@@ -1,0 +1,90 @@
+"""Benchmark harness: HIGGS-style binary training wall-clock + AUC.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md / docs/Experiments.rst:113): reference LightGBM CPU
+trains HIGGS (10.5M rows, 28 features) 500 iters x 255 leaves in 130.094 s on
+a 2x E5-2690v4.  Full HIGGS isn't bundled; we benchmark on the bundled 7k-row
+binary.train replicated to TARGET_ROWS rows so the per-row histogram math is
+comparable, and scale the baseline time by rows*iters to compute vs_baseline
+(>1.0 means faster than the reference per unit work).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_HIGGS_ROWS = 10_500_000
+REFERENCE_TIME_S = 130.094
+REFERENCE_ITERS = 500
+REFERENCE_LEAVES = 255
+
+TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+ITERS = int(os.environ.get("BENCH_ITERS", 50))
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+
+
+def load_data():
+    path = "/root/reference/examples/binary_classification/binary.train"
+    if os.path.exists(path):
+        from lightgbm_tpu.io.parser import load_svmlight_or_csv
+        X, y = load_svmlight_or_csv(path)
+    else:
+        rng = np.random.RandomState(0)
+        X = rng.randn(7000, 28)
+        y = (X[:, 0] + rng.randn(7000) > 0).astype(np.float32)
+    reps = max(1, TARGET_ROWS // X.shape[0])
+    if reps > 1:
+        rng = np.random.RandomState(1)
+        Xs, ys = [], []
+        for r in range(reps):
+            noise = rng.randn(*X.shape).astype(X.dtype) * 0.01
+            Xs.append(X + noise)
+            ys.append(y)
+        X = np.concatenate(Xs, 0)
+        y = np.concatenate(ys, 0)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    X, y = load_data()
+    n = X.shape[0]
+    train_set = lgb.Dataset(X, y)
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "learning_rate": 0.1, "metric": "auc", "verbosity": -1,
+              "min_data_in_leaf": 100}
+    # warmup: bin + compile (excluded, mirroring the reference's convention
+    # of reporting pure training wall-clock)
+    train_set.construct()
+    warm = lgb.train(params, train_set, num_boost_round=1)
+    t0 = time.time()
+    bst = lgb.train(params, train_set, num_boost_round=ITERS)
+    elapsed = time.time() - t0
+    auc = None
+    try:
+        from sklearn.metrics import roc_auc_score
+        auc = float(roc_auc_score(y, bst.predict(X)))
+    except Exception:
+        pass
+
+    # normalize to reference per-(row*iter*leaf) throughput
+    ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
+    our_work = n * ITERS
+    ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
+    vs_baseline = ref_time_scaled / elapsed if elapsed > 0 else 0.0
+    print(json.dumps({
+        "metric": f"binary_train_{n}rows_{ITERS}iters_{NUM_LEAVES}leaves",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(vs_baseline, 3),
+        "train_auc": auc,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch rebuild of LightGBM's capabilities designed for TPUs:
+histogram construction as MXU matmuls (Pallas/XLA), leaf-wise growth as one
+jitted fixed-step program, distributed training via jax.sharding meshes with
+ICI collectives, and a LightGBM-compatible Python API and model format.
+"""
+
+from .basic import Booster, Dataset, Sequence
+from .callback import (early_stopping, log_evaluation, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import CVBooster, cv, train
+from .log import LightGBMError, register_log_callback
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
+           "Config", "LightGBMError", "register_log_callback",
+           "early_stopping", "log_evaluation", "print_evaluation",
+           "record_evaluation", "reset_parameter", "__version__"]
+
+
+def __getattr__(name):
+    # lazy sklearn-style estimators (avoid importing sklearn at package import)
+    if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name == "plot_importance" or name.startswith("plot_"):
+        from . import plotting as _pl
+        return getattr(_pl, name)
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

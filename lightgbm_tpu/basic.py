@@ -1,0 +1,544 @@
+"""LightGBM-compatible binding-level API: Dataset and Booster.
+
+Mirrors python-package/lightgbm/basic.py (Dataset :1125, Booster :2465) so a
+reference user can switch imports.  There is no C-API indirection here — the
+"native" layer is the jitted device program — but the semantics match: lazy
+Dataset construction with binning params frozen at construct time, validation
+sets aligned to their reference Dataset's bin mappers (basic.py:1232
+_init_from_ref_dataset), Booster.update with optional custom fobj.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import Metadata, TrainDataset, ValidDataset
+from .log import LightGBMError, log_info, log_warning, set_verbosity
+from .tree import Tree
+
+__all__ = ["Dataset", "Booster", "Sequence"]
+
+
+class Sequence:
+    """Generic data access interface for chunked out-of-core ingestion
+    (reference basic.py:608-672 Sequence ABC)."""
+    batch_size = 4096
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+def _to_2d_numpy(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        arr = data
+    elif hasattr(data, "toarray"):          # scipy sparse
+        arr = data.toarray()
+    elif type(data).__name__ == "DataFrame":  # pandas without hard dep
+        arr = data.to_numpy()
+    elif isinstance(data, Sequence):
+        arr = np.concatenate([np.atleast_2d(np.asarray(data[i]))
+                              for i in range(len(data))], axis=0)
+    elif isinstance(data, list) and data and isinstance(data[0], Sequence):
+        arr = np.concatenate([_to_2d_numpy(s) for s in data], axis=0)
+    else:
+        arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _pandas_categorical(df):
+    """Extract categorical columns + integer-code them (reference
+    basic.py:518-606 pandas handling)."""
+    cat_cols = [i for i, dt in enumerate(df.dtypes)
+                if str(dt) == "category"]
+    if not cat_cols:
+        return df.to_numpy(dtype=np.float64, na_value=np.nan), []
+    import pandas as pd
+    out = df.copy()
+    for i in cat_cols:
+        col = out.columns[i]
+        out[col] = out[col].cat.codes.replace(-1, np.nan)
+    return out.to_numpy(dtype=np.float64, na_value=np.nan), cat_cols
+
+
+class Dataset:
+    """Lazy-constructed dataset (reference lightgbm.Dataset, basic.py:1125)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name="auto", categorical_feature="auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._handle = None          # TrainDataset or ValidDataset
+        self._used_indices = None
+        self._feature_names: Optional[List[str]] = None
+        self._pandas_cats: List[int] = []
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        if self.reference is not None:
+            self.reference.construct()
+        data = self.data
+        if data is None:
+            raise LightGBMError("cannot construct Dataset: raw data was freed")
+        if isinstance(data, str):
+            from .io.parser import load_svmlight_or_csv
+            arr, label = load_svmlight_or_csv(data)
+            if self.label is None:
+                self.label = label
+        elif type(data).__name__ == "DataFrame":
+            self._feature_names = [str(c) for c in data.columns]
+            arr, self._pandas_cats = _pandas_categorical(data)
+        else:
+            arr = _to_2d_numpy(data)
+
+        if self._used_indices is not None:
+            arr = arr[self._used_indices]
+
+        label = self._slice(self.label)
+        if label is None:
+            label = np.zeros(arr.shape[0], np.float32)
+        meta = Metadata(np.asarray(label),
+                        self._slice(self.weight),
+                        np.asarray(self.group) if self.group is not None else None,
+                        self._slice(self.init_score))
+
+        cfg = Config(self.params)
+        cats = self._resolve_categoricals(arr.shape[1])
+        if self.reference is not None:
+            self._handle = self.reference._handle.create_valid(arr, meta)
+        else:
+            self._handle = TrainDataset(arr, meta, cfg,
+                                        categorical_features=cats)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _slice(self, x):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        if self._used_indices is not None and len(x) != len(self._used_indices):
+            x = x[self._used_indices]
+        return x
+
+    def _resolve_categoricals(self, num_features: int) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            return list(self._pandas_cats)
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                if self._feature_names and c in self._feature_names:
+                    out.append(self._feature_names.index(c))
+            else:
+                out.append(int(c))
+        return sorted(set(out) | set(self._pandas_cats))
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        """Row subset sharing binning params (reference Dataset.subset;
+        CopySubrow dataset.h:416).  Used by cv()."""
+        ds = Dataset(self.data, label=self.label, weight=self.weight,
+                     group=self.group, init_score=self.init_score,
+                     feature_name=self.feature_name,
+                     categorical_feature=self.categorical_feature,
+                     params=params or self.params, free_raw_data=False)
+        ds._used_indices = np.asarray(used_indices)
+        ds.reference = self.reference
+        return ds
+
+    def set_label(self, label):
+        self.label = label
+        if self._handle is not None:
+            self._handle.metadata.label = np.asarray(label, np.float32)
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        return self
+
+    def get_label(self):
+        if self._handle is not None:
+            return np.asarray(self._handle.metadata.label)
+        return np.asarray(self.label) if self.label is not None else None
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._handle.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        h = self._handle
+        return (h.num_total_features if isinstance(h, TrainDataset)
+                else h.train.num_total_features)
+
+    def get_feature_names(self) -> List[str]:
+        if self._feature_names:
+            return self._feature_names
+        return [f"Column_{i}" for i in range(self.num_feature())]
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binned-dataset cache (reference Dataset::SaveBinaryFile)."""
+        self.construct()
+        from .io.binary_cache import save_dataset
+        save_dataset(self._handle, filename)
+        return self
+
+    @staticmethod
+    def from_binary(filename: str, params=None) -> "Dataset":
+        from .io.binary_cache import load_dataset
+        handle = load_dataset(filename, Config(params or {}))
+        ds = Dataset(None, free_raw_data=False)
+        ds._handle = handle
+        return ds
+
+
+class Booster:
+    """Training/prediction handle (reference lightgbm.Booster, basic.py:2465)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self._gbdt = None
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_set = train_set
+        self._loaded_trees: Optional[List[Tree]] = None
+        self._loaded_meta: Dict[str, str] = {}
+
+        if model_file is not None:
+            with open(model_file) as fh:
+                model_str = fh.read()
+        if model_str is not None:
+            self._load_from_string(model_str)
+            return
+        if train_set is None:
+            raise LightGBMError("Booster requires train_set or model file")
+        cfg = Config(self.params)
+        set_verbosity(cfg.verbosity)
+        train_set.params = dict(train_set.params or self.params)
+        train_set.construct()
+        from .objectives import create_objective
+        from .boosting import create_boosting
+        self._config = cfg
+        self._objective = create_objective(cfg)
+        self._gbdt = create_boosting(cfg, train_set._handle, self._objective)
+        self._valid_names: List[str] = []
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.reference = data.reference or self._train_set
+        data.params = dict(data.params or self.params)
+        data.construct()
+        self._gbdt.add_valid(data._handle, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits possible
+        (reference LGBM_BoosterUpdateOneIter / ...Custom, c_api.cpp:1677,1698)."""
+        if fobj is not None:
+            score = self._raw_train_score()
+            grad, hess = fobj(score, self._train_set)
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def _raw_train_score(self):
+        score = np.asarray(self._gbdt.train_score)
+        if self._gbdt.num_class == 1:
+            return score[0]
+        return score.T  # sklearn convention [N, K]
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees if self._gbdt else len(self._loaded_trees)
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_class if self._gbdt else int(
+            self._loaded_meta.get("num_tree_per_iteration", 1))
+
+    def eval_valid(self, feval=None) -> List[tuple]:
+        return [t for name in self._valid_names
+                for t in self._eval_set(name, feval)]
+
+    def eval_train(self, feval=None) -> List[tuple]:
+        return self._eval_set("training", feval)
+
+    def _eval_set(self, name, feval=None) -> List[tuple]:
+        g = self._gbdt
+        results = []
+        if name == "training":
+            data_meta = g.train_data.metadata
+            score = g.train_score
+        else:
+            i = self._valid_names.index(name)
+            data_meta = g.valid_sets[i].metadata
+            score = g.valid_scores[i]
+        raw = score[0] if g.num_class == 1 else score
+        for m in g.train_metrics:
+            for mname, val, hib in m.eval(raw, data_meta.label, data_meta.weight,
+                                          g.objective, data_meta.query_boundaries):
+                results.append((name, mname, val, hib))
+        if feval is not None:
+            ds = (self._train_set if name == "training" else None)
+            raw_np = np.asarray(raw) if g.num_class == 1 else np.asarray(raw).T
+            for r in _call_feval(feval, raw_np, data_meta):
+                results.append((name, r[0], r[1], r[2]))
+        return results
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if isinstance(data, str):
+            from .io.parser import load_svmlight_or_csv
+            data, _ = load_svmlight_or_csv(data)
+        elif type(data).__name__ == "DataFrame":
+            data, _ = _pandas_categorical(data)
+        else:
+            data = _to_2d_numpy(data)
+        if num_iteration is None:
+            num_iteration = -1
+        if num_iteration < 0 and self.best_iteration > 0:
+            num_iteration = self.best_iteration
+        if self._gbdt is not None:
+            if pred_leaf:
+                return self._gbdt.predict_leaf_index(data, start_iteration,
+                                                     num_iteration)
+            if pred_contrib:
+                from .contrib import predict_contrib
+                return predict_contrib(self._trees_for_range(
+                    start_iteration, num_iteration), data,
+                    self.num_model_per_iteration())
+            return self._gbdt.predict(data, raw_score, start_iteration,
+                                      num_iteration)
+        return self._predict_loaded(data, start_iteration, num_iteration,
+                                    raw_score, pred_leaf, pred_contrib)
+
+    def _trees_for_range(self, start_iteration, num_iteration):
+        k = self.num_model_per_iteration()
+        models = self._gbdt.models if self._gbdt else self._loaded_trees
+        n_iter = len(models) // k
+        end = n_iter if num_iteration < 0 else min(
+            start_iteration + num_iteration, n_iter)
+        return models[start_iteration * k: end * k]
+
+    def _predict_loaded(self, data, start_iteration, num_iteration, raw_score,
+                        pred_leaf, pred_contrib):
+        trees = self._trees_for_range(start_iteration, num_iteration)
+        k = int(self._loaded_meta.get("num_tree_per_iteration", 1))
+        n = data.shape[0]
+        if pred_leaf:
+            return np.stack([t.predict_leaf_index(data) for t in trees], axis=1)
+        if pred_contrib:
+            from .contrib import predict_contrib
+            return predict_contrib(trees, data, k)
+        if k == 1:
+            out = np.zeros(n)
+            for t in trees:
+                out += t.predict(data)
+        else:
+            out = np.zeros((n, k))
+            for i, t in enumerate(trees):
+                out[:, i % k] += t.predict(data)
+        if self._loaded_meta.get("average_output"):
+            out /= max(len(trees) // k, 1)
+        if raw_score:
+            return out
+        return self._convert_loaded_output(out)
+
+    def _convert_loaded_output(self, raw):
+        obj = self._loaded_meta.get("objective", "")
+        if obj.startswith("binary") or obj.startswith("cross_entropy"):
+            sigmoid = 1.0
+            for tok in obj.split():
+                if tok.startswith("sigmoid:"):
+                    sigmoid = float(tok.split(":")[1])
+            return 1.0 / (1.0 + np.exp(-sigmoid * raw))
+        if obj.startswith("multiclass ") or obj.startswith("multiclass"):
+            if "ova" not in obj:
+                e = np.exp(raw - raw.max(axis=1, keepdims=True))
+                return e / e.sum(axis=1, keepdims=True)
+            return 1.0 / (1.0 + np.exp(-raw))
+        if any(obj.startswith(p) for p in ("poisson", "gamma", "tweedie")):
+            return np.exp(raw)
+        return raw
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        models = (self._gbdt.models if self._gbdt else self._loaded_trees)
+        nfeat = self.num_feature()
+        out = np.zeros(nfeat)
+        k = self.num_model_per_iteration()
+        if iteration is not None and iteration > 0:
+            models = models[: iteration * k]
+        for t in models:
+            ni = t.num_leaves - 1
+            for node in range(ni):
+                f = t.split_feature[node]
+                if importance_type == "split":
+                    out[f] += 1
+                else:
+                    out[f] += max(float(t.split_gain[node]), 0.0)
+        return out
+
+    def num_feature(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.train_data.num_total_features
+        return int(self._loaded_meta.get("max_feature_idx", 0)) + 1
+
+    def feature_name(self) -> List[str]:
+        if "feature_names" in self._loaded_meta:
+            return self._loaded_meta["feature_names"].split()
+        if self._train_set is not None:
+            return self._train_set.get_feature_names()
+        return [f"Column_{i}" for i in range(self.num_feature())]
+
+    # -- model io ---------------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1,
+                        start_iteration: int = 0) -> str:
+        if self._gbdt is not None:
+            return self._gbdt.save_model_to_string(start_iteration,
+                                                   num_iteration)
+        # re-serialize loaded model
+        lines = [f"{k}={v}" for k, v in self._loaded_meta.items()
+                 if k not in ("feature_names", "feature_infos")]
+        header = ["tree"] + lines
+        header.append("feature_names=" + self._loaded_meta.get("feature_names", ""))
+        header.append("feature_infos=" + self._loaded_meta.get("feature_infos", ""))
+        header.append("")
+        for i, t in enumerate(self._loaded_trees):
+            header.append(t.to_string(i))
+        header.append("end of trees\n")
+        return "\n".join(header)
+
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0, **kwargs) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
+        models = (self._gbdt.models if self._gbdt else self._loaded_trees)
+        k = self.num_model_per_iteration()
+        trees = self._trees_for_range(start_iteration, num_iteration) \
+            if models else []
+        return {
+            "name": "tree",
+            "version": "v3",
+            "num_class": k,
+            "num_tree_per_iteration": k,
+            "max_feature_idx": self.num_feature() - 1,
+            "feature_names": self.feature_name(),
+            "tree_info": [t.to_json(i) for i, t in enumerate(trees)],
+        }
+
+    def _load_from_string(self, model_str: str) -> None:
+        header, _, rest = model_str.partition("\nTree=")
+        meta: Dict[str, str] = {}
+        for line in header.splitlines():
+            if line.strip() == "average_output":
+                meta["average_output"] = "1"
+            elif "=" in line:
+                key, v = line.split("=", 1)
+                meta[key.strip()] = v.strip()
+        self._loaded_meta = meta
+        trees = []
+        if rest:
+            body = "Tree=" + rest
+            blocks = body.split("\nTree=")
+            for b in blocks:
+                b = b.strip()
+                if not b or b.startswith("end of trees"):
+                    continue
+                if not b.startswith("Tree="):
+                    b = "Tree=" + b
+                b = b.split("end of trees")[0]
+                trees.append(Tree.from_string(b))
+        self._loaded_trees = trees
+
+    def __copy__(self):
+        return self
+
+    # reference Booster attributes used by callbacks
+    @property
+    def objective(self):
+        if self._gbdt is not None:
+            return self._gbdt.objective.name
+        return self._loaded_meta.get("objective", "")
+
+
+def _call_feval(feval, raw_np, data_meta):
+    class _DS:  # minimal Dataset shim for feval signature
+        def __init__(self, meta):
+            self._meta = meta
+
+        def get_label(self):
+            return np.asarray(self._meta.label)
+
+        def get_weight(self):
+            return self._meta.weight
+
+        def get_group(self):
+            if self._meta.query_boundaries is None:
+                return None
+            return np.diff(self._meta.query_boundaries)
+
+    fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+    out = []
+    for f in fevals:
+        r = f(raw_np, _DS(data_meta))
+        if isinstance(r, list):
+            out.extend(r)
+        else:
+            out.append(r)
+    return out

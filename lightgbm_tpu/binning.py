@@ -1,0 +1,311 @@
+"""Host-side feature binning: raw feature value -> small integer bin id.
+
+TPU-native equivalent of the reference ``BinMapper`` (include/LightGBM/bin.h:61,
+src/io/bin.cpp).  Binning is sample-based and cheap, so it stays on host
+(reference keeps it on CPU too: src/io/dataset_loader.cpp:1012-1043); the binned
+uint8/uint16 matrix is what ships to TPU HBM.
+
+Deviation from the reference, documented: storage is always a dense packed bin
+matrix (rows x features).  The reference's sparse-bin / multi-val-bin split is a
+CPU cache-locality optimisation that does not map to the MXU-matmul histogram
+formulation; sparsity is instead exploited through EFB bundling (efb.py) which
+the reference also prefers (docs/Features.rst EFB section).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["BinMapper", "BinType", "MissingType", "find_bin_mappers"]
+
+
+class BinType:
+    NUMERICAL = "numerical"
+    CATEGORICAL = "categorical"
+
+
+class MissingType:
+    # reference bin.h MissingType enum: None/Zero/NaN
+    NONE = "none"
+    ZERO = "zero"
+    NAN = "nan"
+
+
+_K_ZERO_LOW = -1e-35
+_K_ZERO_HIGH = 1e-35  # reference kZeroThreshold band: values in (-1e-35,1e-35) are "zero"
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Find numerical bin upper bounds from distinct sample values.
+
+    Same strategy as reference GreedyFindBin (src/io/bin.cpp): if the number of
+    distinct values fits, one bin per value with midpoint boundaries; otherwise
+    distribute by count as evenly as possible while respecting min_data_in_bin.
+    Returns upper bounds; last is +inf.
+    """
+    bin_upper_bound: List[float] = []
+    num_distinct = len(distinct_values)
+    if num_distinct <= max_bin:
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += counts[i]
+            if cur_cnt >= min_data_in_bin or counts[i + 1] >= min_data_in_bin:
+                # midpoint boundary, same as reference (bin.cpp GreedyFindBin)
+                bin_upper_bound.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur_cnt = 0
+        bin_upper_bound.append(np.inf)
+        return bin_upper_bound
+
+    max_bin = max(1, max_bin)
+    mean_bin_size = total_cnt / max_bin
+    # values whose count alone exceeds mean bin size get their own bin
+    is_big = counts >= mean_bin_size
+    rest_cnt = total_cnt - counts[is_big].sum()
+    rest_bins = max_bin - int(is_big.sum())
+    mean_rest = rest_cnt / max(rest_bins, 1)
+
+    upper: List[float] = []
+    cur_cnt = 0
+    for i in range(num_distinct):
+        if not is_big[i]:
+            rest_cnt -= counts[i]
+        cur_cnt += counts[i]
+        boundary = (is_big[i] or cur_cnt >= mean_rest or
+                    (i + 1 < num_distinct and is_big[i + 1]))
+        if boundary and i + 1 < num_distinct and cur_cnt >= min_data_in_bin:
+            upper.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+            cur_cnt = 0
+            if not is_big[i] and rest_bins > 1:
+                rest_bins -= 1
+                mean_rest = rest_cnt / max(rest_bins, 1)
+        if len(upper) >= max_bin - 1:
+            break
+    upper.append(np.inf)
+    return upper
+
+
+class BinMapper:
+    """Per-feature raw-value -> bin mapping (reference bin.h:61-225)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.bin_type: str = BinType.NUMERICAL
+        self.missing_type: str = MissingType.NONE
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.bin_2_categorical: List[int] = []
+        self.default_bin: int = 0          # bin that holds raw zero
+        self.most_freq_bin: int = 0
+        self.is_trivial: bool = False      # single-bin feature -> filtered
+        self.sparse_rate: float = 0.0
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+
+    # ------------------------------------------------------------------
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int = 3, min_split_data: int = 0,
+                 pre_filter: bool = True, bin_type: str = BinType.NUMERICAL,
+                 use_missing: bool = True, zero_as_missing: bool = False) -> "BinMapper":
+        """Compute the mapping from sampled values (reference BinMapper::FindBin,
+        bin.h:160 / src/io/bin.cpp).  ``values`` are the sampled non-missing raw
+        values; rows not present in ``values`` out of ``total_sample_cnt`` are
+        implicit zeros (sparse sampling convention shared with the reference).
+        """
+        self.bin_type = bin_type
+        values = np.asarray(values, dtype=np.float64)
+        na_cnt = int(np.isnan(values).sum())
+        values = values[~np.isnan(values)]
+        # implicit rows (absent from the sample) are zeros, but NaN rows are
+        # not (reference bin.cpp:352 subtracts na_cnt)
+        zero_cnt = total_sample_cnt - len(values) - na_cnt + int(
+            ((values > _K_ZERO_LOW) & (values < _K_ZERO_HIGH)).sum())
+
+        if zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        elif not use_missing:
+            self.missing_type = MissingType.NONE
+        elif na_cnt > 0:
+            self.missing_type = MissingType.NAN
+        else:
+            self.missing_type = MissingType.NONE
+
+        if bin_type == BinType.CATEGORICAL:
+            self._find_bin_categorical(values, total_sample_cnt, max_bin,
+                                       min_data_in_bin)
+        else:
+            self._find_bin_numerical(values, total_sample_cnt, zero_cnt, na_cnt,
+                                     max_bin, min_data_in_bin)
+
+        counts = self._bin_counts(values, total_sample_cnt)
+        if counts.sum() > 0:
+            self.most_freq_bin = int(np.argmax(counts))
+            self.sparse_rate = float(counts[self.most_freq_bin]) / max(total_sample_cnt, 1)
+        self.is_trivial = self.num_bin <= 1
+        if pre_filter and min_split_data > 0 and not self.is_trivial:
+            # feature_pre_filter: a feature that can never satisfy
+            # min_data_in_leaf on both sides is trivial (reference bin.cpp)
+            big = counts >= (total_sample_cnt - min_split_data)
+            if big.any():
+                self.is_trivial = True
+        return self
+
+    def _find_bin_numerical(self, values, total, zero_cnt, na_cnt, max_bin,
+                            min_data_in_bin):
+        non_zero = values[(values <= _K_ZERO_LOW) | (values >= _K_ZERO_HIGH)]
+        self.min_val = float(non_zero.min()) if len(non_zero) else 0.0
+        self.max_val = float(non_zero.max()) if len(non_zero) else 0.0
+        distinct, counts = (np.unique(non_zero, return_counts=True)
+                            if len(non_zero) else (np.array([]), np.array([], dtype=int)))
+        # inject the zero pseudo-value with its count so that zero gets a bin
+        if zero_cnt > 0 and self.missing_type != MissingType.ZERO:
+            idx = np.searchsorted(distinct, 0.0)
+            distinct = np.insert(distinct, idx, 0.0)
+            counts = np.insert(counts, idx, zero_cnt)
+        usable_bins = max_bin - (1 if self.missing_type in (MissingType.NAN, MissingType.ZERO) else 0)
+        if len(distinct) == 0:
+            upper = [np.inf]
+        else:
+            upper = _greedy_find_bin(distinct, counts,
+                                     usable_bins, int(counts.sum()), min_data_in_bin)
+        self.bin_upper_bound = np.asarray(upper, dtype=np.float64)
+        self.num_bin = len(upper)
+        if self.missing_type in (MissingType.NAN, MissingType.ZERO):
+            self.num_bin += 1  # last bin is the missing bin
+        # bin holding raw zero
+        self.default_bin = (self.num_bin - 1 if self.missing_type == MissingType.ZERO
+                            else int(np.searchsorted(self.bin_upper_bound, 0.0)))
+
+    def _find_bin_categorical(self, values, total, max_bin, min_data_in_bin):
+        cats = values.astype(np.int64)
+        cats = cats[cats >= 0]  # negative categories treated as missing (reference warns)
+        distinct, counts = (np.unique(cats, return_counts=True)
+                            if len(cats) else (np.array([], dtype=np.int64),
+                                               np.array([], dtype=int)))
+        order = np.argsort(-counts, kind="stable")
+        distinct, counts = distinct[order], counts[order]
+        # keep most frequent categories covering 99% of data, capped at max_bin-1
+        # (reference bin.cpp categorical path)
+        cut = len(distinct)
+        if cut > 0:
+            cum = np.cumsum(counts)
+            cover = int(np.searchsorted(cum, 0.99 * cum[-1])) + 1
+            cut = min(cut, cover, max_bin - 1 if max_bin > 1 else 1)
+            keep_mask = counts[:cut] >= min_data_in_bin
+            if keep_mask.any():
+                cut = int(np.nonzero(keep_mask)[0].max()) + 1
+        distinct = distinct[:cut]
+        self.bin_2_categorical = [int(c) for c in distinct]
+        # bin 0 reserved for missing/other categories
+        self.categorical_2_bin = {int(c): i + 1 for i, c in enumerate(distinct)}
+        self.num_bin = len(distinct) + 1
+        self.missing_type = MissingType.NAN
+        self.default_bin = self.categorical_2_bin.get(0, 0)
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized raw value -> bin id (reference ValueToBin, bin.h:464-502)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BinType.CATEGORICAL:
+            out = np.zeros(values.shape, dtype=np.int32)
+            nan = np.isnan(values)
+            ints = np.where(nan, -1, values).astype(np.int64)
+            for cat, b in self.categorical_2_bin.items():
+                out[ints == cat] = b
+            return out
+        nan_mask = np.isnan(values)
+        if self.missing_type == MissingType.ZERO:
+            zero_mask = (values > _K_ZERO_LOW) & (values < _K_ZERO_HIGH)
+            nan_mask = nan_mask | zero_mask
+        filled = np.where(nan_mask, 0.0, values)
+        out = np.searchsorted(self.bin_upper_bound, filled, side="left").astype(np.int32)
+        # values exactly equal to an upper bound belong to that bin (bound is inclusive)
+        n_bounds = len(self.bin_upper_bound)
+        out = np.minimum(out, n_bounds - 1)
+        if self.missing_type in (MissingType.NAN, MissingType.ZERO):
+            out[nan_mask] = self.num_bin - 1
+        return out
+
+    def bin_to_value(self, b: int) -> float:
+        """Representative threshold value for a bin boundary (for model files:
+        the reference stores real-valued thresholds, tree.cpp ToString)."""
+        if self.bin_type == BinType.CATEGORICAL:
+            if 0 <= b - 1 < len(self.bin_2_categorical):
+                return float(self.bin_2_categorical[b - 1])
+            return -1.0
+        if b >= len(self.bin_upper_bound):
+            return float(self.bin_upper_bound[-1])
+        return float(self.bin_upper_bound[b])
+
+    @property
+    def missing_bin(self) -> Optional[int]:
+        if self.missing_type in (MissingType.NAN, MissingType.ZERO):
+            return self.num_bin - 1
+        return None
+
+    def _bin_counts(self, values, total_sample_cnt) -> np.ndarray:
+        counts = np.zeros(max(self.num_bin, 1), dtype=np.int64)
+        if len(values):
+            b = self.value_to_bin(values)
+            np.add.at(counts, b, 1)
+        implicit = total_sample_cnt - len(values)
+        if implicit > 0 and self.num_bin > 0:
+            zb = self.value_to_bin(np.zeros(1))[0]
+            counts[zb] += implicit
+        return counts
+
+    # -- serialization (reference CopyTo/CopyFrom + model text) ----------
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "bin_type": self.bin_type,
+            "missing_type": self.missing_type,
+            "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
+            "bin_2_categorical": self.bin_2_categorical,
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "is_trivial": self.is_trivial,
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BinMapper":
+        m = BinMapper()
+        m.num_bin = d["num_bin"]
+        m.bin_type = d["bin_type"]
+        m.missing_type = d["missing_type"]
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = list(d.get("bin_2_categorical", []))
+        m.categorical_2_bin = {c: i + 1 for i, c in enumerate(m.bin_2_categorical)}
+        m.default_bin = d.get("default_bin", 0)
+        m.most_freq_bin = d.get("most_freq_bin", 0)
+        m.is_trivial = d.get("is_trivial", False)
+        m.min_val = d.get("min_val", 0.0)
+        m.max_val = d.get("max_val", 0.0)
+        return m
+
+
+def find_bin_mappers(sample: np.ndarray, max_bin: int = 255,
+                     min_data_in_bin: int = 3,
+                     categorical_features: Optional[Sequence[int]] = None,
+                     use_missing: bool = True, zero_as_missing: bool = False,
+                     min_split_data: int = 0,
+                     max_bin_by_feature: Optional[Sequence[int]] = None,
+                     feature_pre_filter: bool = True) -> List[BinMapper]:
+    """Find one BinMapper per column of a sampled row-block
+    (reference DatasetLoader::ConstructBinMappersFromTextData path)."""
+    sample = np.asarray(sample, dtype=np.float64)
+    n, num_features = sample.shape
+    cats = set(categorical_features or ())
+    mappers = []
+    for f in range(num_features):
+        mb = max_bin if max_bin_by_feature is None else int(max_bin_by_feature[f])
+        m = BinMapper().find_bin(
+            sample[:, f], n, mb, min_data_in_bin, min_split_data,
+            pre_filter=feature_pre_filter,
+            bin_type=BinType.CATEGORICAL if f in cats else BinType.NUMERICAL,
+            use_missing=use_missing, zero_as_missing=zero_as_missing)
+        mappers.append(m)
+    return mappers

@@ -1,0 +1,23 @@
+"""Boosting algorithms (reference src/boosting/, factory boosting.cpp:35)."""
+
+from .gbdt import GBDT
+
+
+def create_boosting(config, dataset, objective):
+    """reference Boosting::CreateBoosting (include/LightGBM/boosting.h:314)."""
+    btype = config.boosting
+    if btype == "gbdt":
+        return GBDT(config, dataset, objective)
+    if btype == "dart":
+        from .dart import DART
+        return DART(config, dataset, objective)
+    if btype == "goss":
+        from .goss import GOSS
+        return GOSS(config, dataset, objective)
+    if btype == "rf":
+        from .rf import RF
+        return RF(config, dataset, objective)
+    raise ValueError(f"unknown boosting type: {btype!r}")
+
+
+__all__ = ["GBDT", "create_boosting"]

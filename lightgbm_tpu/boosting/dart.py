@@ -1,0 +1,114 @@
+"""DART: Dropouts meet Multiple Additive Regression Trees.
+
+Reference: src/boosting/dart.hpp — per iteration select a drop set of
+existing trees (uniform or weight-proportional, dart.hpp:97-130), remove them
+from the training score so the new tree fits the residual, then Normalize
+(dart.hpp:158+): the new tree is trained with shrinkage lr/(1+k) and each
+dropped tree is rescaled to k/(k+1) of its weight (xgboost_dart_mode uses
+lr/(lr+k) and k/(k+lr)).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .gbdt import GBDT, _negated
+
+
+class DART(GBDT):
+    def __init__(self, config, train_data, objective):
+        super().__init__(config, train_data, objective)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._dropping_trees()
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    # ------------------------------------------------------------------
+    def _scale_tree_and_rescore(self, it: int, factor: float,
+                                train: bool, valid: bool) -> None:
+        """Multiply iteration ``it``'s trees' leaf values by ``factor`` and
+        add their (new minus nothing) contribution... following the
+        reference's Shrinkage+AddScore sequence exactly: the caller arranges
+        factors so each AddScore applies the intended delta."""
+        for cls in range(self.num_class):
+            tree = self.models[it * self.num_class + cls]
+            tree.shrinkage(factor)
+            if train:
+                self.train_score = self._add_tree_to_score(
+                    self.train_score, cls, tree, self.train_data.device_bins)
+            if valid:
+                for i, v in enumerate(self.valid_sets):
+                    self.valid_scores[i] = self._add_tree_to_score(
+                        self.valid_scores[i], cls, tree, v.device_bins)
+
+    def _dropping_trees(self) -> None:
+        """reference DART::DroppingTrees (dart.hpp:97-148)."""
+        cfg = self.config
+        self.drop_index = []
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip and self.iter_ > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop and self.sum_weight > 0:
+                inv_avg = len(self.tree_weight) / self.sum_weight
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self.iter_)
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        # drop from the training score: Shrinkage(-1) + AddScore
+        for it in self.drop_index:
+            self._scale_tree_and_rescore(it, -1.0, train=True, valid=False)
+        k = float(len(self.drop_index))
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if k == 0 else
+                                   cfg.learning_rate / (cfg.learning_rate + k))
+
+    def _normalize(self) -> None:
+        """reference DART::Normalize (dart.hpp:158-206): dropped tree ends at
+        weight k/(k+1) of its original; valid score adjusted by the delta,
+        train score gets the tree re-added at its final weight."""
+        cfg = self.config
+        k = float(len(self.drop_index))
+        for it in self.drop_index:
+            if not cfg.xgboost_dart_mode:
+                # tree currently at -w; shrink to -w/(k+1), add to valid
+                self._scale_tree_and_rescore(it, 1.0 / (k + 1.0),
+                                             train=False, valid=True)
+                # shrink to w*k/(k+1), add back to train
+                self._scale_tree_and_rescore(it, -k, train=True, valid=False)
+            else:
+                self._scale_tree_and_rescore(it, self.shrinkage_rate,
+                                             train=False, valid=True)
+                self._scale_tree_and_rescore(it, -k / cfg.learning_rate,
+                                             train=True, valid=False)
+            if not cfg.uniform_drop:
+                denom = (k + 1.0 if not cfg.xgboost_dart_mode
+                         else k + cfg.learning_rate)
+                self.sum_weight -= self.tree_weight[it] * (1.0 / denom)
+                self.tree_weight[it] *= (k / denom)

@@ -1,0 +1,415 @@
+"""GBDT: the boosting training loop.
+
+TPU-native equivalent of the reference GBDT (src/boosting/gbdt.cpp): per
+iteration compute gradients on device, apply bagging, grow one tree per class
+with the jitted leaf-wise learner, optionally refit leaves host-side
+(RenewTreeOutput), shrink, and update train/valid raw scores incrementally
+(ScoreUpdater::AddScore, score_updater.hpp:21).  Model text serialization
+keeps the reference format (gbdt_model_text.cpp:311 SaveModelToString).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..dataset import TrainDataset, ValidDataset
+from ..tree import Tree
+from ..tree_learner import SerialTreeLearner, state_to_tree
+from ..ops.predict import traverse_binned
+from ..metrics import create_metrics
+from ..log import log_info, log_warning
+
+__all__ = ["GBDT"]
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree trainer (reference gbdt.h/gbdt.cpp)."""
+
+    def __init__(self, config, train_data: TrainDataset, objective):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.num_class = objective.num_model_per_iteration
+        self.shrinkage_rate = config.learning_rate
+        self.models: List[Tree] = []   # iteration-major, class-minor
+        self.iter_ = 0
+        self.best_iteration = -1
+        self.average_output = False    # RF sets True (reference rf.hpp:27)
+
+        objective.init(train_data.metadata, train_data.num_data)
+        self.tree_learner = self._create_tree_learner(config, train_data)
+
+        n = train_data.num_data
+        k = self.num_class
+        init = jnp.zeros((k, n), jnp.float32)
+        if train_data.metadata.init_score is not None:
+            s = np.asarray(train_data.metadata.init_score, np.float32)
+            init = init + jnp.asarray(s.reshape(k, n) if s.size == k * n
+                                      else np.tile(s, (k, 1)))
+            self._has_init_score = True
+        else:
+            self._has_init_score = False
+        self.train_score = init
+        self.valid_sets: List[ValidDataset] = []
+        self.valid_names: List[str] = []
+        self.valid_scores: List[jnp.ndarray] = []
+        self.train_metrics = create_metrics(config, objective)
+        self._boosted_from_average = [False] * k
+        self._bag_rng = np.random.RandomState(config.bagging_seed)
+        self.eval_results: Dict[str, Dict[str, List[float]]] = {}
+        self._L = self.tree_learner.grower_cfg.num_leaves
+
+    def _create_tree_learner(self, config, train_data):
+        # reference TreeLearner::CreateTreeLearner 4x3 factory
+        # (src/treelearner/tree_learner.cpp); parallel modes live in
+        # parallel/ and are selected by tree_learner= config
+        if config.tree_learner == "serial" or config.num_machines <= 1:
+            return SerialTreeLearner(config, train_data)
+        from ..parallel.data_parallel import DataParallelTreeLearner
+        return DataParallelTreeLearner(config, train_data)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid: ValidDataset, name: str):
+        self.valid_sets.append(valid)
+        self.valid_names.append(name)
+        k, nv = self.num_class, valid.num_data
+        score = jnp.zeros((k, nv), jnp.float32)
+        if valid.metadata.init_score is not None:
+            s = np.asarray(valid.metadata.init_score, np.float32)
+            score = score + jnp.asarray(s.reshape(k, nv) if s.size == k * nv
+                                        else np.tile(s, (k, 1)))
+        # catch up on already-trained iterations
+        if self.models:
+            for it in range(self.iter_):
+                for cls in range(self.num_class):
+                    tree = self.models[it * self.num_class + cls]
+                    score = self._add_tree_to_score(score, cls, tree,
+                                                    valid.device_bins)
+        self.valid_scores.append(score)
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, cls: int) -> float:
+        cfg, obj = self.config, self.objective
+        if (not cfg.boost_from_average or self._has_init_score
+                or self._boosted_from_average[cls]):
+            return 0.0
+        self._boosted_from_average[cls] = True
+        label = self.train_data.label
+        weight = self.train_data.weight
+        init = obj.boost_from_score(label, weight, cls)
+        if init != 0.0:
+            self.train_score = self.train_score.at[cls].add(init)
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self.valid_scores[i].at[cls].add(init)
+        return init
+
+    def _bagging_mask(self, iteration: int) -> jnp.ndarray:
+        """reference GBDT::Bagging (gbdt.cpp:228): deterministic per-iteration
+        row subset, incl. balanced pos/neg bagging."""
+        cfg = self.config
+        n = self.train_data.num_data
+        use_pos_neg = (cfg.pos_bagging_fraction < 1.0
+                       or cfg.neg_bagging_fraction < 1.0)
+        need = (cfg.bagging_freq > 0 and
+                (cfg.bagging_fraction < 1.0 or use_pos_neg))
+        if not need:
+            return jnp.ones((n,), jnp.float32)
+        if iteration % cfg.bagging_freq != 0 and hasattr(self, "_last_mask"):
+            return self._last_mask
+        rng = np.random.RandomState(cfg.bagging_seed + iteration)
+        if use_pos_neg:
+            label = np.asarray(self.train_data.metadata.label)
+            mask = np.zeros(n, np.float32)
+            pos = label > 0
+            mask[pos] = (rng.rand(int(pos.sum())) <
+                         cfg.pos_bagging_fraction).astype(np.float32)
+            mask[~pos] = (rng.rand(int((~pos).sum())) <
+                          cfg.neg_bagging_fraction).astype(np.float32)
+        else:
+            mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
+        self._last_mask = jnp.asarray(mask)
+        return self._last_mask
+
+    def _get_gradients(self):
+        label = self.train_data.label
+        weight = self.train_data.weight
+        score = self.train_score
+        if self.num_class == 1:
+            g, h = self.objective.get_gradients(score[0], label, weight)
+            return g[None, :], h[None, :]
+        return self.objective.get_gradients(score, label, weight)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """Train one boosting iteration (reference GBDT::TrainOneIter,
+        gbdt.cpp:369).  Returns True if training should stop (no splits)."""
+        k = self.num_class
+        init_scores = [0.0] * k
+        if grad is None or hess is None:
+            for cls in range(k):
+                init_scores[cls] = self._boost_from_average(cls)
+            grad, hess = self._get_gradients()
+        else:
+            grad = jnp.asarray(np.asarray(grad, np.float32).reshape(k, -1))
+            hess = jnp.asarray(np.asarray(hess, np.float32).reshape(k, -1))
+
+        grad, hess, mask = self._adjust_gradients(grad, hess)
+        stop = self._grow_and_apply(grad, hess, mask, init_scores)
+        self.iter_ += 1
+        return stop
+
+    def _adjust_gradients(self, grad, hess):
+        """Hook for sampling strategies that rescale gradients (GOSS
+        overrides this; reference GOSS::BaggingHelper)."""
+        return grad, hess, self._bagging_mask(self.iter_)
+
+    bias_before_score_update = False
+
+    def _renew_score(self, cls: int) -> np.ndarray:
+        """Score used for leaf-refit residuals (RF overrides with its
+        constant init score, reference rf.hpp:132-135)."""
+        return np.asarray(self.train_score[cls])
+
+    def _grow_and_apply(self, grad, hess, mask, init_scores) -> bool:
+        obj = self.objective
+        any_split = False
+        for cls in range(self.num_class):
+            state = self.tree_learner.train(grad[cls], hess[cls], mask,
+                                            self.iter_)
+            tree = state_to_tree(state, self.train_data.feature_mappers,
+                                 self.train_data.real_feature_index)
+            if tree.num_leaves > 1:
+                any_split = True
+                if obj.need_renew_tree_output:
+                    # reference RenewTreeOutput (serial_tree_learner.cpp:684)
+                    tree = obj.renew_tree_output(
+                        tree, self._renew_score(cls),
+                        np.asarray(self.train_data.metadata.label),
+                        self.train_data.metadata.weight,
+                        np.asarray(state.row_leaf), tree.num_leaves)
+                tree.shrinkage(self.shrinkage_rate)
+                if self.bias_before_score_update:
+                    # RF: the tree IS a standalone predictor incl. the init
+                    # (reference rf.hpp:136-141 AddBias before UpdateScore)
+                    if init_scores[cls] != 0.0:
+                        tree.add_bias(init_scores[cls])
+                    self._update_scores(cls, tree, state)
+                else:
+                    # GBDT: scores first, THEN fold the init bias into the
+                    # stored tree — the running scores already received the
+                    # init via BoostFromAverage (reference gbdt.cpp:411-416)
+                    self._update_scores(cls, tree, state)
+                    if init_scores[cls] != 0.0:
+                        tree.add_bias(init_scores[cls])
+            else:
+                # no splits: store the init as a constant tree so standalone
+                # prediction matches (reference gbdt.cpp:418-434)
+                if init_scores[cls] != 0.0:
+                    tree.leaf_value[0] = init_scores[cls]
+            self.models.append(tree)
+        if not any_split:
+            log_warning("stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        return not any_split
+
+    def _update_scores(self, cls: int, tree: Tree, state):
+        # train: fast path via row->leaf vector (reference ScoreUpdater
+        # AddScore(tree, data_partition), score_updater.hpp)
+        leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
+        if tree.num_leaves > 1:
+            self.train_score = self.train_score.at[cls].add(
+                leaf_vals[state.row_leaf])
+        else:
+            self.train_score = self.train_score.at[cls].add(tree.leaf_value[0])
+        for i, valid in enumerate(self.valid_sets):
+            self.valid_scores[i] = self._add_tree_to_score(
+                self.valid_scores[i], cls, tree, valid.device_bins, state)
+
+    def _add_tree_to_score(self, score, cls, tree: Tree, bins, state=None):
+        if tree.num_leaves <= 1:
+            return score.at[cls].add(float(tree.leaf_value[0]))
+        ds = self.train_data
+        if state is not None:
+            sf = state.split_feature
+            tb = state.threshold_bin
+            dl = state.default_left
+            lc = state.left_child
+            rc = state.right_child
+            n_leaves = state.n_leaves
+        else:
+            ni = tree.num_leaves - 1
+            pad = self._L - 1
+            sf = jnp.asarray(_padded(self._inner_features(tree), pad), jnp.int32)
+            tb = jnp.asarray(_padded(tree.threshold_in_bin[:ni], pad), jnp.int32)
+            dl = jnp.asarray(_padded((tree.decision_type[:ni] & 2) != 0, pad), bool)
+            lc = jnp.asarray(_padded(tree.left_child[:ni], pad), jnp.int32)
+            rc = jnp.asarray(_padded(tree.right_child[:ni], pad), jnp.int32)
+            n_leaves = jnp.int32(tree.num_leaves)
+        leaf_idx = traverse_binned(sf, tb, dl, lc, rc, n_leaves, bins,
+                                   ds.num_bins_per_feature,
+                                   ds.has_missing_per_feature,
+                                   max_steps=self._L)
+        leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
+        return score.at[cls].add(leaf_vals[leaf_idx])
+
+    def _inner_features(self, tree: Tree):
+        inv = {real: inner for inner, real in
+               enumerate(self.train_data.real_feature_index)}
+        ni = tree.num_leaves - 1
+        return np.asarray([inv[f] for f in tree.split_feature[:ni]], np.int32)
+
+    # ------------------------------------------------------------------
+    def eval(self) -> Dict[str, List[tuple]]:
+        """Evaluate all metrics on train (if requested) + valid sets
+        (reference GBDT::EvalAndCheckEarlyStopping, gbdt.cpp:472)."""
+        out = {}
+        cfg = self.config
+        obj = self.objective
+        if cfg.is_provide_training_metric and self.train_metrics:
+            out["training"] = self._eval_one(
+                self.train_score, self.train_data.metadata, self.train_metrics)
+        for i, (valid, name) in enumerate(zip(self.valid_sets, self.valid_names)):
+            out[name] = self._eval_one(self.valid_scores[i], valid.metadata,
+                                       self.train_metrics)
+        return out
+
+    def _eval_one(self, score, metadata, metrics):
+        results = []
+        raw = score[0] if self.num_class == 1 else score
+        qb = metadata.query_boundaries
+        for m in metrics:
+            results.extend(m.eval(raw, metadata.label, metadata.weight,
+                                  self.objective, qb))
+        return results
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self):
+        """reference GBDT::RollbackOneIter (gbdt.cpp:454)."""
+        if self.iter_ <= 0:
+            return
+        for cls in reversed(range(self.num_class)):
+            tree = self.models.pop()
+            # subtract the tree's contribution (incl. any folded-in init
+            # bias) from all scores
+            t2 = _negated(tree)
+            for arr_i in range(len(self.valid_scores)):
+                self.valid_scores[arr_i] = self._add_tree_to_score(
+                    self.valid_scores[arr_i], cls, t2,
+                    self.valid_sets[arr_i].device_bins)
+            self.train_score = self._add_tree_to_score(
+                self.train_score, cls, t2, self.train_data.device_bins)
+        self.iter_ -= 1
+        if self.iter_ == 0:
+            # the rolled-back trees carried the boost-from-average bias; let
+            # the next iteration re-apply it (reference RollbackOneIter
+            # leaves models_ empty so BoostFromAverage fires again)
+            self._boosted_from_average = [False] * self.num_class
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw scores for new data: [N] or [N, K] (reference GBDT::PredictRaw).
+
+        Input rows are binned with the training mappers and traversed in bin
+        space, which makes predict() bit-identical to the incremental
+        train/valid score updaters (the reference achieves the same
+        consistency through double-precision thresholds, which TPUs lack).
+        """
+        k = self.num_class
+        end = self.iter_ if num_iteration < 0 else min(
+            start_iteration + num_iteration, self.iter_)
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        n = X.shape[0]
+        if end <= start_iteration or not self.models:
+            return np.zeros((n, k) if k > 1 else n)
+        trees = self.models[start_iteration * k: end * k]
+        bins = jnp.asarray(self.train_data.bin_external(X))
+        score = jnp.zeros((k, n), jnp.float32)
+        for i, tree in enumerate(trees):
+            score = self._add_tree_to_score(score, i % k, tree, bins)
+        out = np.asarray(score, np.float64)
+        return out[0] if k == 1 else out.T
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if raw_score:
+            return raw
+        obj = self.objective
+        if self.num_class > 1:
+            return np.asarray(obj.convert_output(jnp.asarray(raw.T))).T
+        return np.asarray(obj.convert_output(jnp.asarray(raw)))
+
+    def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        from ..ops.predict import stack_trees, predict_leaf_indices
+        k = self.num_class
+        end = self.iter_ if num_iteration < 0 else min(
+            start_iteration + num_iteration, self.iter_)
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        trees = self.models[start_iteration * k: end * k]
+        if not trees:
+            return np.zeros((X.shape[0], 0), np.int32)
+        stacked = stack_trees(trees)
+        leaves = predict_leaf_indices(stacked, jnp.asarray(X))
+        return np.asarray(leaves).T  # [N, T]
+
+    # -- model serialization (reference gbdt_model_text.cpp) --------------
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1) -> str:
+        ds = self.train_data
+        k = self.num_class
+        end = self.iter_ if num_iteration < 0 else min(
+            start_iteration + num_iteration, self.iter_)
+        lines = ["tree", "version=v3",
+                 f"num_class={k}",
+                 f"num_tree_per_iteration={k}",
+                 f"label_index=0",
+                 f"max_feature_idx={ds.num_total_features - 1}",
+                 f"objective={self.objective.to_string()}",
+                 "feature_names=" + " ".join(ds.feature_names),
+                 "feature_infos=" + " ".join(["none"] * ds.num_total_features)]
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("")
+        trees = self.models[start_iteration * k: end * k]
+        for i, tree in enumerate(trees):
+            lines.append(tree.to_string(i))
+        lines.append("end of trees")
+        lines.append("")
+        return "\n".join(lines)
+
+    def save_model(self, filename: str, start_iteration: int = 0,
+                   num_iteration: int = -1) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.save_model_to_string(start_iteration, num_iteration))
+
+    def restore_snapshot(self, trees: List[Tree]):
+        self.models = list(trees)
+        self.iter_ = len(trees) // self.num_class
+
+
+def _padded(arr, size):
+    arr = np.asarray(arr)
+    out = np.zeros((size,), arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+def _negated(tree: Tree) -> Tree:
+    import copy
+    t2 = copy.copy(tree)
+    t2.leaf_value = -tree.leaf_value
+    return t2

@@ -1,0 +1,56 @@
+"""GOSS: Gradient-based One-Side Sampling.
+
+Reference: src/boosting/goss.hpp:103-156 — keep the top ``top_rate`` fraction
+of rows by sum over classes of |grad*hess|, sample ``other_rate`` of the rest
+uniformly and scale their grad/hess by (1-top_rate)/other_rate; no sampling
+for the first 1/learning_rate iterations (goss.hpp:156).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .gbdt import GBDT
+from ..log import log_info
+
+
+class GOSS(GBDT):
+    def __init__(self, config, train_data, objective):
+        if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
+            raise ValueError("cannot use bagging in GOSS")
+        if config.top_rate + config.other_rate > 1.0:
+            raise ValueError("top_rate + other_rate must be <= 1.0 in GOSS")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            raise ValueError("top_rate and other_rate must be > 0 in GOSS")
+        super().__init__(config, train_data, objective)
+        log_info("Using GOSS")
+        self._goss_rng = np.random.RandomState(config.bagging_seed)
+
+    def _adjust_gradients(self, grad, hess):
+        cfg = self.config
+        n = self.train_data.num_data
+        # no sampling for early iterations (reference goss.hpp:156)
+        if self.iter_ < int(1.0 / cfg.learning_rate):
+            return grad, hess, jnp.ones((n,), jnp.float32)
+
+        g_abs = np.asarray(jnp.sum(jnp.abs(grad * hess), axis=0))
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        # threshold = top_k-th largest |g*h|
+        threshold = np.partition(g_abs, n - top_k)[n - top_k]
+        is_top = g_abs >= threshold
+        rest_idx = np.nonzero(~is_top)[0]
+        multiply = (n - top_k) / other_k
+        mask = np.zeros(n, np.float32)
+        mask[is_top] = 1.0
+        if len(rest_idx) > 0:
+            sampled = self._goss_rng.choice(
+                rest_idx, size=min(other_k, len(rest_idx)), replace=False)
+            mask[sampled] = 1.0
+            scale = np.ones(n, np.float32)
+            scale[sampled] = multiply
+            scale_j = jnp.asarray(scale)[None, :]
+            grad = grad * scale_j
+            hess = hess * scale_j
+        return grad, hess, jnp.asarray(mask)

@@ -1,0 +1,88 @@
+"""RF: random-forest mode.
+
+Reference: src/boosting/rf.hpp:25-217 — no shrinkage, bagging required,
+gradients recomputed from the CONSTANT boost-from-average score each
+iteration (not the running ensemble score), output is the AVERAGE of trees
+(``average_output_``).  Running scores are maintained as averages so metrics
+and early stopping see comparable numbers at every iteration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    def __init__(self, config, train_data, objective):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            raise ValueError(
+                "random forest requires bagging_freq > 0 and "
+                "0 < bagging_fraction < 1")
+        super().__init__(config, train_data, objective)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        # constant per-class init scores (reference RF::Boosting:
+        # BoostFromAverage(cls, update_scorer=False))
+        self._rf_init = np.zeros(self.num_class)
+        if config.boost_from_average:
+            for cls in range(self.num_class):
+                self._rf_init[cls] = objective.boost_from_score(
+                    train_data.label, train_data.weight, cls)
+        self._const_grad = None
+
+    def _constant_gradients(self):
+        if self._const_grad is None:
+            n = self.train_data.num_data
+            score = jnp.asarray(
+                np.tile(self._rf_init[:, None], (1, n)).astype(np.float32))
+            label = self.train_data.label
+            weight = self.train_data.weight
+            if self.num_class == 1:
+                g, h = self.objective.get_gradients(score[0], label, weight)
+                self._const_grad = (g[None, :], h[None, :])
+            else:
+                self._const_grad = self.objective.get_gradients(
+                    score, label, weight)
+        return self._const_grad
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is not None:
+            raise ValueError("RF mode does not support custom objective "
+                             "functions, please use built-in objectives")
+        grad, hess = self._constant_gradients()
+        mask = self._bagging_mask(self.iter_)
+        init_scores = [float(v) for v in self._rf_init]
+        # scores currently hold the average of iter_ trees; expand to a sum,
+        # add the new tree, then contract back to an average (mirrors the
+        # reference's MultiplyScore bracketing in RF::TrainOneIter)
+        it = self.iter_
+        if it > 0:
+            self.train_score = self.train_score * float(it)
+            for i in range(len(self.valid_scores)):
+                self.valid_scores[i] = self.valid_scores[i] * float(it)
+        stop = self._grow_and_apply(grad, hess, mask, init_scores)
+        denom = float(it + 1)
+        self.train_score = self.train_score / denom
+        for i in range(len(self.valid_scores)):
+            self.valid_scores[i] = self.valid_scores[i] / denom
+        self.iter_ += 1
+        return stop
+
+    def _boost_from_average(self, cls):  # handled via _rf_init
+        return 0.0
+
+    bias_before_score_update = True
+
+    def _renew_score(self, cls):
+        return np.full(self.train_data.num_data, self._rf_init[cls],
+                       np.float64)
+
+    def predict_raw(self, X, start_iteration=0, num_iteration=-1):
+        out = super().predict_raw(X, start_iteration, num_iteration)
+        end = self.iter_ if num_iteration < 0 else min(
+            start_iteration + num_iteration, self.iter_)
+        n_iters = max(end - start_iteration, 1)
+        return out / n_iters

@@ -1,0 +1,156 @@
+"""Training callbacks (reference python-package/lightgbm/callback.py)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List
+
+from .log import log_info, log_warning
+
+__all__ = ["EarlyStopException", "CallbackEnv", "print_evaluation",
+           "log_evaluation", "record_evaluation", "reset_parameter",
+           "early_stopping"]
+
+
+class EarlyStopException(Exception):
+    """reference callback.py EarlyStopException."""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _fmt(res) -> str:
+    data_name, eval_name, value, _ = res[:4]
+    return f"{data_name}'s {eval_name}: {value:g}"
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """reference print_evaluation/log_evaluation (callback.py:52)."""
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(_fmt(x) for x in env.evaluation_result_list)
+            log_info(f"[{env.iteration + 1}]\t{result}")
+    _callback.order = 10
+    return _callback
+
+
+print_evaluation = log_evaluation
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    """reference record_evaluation (callback.py:75)."""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dict")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for item in env.evaluation_result_list:
+            data_name, eval_name = item[0], item[1]
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for item in env.evaluation_result_list:
+            data_name, eval_name, value = item[0], item[1], item[2]
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+            eval_result[data_name][eval_name].append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """reference reset_parameter (callback.py:106): per-iteration learning
+    rate (or other param) schedules; value is a list or a fn(iter)->value."""
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"length of list {key!r} has to be {env.end_iteration - env.begin_iteration}")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+        if new_params:
+            if "learning_rate" in new_params:
+                env.model._gbdt.shrinkage_rate = new_params["learning_rate"]
+            env.params.update(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta=0.0) -> Callable:
+    """reference early_stopping (callback.py:146)."""
+    best_score: List = []
+    best_iter: List = []
+    best_score_list: List = []
+    cmp_op: List = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            log_warning("early stopping is only effective with at least one "
+                        "validation set")
+            return
+        if verbose:
+            log_info(f"Training until validation scores don't improve for "
+                     f"{stopping_rounds} rounds")
+        first_metric[0] = env.evaluation_result_list[0][1]
+        deltas = (min_delta if isinstance(min_delta, list)
+                  else [min_delta] * len(env.evaluation_result_list))
+        for (_, _, _, higher_better), delta in zip(
+                [r[:4] for r in env.evaluation_result_list], deltas):
+            best_iter.append(0)
+            best_score_list.append(None)
+            if higher_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y, d=delta: x > y + d)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y, d=delta: x < y - d)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, item in enumerate(env.evaluation_result_list):
+            data_name, eval_name, score = item[0], item[1], item[2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if first_metric_only and first_metric[0] != eval_name:
+                continue
+            if data_name == "training":
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    log_info("Early stopping, best iteration is:\n"
+                             f"[{best_iter[i] + 1}]\t" + "\t".join(
+                                 _fmt(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    log_info("Did not meet early stopping. Best iteration is:"
+                             f"\n[{best_iter[i] + 1}]\t" + "\t".join(
+                                 _fmt(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,378 @@
+"""Declarative configuration system.
+
+The reference keeps a single ``struct Config`` whose doc-comments are the source
+of truth, with a generator producing the string->member parser and a ~100-entry
+alias table (reference: include/LightGBM/config.h:34, src/io/config_auto.cpp,
+helpers/parameter_generator.py).  Here the declarative table *is* the code: one
+``_PARAMS`` list drives defaults, parsing, aliases, validation and docs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Config", "ParamSpec", "param_docs", "resolve_aliases"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    name: str
+    typ: type
+    default: Any
+    aliases: Tuple[str, ...] = ()
+    check: Optional[str] = None  # e.g. ">=0", ">0", "in:a|b|c"
+    desc: str = ""
+
+
+def _p(name, typ, default, aliases=(), check=None, desc=""):
+    return ParamSpec(name, typ, default, tuple(aliases), check, desc)
+
+
+# Mirrors the sections of reference config.h (Core :86, Learning Control :232,
+# IO :572, Predict :724, Objective :815, Metric :897, Network :971, Device :1002).
+_PARAMS: List[ParamSpec] = [
+    # ---- Core ----
+    _p("config", str, "", ("config_file",), desc="path to a config file (CLI)"),
+    _p("task", str, "train", ("task_type",),
+       check="in:train|predict|convert_model|refit|save_binary"),
+    _p("objective", str, "regression",
+       ("objective_type", "app", "application", "loss"),
+       desc="objective name, see objectives.py"),
+    _p("boosting", str, "gbdt", ("boosting_type", "boost"),
+       check="in:gbdt|dart|goss|rf|random_forest"),
+    _p("data", str, "", ("train", "train_data", "train_data_file", "data_filename")),
+    _p("valid", str, "", ("test", "valid_data", "valid_data_file", "test_data",
+                          "test_data_file", "valid_filenames")),
+    _p("num_iterations", int, 100,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "num_boost_round", "n_estimators", "nrounds"), ">=0"),
+    _p("learning_rate", float, 0.1, ("shrinkage_rate", "eta"), ">0"),
+    _p("num_leaves", int, 31, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"), ">1"),
+    _p("tree_learner", str, "serial",
+       ("tree", "tree_type", "tree_learner_type"),
+       check="in:serial|feature|data|voting"),
+    _p("num_threads", int, 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    _p("device_type", str, "tpu", ("device",), check="in:cpu|gpu|cuda|tpu"),
+    _p("seed", int, 0, ("random_seed", "random_state")),
+    _p("deterministic", bool, False),
+    # ---- Learning control ----
+    _p("force_col_wise", bool, False),
+    _p("force_row_wise", bool, False),
+    _p("histogram_pool_size", float, -1.0, ("hist_pool_size",)),
+    _p("max_depth", int, -1),
+    _p("min_data_in_leaf", int, 20,
+       ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"), ">=0"),
+    _p("min_sum_hessian_in_leaf", float, 1e-3,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight"), ">=0"),
+    _p("bagging_fraction", float, 1.0,
+       ("sub_row", "subsample", "bagging"), ">0"),
+    _p("pos_bagging_fraction", float, 1.0, ("pos_sub_row", "pos_subsample", "pos_bagging"), ">0"),
+    _p("neg_bagging_fraction", float, 1.0, ("neg_sub_row", "neg_subsample", "neg_bagging"), ">0"),
+    _p("bagging_freq", int, 0, ("subsample_freq",)),
+    _p("bagging_seed", int, 3, ("bagging_fraction_seed",)),
+    _p("feature_fraction", float, 1.0, ("sub_feature", "colsample_bytree"), ">0"),
+    _p("feature_fraction_bynode", float, 1.0,
+       ("sub_feature_bynode", "colsample_bynode"), ">0"),
+    _p("feature_fraction_seed", int, 2),
+    _p("extra_trees", bool, False, ("extra_tree",)),
+    _p("extra_seed", int, 6),
+    _p("early_stopping_round", int, 0,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    _p("first_metric_only", bool, False),
+    _p("max_delta_step", float, 0.0, ("max_tree_output", "max_leaf_output")),
+    _p("lambda_l1", float, 0.0, ("reg_alpha", "l1_regularization"), ">=0"),
+    _p("lambda_l2", float, 0.0, ("reg_lambda", "lambda", "l2_regularization"), ">=0"),
+    _p("linear_lambda", float, 0.0, (), ">=0"),
+    _p("min_gain_to_split", float, 0.0, ("min_split_gain",), ">=0"),
+    _p("drop_rate", float, 0.1, ("rate_drop",)),
+    _p("max_drop", int, 50),
+    _p("skip_drop", float, 0.5),
+    _p("xgboost_dart_mode", bool, False),
+    _p("uniform_drop", bool, False),
+    _p("drop_seed", int, 4),
+    _p("top_rate", float, 0.2, (), ">=0"),
+    _p("other_rate", float, 0.1, (), ">=0"),
+    _p("min_data_per_group", int, 100, (), ">0"),
+    _p("max_cat_threshold", int, 32, (), ">0"),
+    _p("cat_l2", float, 10.0, (), ">=0"),
+    _p("cat_smooth", float, 10.0, (), ">=0"),
+    _p("max_cat_to_onehot", int, 4, (), ">0"),
+    _p("top_k", int, 20, ("topk",), ">0"),
+    _p("monotone_constraints", list, None, ("mc", "monotone_constraint")),
+    _p("monotone_constraints_method", str, "basic",
+       ("monotone_constraining_method", "mc_method"),
+       check="in:basic|intermediate|advanced"),
+    _p("monotone_penalty", float, 0.0, ("monotone_splits_penalty", "ms_penalty", "mc_penalty"), ">=0"),
+    _p("feature_contri", list, None, ("feature_contrib", "fc", "fp", "feature_penalty")),
+    _p("forcedsplits_filename", str, "", ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    _p("refit_decay_rate", float, 0.9),
+    _p("cegb_tradeoff", float, 1.0, (), ">=0"),
+    _p("cegb_penalty_split", float, 0.0, (), ">=0"),
+    _p("cegb_penalty_feature_lazy", list, None),
+    _p("cegb_penalty_feature_coupled", list, None),
+    _p("path_smooth", float, 0.0, (), ">=0"),
+    _p("interaction_constraints", str, ""),
+    _p("verbosity", int, 1, ("verbose",)),
+    _p("input_model", str, "", ("model_input", "model_in")),
+    _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
+    _p("saved_feature_importance_type", int, 0),
+    _p("snapshot_freq", int, -1, ("save_period",)),
+    _p("linear_tree", bool, False, ("linear_trees",)),
+    # ---- IO / Dataset ----
+    _p("max_bin", int, 255, ("max_bins",), ">1"),
+    _p("max_bin_by_feature", list, None),
+    _p("min_data_in_bin", int, 3, (), ">0"),
+    _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",), ">0"),
+    _p("data_random_seed", int, 1, ("data_seed",)),
+    _p("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse")),
+    _p("enable_bundle", bool, True, ("is_enable_bundle", "bundle")),
+    _p("use_missing", bool, True),
+    _p("zero_as_missing", bool, False),
+    _p("feature_pre_filter", bool, True),
+    _p("pre_partition", bool, False, ("is_pre_partition",)),
+    _p("two_round", bool, False, ("two_round_loading", "use_two_round_loading")),
+    _p("header", bool, False, ("has_header",)),
+    _p("label_column", str, "", ("label",)),
+    _p("weight_column", str, "", ("weight",)),
+    _p("group_column", str, "", ("group", "group_id", "query_column", "query", "query_id")),
+    _p("ignore_column", str, "", ("ignore_feature", "blacklist")),
+    _p("categorical_feature", str, "", ("cat_feature", "categorical_column", "cat_column")),
+    _p("forcedbins_filename", str, ""),
+    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file")),
+    _p("precise_float_parser", bool, False),
+    # ---- Predict ----
+    _p("start_iteration_predict", int, 0),
+    _p("num_iteration_predict", int, -1),
+    _p("predict_raw_score", bool, False, ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    _p("predict_leaf_index", bool, False, ("is_predict_leaf_index", "leaf_index")),
+    _p("predict_contrib", bool, False, ("is_predict_contrib", "contrib")),
+    _p("predict_disable_shape_check", bool, False),
+    _p("pred_early_stop", bool, False),
+    _p("pred_early_stop_freq", int, 10),
+    _p("pred_early_stop_margin", float, 10.0),
+    _p("output_result", str, "LightGBM_predict_result.txt",
+       ("predict_result", "prediction_result", "predict_name", "pred_name", "name_pred")),
+    # ---- Objective ----
+    _p("num_class", int, 1, ("num_classes",), ">0"),
+    _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),
+    _p("scale_pos_weight", float, 1.0, (), ">0"),
+    _p("sigmoid", float, 1.0, (), ">0"),
+    _p("boost_from_average", bool, True),
+    _p("reg_sqrt", bool, False),
+    _p("alpha", float, 0.9, (), ">0"),
+    _p("fair_c", float, 1.0, (), ">0"),
+    _p("poisson_max_delta_step", float, 0.7, (), ">0"),
+    _p("tweedie_variance_power", float, 1.5),
+    _p("lambdarank_truncation_level", int, 30, (), ">0"),
+    _p("lambdarank_norm", bool, True),
+    _p("label_gain", list, None),
+    _p("objective_seed", int, 5),
+    # ---- Metric ----
+    _p("metric", list, None, ("metrics", "metric_types")),
+    _p("metric_freq", int, 1, ("output_freq",), ">0"),
+    _p("is_provide_training_metric", bool, False,
+       ("training_metric", "is_training_metric", "train_metric")),
+    _p("eval_at", list, None, ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    _p("multi_error_top_k", int, 1, (), ">0"),
+    _p("auc_mu_weights", list, None),
+    # ---- Network (reference config.h:971; here = jax.distributed / mesh shape) ----
+    _p("num_machines", int, 1, ("num_machine",), ">0"),
+    _p("local_listen_port", int, 12400, ("local_port", "port"), ">0"),
+    _p("time_out", int, 120, (), ">0"),
+    _p("machine_list_filename", str, "", ("machine_list_file", "machine_list", "mlist")),
+    _p("machines", str, "", ("workers", "nodes")),
+    # ---- Device (reference GPU section -> TPU mesh controls) ----
+    _p("gpu_platform_id", int, -1),
+    _p("gpu_device_id", int, -1),
+    _p("gpu_use_dp", bool, False),
+    _p("num_gpu", int, 1, (), ">0"),
+    _p("num_tpu_devices", int, 0, ("num_devices",),
+       desc="devices in the mesh; 0 = all visible"),
+    _p("tpu_precision", str, "float32", (), "in:float32|bfloat16",
+       "histogram accumulation dtype on device"),
+    _p("histogram_impl", str, "auto", (),
+       "in:auto|onehot|segment|pallas",
+       "histogram kernel implementation override"),
+]
+
+_SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}
+_ALIAS_TABLE: Dict[str, str] = {}
+for _spec in _PARAMS:
+    for _a in _spec.aliases:
+        _ALIAS_TABLE[_a] = _spec.name
+
+
+def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Map aliased keys to canonical names (reference KeyAliasTransform,
+    src/application/application.cpp:52-85). First-seen canonical key wins."""
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        canon = _ALIAS_TABLE.get(k, k)
+        if canon not in out:
+            out[canon] = v
+    return out
+
+
+def _coerce(spec: ParamSpec, value: Any) -> Any:
+    if value is None:
+        return None
+    if spec.typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "+", "t")
+        return bool(value)
+    if spec.typ is int:
+        return int(value)
+    if spec.typ is float:
+        return float(value)
+    if spec.typ is list:
+        if isinstance(value, str):
+            if not value:
+                return None
+            return [_num(tok) for tok in value.replace(";", ",").split(",")]
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        return [value]
+    return str(value)
+
+
+def _num(tok: str) -> Any:
+    tok = tok.strip()
+    try:
+        return int(tok)
+    except ValueError:
+        try:
+            return float(tok)
+        except ValueError:
+            return tok
+
+
+def _check(spec: ParamSpec, value: Any) -> None:
+    c = spec.check
+    if c is None or value is None:
+        return
+    if c.startswith("in:"):
+        allowed = c[3:].split("|")
+        if str(value) not in allowed:
+            raise ValueError(
+                f"config parameter {spec.name}={value!r} must be one of {allowed}")
+    elif c == ">0":
+        if not value > 0:
+            raise ValueError(f"config parameter {spec.name}={value} must be > 0")
+    elif c == ">=0":
+        if not value >= 0:
+            raise ValueError(f"config parameter {spec.name}={value} must be >= 0")
+    elif c == ">1":
+        if not value > 1:
+            raise ValueError(f"config parameter {spec.name}={value} must be > 1")
+
+
+_OBJECTIVE_ALIASES = {
+    "regression_l2": "regression", "l2": "regression", "mean_squared_error": "regression",
+    "mse": "regression", "l2_root": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "l1": "regression_l1", "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg", "xendcg": "rank_xendcg",
+    "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "softmax": "multiclass",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+}
+
+
+class Config:
+    """Parsed + validated configuration; every layer reads from this object."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs):
+        merged = dict(params or {})
+        merged.update(kwargs)
+        merged = resolve_aliases(merged)
+        self._raw = merged
+        self._extra: Dict[str, Any] = {}
+        for spec in _PARAMS:
+            setattr(self, spec.name, spec.default)
+        for key, value in merged.items():
+            spec = _SPEC_BY_NAME.get(key)
+            if spec is None:
+                self._extra[key] = value
+                continue
+            coerced = _coerce(spec, value)
+            _check(spec, coerced)
+            setattr(self, key, coerced)
+        self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
+        if self.boosting == "random_forest":
+            self.boosting = "rf"
+        self._post_validate()
+
+    def _post_validate(self) -> None:
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            raise ValueError("num_class must be >1 for multiclass objectives")
+        if self.objective not in ("multiclass", "multiclassova") and self.num_class != 1:
+            raise ValueError("num_class must be 1 for non-multiclass objectives")
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and
+                    (self.bagging_fraction < 1.0 or
+                     self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0)):
+                raise ValueError(
+                    "random forest requires bagging "
+                    "(bagging_freq>0 and bagging_fraction<1)")
+        if self.eval_at is None:
+            self.eval_at = [1, 2, 3, 4, 5]
+        if self.label_gain is None:
+            self.label_gain = [float((1 << min(i, 30)) - 1) for i in range(31)]
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            raise ValueError("cannot set both is_unbalance and scale_pos_weight")
+
+    # -- helpers ----------------------------------------------------------
+    @property
+    def extra_params(self) -> Dict[str, Any]:
+        return dict(self._extra)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {p.name: getattr(self, p.name) for p in _PARAMS}
+
+    def copy(self, **overrides) -> "Config":
+        d = self.to_dict()
+        d.update(overrides)
+        return Config(d)
+
+    @staticmethod
+    def kv2map(args: List[str]) -> Dict[str, str]:
+        """Parse ``key=value`` CLI tokens (reference Config::KV2Map)."""
+        out: Dict[str, str] = {}
+        for arg in args:
+            arg = arg.strip()
+            if not arg or arg.startswith("#"):
+                continue
+            if "=" in arg:
+                k, v = arg.split("=", 1)
+                out[k.strip()] = v.split("#", 1)[0].strip()
+        return out
+
+    @staticmethod
+    def from_file(path: str, overrides: Optional[Dict[str, str]] = None) -> "Config":
+        """Read a LightGBM-style ``key=value`` conf file; CLI overrides win
+        (reference Application::LoadParameters)."""
+        kv: Dict[str, str] = {}
+        with open(path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if "=" in line:
+                    k, v = line.split("=", 1)
+                    kv[k.strip()] = v.strip()
+        if overrides:
+            kv.update(overrides)
+        return Config(kv)
+
+
+def param_docs() -> str:
+    """Render parameter documentation (reference generates Parameters.rst)."""
+    lines = ["Parameters", "=========", ""]
+    for spec in _PARAMS:
+        alias = f" (aliases: {', '.join(spec.aliases)})" if spec.aliases else ""
+        lines.append(f"- ``{spec.name}`` : {spec.typ.__name__}, "
+                     f"default ``{spec.default!r}``{alias}. {spec.desc}")
+    return "\n".join(lines)

@@ -1,0 +1,13 @@
+"""SHAP feature contributions (reference Tree::PredictContrib, tree.h:139,
+recursive TreeSHAP in tree.cpp).  Full implementation lands with the M5
+feature set; until then fail loudly rather than silently."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def predict_contrib(trees, X: np.ndarray, num_class: int) -> np.ndarray:
+    raise NotImplementedError(
+        "predict(pred_contrib=True) (SHAP values) is not implemented yet "
+        "in lightgbm_tpu; planned for the constraints/extras milestone")

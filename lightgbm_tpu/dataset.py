@@ -1,0 +1,164 @@
+"""Binned training dataset resident in device HBM.
+
+TPU-native equivalent of the reference Dataset/FeatureGroup/Metadata stack
+(include/LightGBM/dataset.h:285, feature_group.h:25, src/io/dataset.cpp).
+Storage deviates deliberately: a single dense packed bin matrix
+``uint8/int32[rows, features]`` sharded over the row axis (SURVEY §7 /
+BASELINE.json north star) instead of column-group Dense/SparseBin objects —
+the MXU histogram formulation wants exactly this layout.  Trivial features are
+filtered (reference feature_pre_filter) and sparse features are handled via
+EFB bundling (efb.py) rather than sparse storage.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .binning import BinMapper, BinType, find_bin_mappers
+from .config import Config
+
+__all__ = ["Metadata", "TrainDataset", "ValidDataset"]
+
+
+class Metadata:
+    """label / weight / query-boundary / init-score arrays
+    (reference Metadata, dataset.h:41-249)."""
+
+    def __init__(self, label: np.ndarray,
+                 weight: Optional[np.ndarray] = None,
+                 group: Optional[np.ndarray] = None,
+                 init_score: Optional[np.ndarray] = None):
+        self.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        self.num_data = len(self.label)
+        self.weight = (np.asarray(weight, dtype=np.float32).reshape(-1)
+                       if weight is not None else None)
+        self.init_score = (np.asarray(init_score, dtype=np.float64)
+                           if init_score is not None else None)
+        if group is not None:
+            group = np.asarray(group, dtype=np.int64).reshape(-1)
+            # group sizes -> query boundaries (reference Metadata::SetQuery)
+            self.query_boundaries = np.concatenate([[0], np.cumsum(group)])
+            if self.query_boundaries[-1] != self.num_data:
+                raise ValueError(
+                    f"sum of group sizes ({self.query_boundaries[-1]}) "
+                    f"!= num_data ({self.num_data})")
+            qid = np.zeros(self.num_data, dtype=np.int32)
+            qid[self.query_boundaries[1:-1]] = 1
+            self.query_ids = np.cumsum(qid).astype(np.int32)
+            self.num_queries = len(self.query_boundaries) - 1
+        else:
+            self.query_boundaries = None
+            self.query_ids = None
+            self.num_queries = 0
+
+
+class TrainDataset:
+    """Binned dataset + feature metadata, ready for the device grower."""
+
+    def __init__(self, data: np.ndarray, metadata: Metadata, config: Config,
+                 categorical_features: Optional[Sequence[int]] = None,
+                 bin_mappers: Optional[List[BinMapper]] = None,
+                 sample_cnt: Optional[int] = None):
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        self.num_total_features = data.shape[1]
+        self.metadata = metadata
+        self.config = config
+        n = data.shape[0]
+        if metadata.num_data != n:
+            raise ValueError(f"label length {metadata.num_data} != rows {n}")
+
+        cats = sorted(set(categorical_features or ()))
+        if bin_mappers is None:
+            sample_n = min(n, sample_cnt or config.bin_construct_sample_cnt)
+            if sample_n < n:
+                rng = np.random.RandomState(config.data_random_seed)
+                idx = rng.choice(n, size=sample_n, replace=False)
+                sample = data[np.sort(idx)]
+            else:
+                sample = data
+            min_split = (config.min_data_in_leaf
+                         if config.feature_pre_filter else 0)
+            bin_mappers = find_bin_mappers(
+                sample, max_bin=config.max_bin,
+                min_data_in_bin=config.min_data_in_bin,
+                categorical_features=cats,
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing,
+                min_split_data=min_split,
+                max_bin_by_feature=config.max_bin_by_feature,
+                feature_pre_filter=config.feature_pre_filter)
+        self.all_bin_mappers = bin_mappers
+
+        # filter trivial features (reference used_feature map, dataset.cpp)
+        self.real_feature_index = [i for i, m in enumerate(bin_mappers)
+                                   if not m.is_trivial]
+        self.feature_mappers = [bin_mappers[i] for i in self.real_feature_index]
+        self.num_features = len(self.real_feature_index)
+        if self.num_features == 0:
+            raise ValueError("no usable (non-trivial) features in data")
+        self.num_data = n
+
+        nbins = np.asarray([m.num_bin for m in self.feature_mappers], np.int32)
+        self.max_num_bins = int(nbins.max())
+        bins = np.empty((n, self.num_features),
+                        np.uint8 if self.max_num_bins <= 256 else np.int32)
+        for j, (real, mapper) in enumerate(
+                zip(self.real_feature_index, self.feature_mappers)):
+            bins[:, j] = mapper.value_to_bin(data[:, real])
+        self.bins = bins
+
+        self.num_bins_per_feature = jnp.asarray(nbins)
+        self.has_missing_per_feature = jnp.asarray(
+            np.asarray([m.missing_bin is not None for m in self.feature_mappers]))
+        self.device_bins = jnp.asarray(bins)
+        self.is_categorical = np.asarray(
+            [m.bin_type == BinType.CATEGORICAL for m in self.feature_mappers])
+
+        self.label = jnp.asarray(metadata.label)
+        self.weight = (jnp.asarray(metadata.weight)
+                       if metadata.weight is not None else None)
+        self.query_ids = (jnp.asarray(metadata.query_ids)
+                          if metadata.query_ids is not None else None)
+
+    # ------------------------------------------------------------------
+    def bin_external(self, data: np.ndarray) -> np.ndarray:
+        """Bin new rows with this dataset's mappers (reference
+        LoadFromFileAlignWithOtherDataset / _init_from_ref_dataset)."""
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim != 2 or data.shape[1] != self.num_total_features:
+            raise ValueError(
+                f"input has {data.shape[1] if data.ndim == 2 else 'wrong'} "
+                f"features, but the model expects {self.num_total_features} "
+                "(reference: LGBM_BoosterPredictForMat shape check)")
+        out = np.empty((data.shape[0], self.num_features), self.bins.dtype)
+        for j, real in enumerate(self.real_feature_index):
+            out[:, j] = self.feature_mappers[j].value_to_bin(data[:, real])
+        return out
+
+    def create_valid(self, data: np.ndarray, metadata: Metadata) -> "ValidDataset":
+        return ValidDataset(self, data, metadata)
+
+    @property
+    def feature_names(self) -> List[str]:
+        return [f"Column_{i}" for i in range(self.num_total_features)]
+
+
+class ValidDataset:
+    """Validation set binned with the training mappers (reference aligned
+    valid Dataset, basic.py:1232 _init_from_ref_dataset semantics)."""
+
+    def __init__(self, train: TrainDataset, data: np.ndarray, metadata: Metadata):
+        self.train = train
+        self.metadata = metadata
+        self.num_data = metadata.num_data
+        self.bins = train.bin_external(data)
+        self.device_bins = jnp.asarray(self.bins)
+        self.label = jnp.asarray(metadata.label)
+        self.weight = (jnp.asarray(metadata.weight)
+                       if metadata.weight is not None else None)
+        self.query_ids = (jnp.asarray(metadata.query_ids)
+                          if metadata.query_ids is not None else None)

@@ -1,0 +1,229 @@
+"""Training/CV entry points (reference python-package/lightgbm/engine.py)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import (CallbackEnv, EarlyStopException, early_stopping,
+                       log_evaluation, record_evaluation)
+from .config import Config, resolve_aliases
+from .log import log_info, log_warning
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List] = None,
+          evals_result: Optional[Dict] = None,
+          early_stopping_rounds: Optional[int] = None,
+          verbose_eval="warn") -> Booster:
+    """Train a model (reference engine.py:15 train())."""
+    params = resolve_aliases(dict(params))
+    if fobj is not None:
+        params["objective"] = "none"
+    nbr = params.pop("num_iterations", num_boost_round)
+    if early_stopping_rounds is None:
+        early_stopping_rounds = params.get("early_stopping_round", 0) or None
+
+    cbs = list(callbacks or [])
+    if evals_result is not None:
+        cbs.append(record_evaluation(evals_result))
+    if early_stopping_rounds:
+        cbs.append(early_stopping(early_stopping_rounds,
+                                  params.get("first_metric_only", False)))
+    if verbose_eval not in ("warn", False, None):
+        period = 1 if verbose_eval is True else int(verbose_eval)
+        cbs.append(log_evaluation(period))
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    if init_model is not None:
+        # continued training (reference engine.py init_model -> _InnerPredictor):
+        # previous model's raw predictions become the new init score
+        prev = (init_model if isinstance(init_model, Booster)
+                else Booster(model_file=init_model))
+        train_set.construct()
+        raw_data = train_set.data
+        if raw_data is None:
+            raise ValueError("continued training requires "
+                             "free_raw_data=False on train_set")
+        init_score = prev.predict(raw_data, raw_score=True)
+        train_set.set_init_score(init_score)
+        train_set._handle = None  # rebuild with init score
+
+    booster = Booster(params=params, train_set=train_set)
+    for i, vs in enumerate(valid_sets or []):
+        name = (valid_names[i] if valid_names and i < len(valid_names)
+                else f"valid_{i}")
+        if vs is train_set:
+            name = "training"
+            booster._gbdt.config = booster._gbdt.config.copy(
+                is_provide_training_metric=True)
+            booster._gbdt.config.is_provide_training_metric = True
+            booster._valid_names.append("training")
+            continue
+        booster.add_valid(vs, name)
+
+    train_in_valid = any(vs is train_set for vs in (valid_sets or []))
+
+    finished_early = False
+    for it in range(nbr):
+        env = CallbackEnv(model=booster, params=params, iteration=it,
+                          begin_iteration=0, end_iteration=nbr,
+                          evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        should_stop = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if booster._valid_names or train_in_valid:
+            if train_in_valid:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            for name in booster._valid_names:
+                if name != "training":
+                    evaluation_result_list.extend(booster._eval_set(name, feval))
+        env = env._replace(evaluation_result_list=evaluation_result_list)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for item in e.best_score:
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            finished_early = True
+            break
+        if should_stop:
+            break
+    if not finished_early and evals_result:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:283 CVBooster)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    """reference _make_n_folds (engine.py:321): stratified / group-aware."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    label = full_data.get_label()
+    group = full_data.get_group()
+    if folds is not None:
+        if hasattr(folds, "split"):
+            folds = folds.split(np.zeros(num_data), label,
+                                groups=_group_ids(group, num_data))
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    if group is not None:
+        # group-wise folds: keep queries intact
+        ngroups = len(np.asarray(group))
+        gidx = np.arange(ngroups)
+        if shuffle:
+            rng.shuffle(gidx)
+        gfolds = np.array_split(gidx, nfold)
+        boundaries = np.concatenate([[0], np.cumsum(np.asarray(group))])
+        out = []
+        for gf in gfolds:
+            test_rows = np.concatenate(
+                [np.arange(boundaries[g], boundaries[g + 1]) for g in gf]) \
+                if len(gf) else np.array([], np.int64)
+            train_rows = np.setdiff1d(np.arange(num_data), test_rows)
+            out.append((train_rows, test_rows))
+        return out
+    if stratified:
+        from sklearn.model_selection import StratifiedKFold
+        skf = StratifiedKFold(n_splits=nfold, shuffle=shuffle,
+                              random_state=seed if shuffle else None)
+        return list(skf.split(np.zeros(num_data), label))
+    idx = np.arange(num_data)
+    if shuffle:
+        rng.shuffle(idx)
+    folds_idx = np.array_split(idx, nfold)
+    return [(np.setdiff1d(idx, f), f) for f in folds_idx]
+
+
+def _group_ids(group, num_data):
+    if group is None:
+        return None
+    boundaries = np.concatenate([[0], np.cumsum(np.asarray(group))])
+    out = np.zeros(num_data, np.int64)
+    for i in range(len(boundaries) - 1):
+        out[boundaries[i]:boundaries[i + 1]] = i
+    return out
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds: Optional[int] = None, seed: int = 0,
+       callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """Cross-validation (reference engine.py:397 cv())."""
+    params = resolve_aliases(dict(params))
+    if metrics is not None:
+        params["metric"] = metrics
+    if params.get("objective") in ("binary",) or stratified is True:
+        try:
+            lab = train_set.get_label() if train_set.label is not None else None
+        except Exception:
+            lab = None
+        if params.get("objective") not in ("binary", "multiclass",
+                                           "multiclassova"):
+            stratified = False
+    train_set.free_raw_data = False
+    fold_defs = _make_n_folds(train_set, folds, nfold, params, seed,
+                              stratified, shuffle)
+    cvbooster = CVBooster()
+    fold_results: List[Dict] = []
+    for train_idx, test_idx in fold_defs:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        res: Dict = {}
+        bst = train(params, tr, num_boost_round, valid_sets=[te],
+                    valid_names=["valid"], fobj=fobj, feval=feval,
+                    early_stopping_rounds=early_stopping_rounds,
+                    evals_result=res, callbacks=list(callbacks or []),
+                    verbose_eval=False)
+        cvbooster._append(bst)
+        fold_results.append(res.get("valid", {}))
+    # aggregate
+    out: Dict[str, List[float]] = {}
+    if fold_results and fold_results[0]:
+        metrics_names = fold_results[0].keys()
+        n_iters = min(len(r[m]) for r in fold_results for m in metrics_names)
+        for m in metrics_names:
+            means, stds = [], []
+            for i in range(n_iters):
+                vals = [r[m][i] for r in fold_results]
+                means.append(float(np.mean(vals)))
+                stds.append(float(np.std(vals)))
+            out[f"{m}-mean"] = means
+            out[f"{m}-stdv"] = stds
+        cvbooster.best_iteration = n_iters
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

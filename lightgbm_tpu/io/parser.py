@@ -1,0 +1,129 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Reference: Parser::CreateParser (include/LightGBM/dataset.h:279,
+src/io/parser.cpp) — auto-detects the format from the first lines.  A C++
+fast-path parser (native/) accelerates large files; this module is the
+host-Python fallback and the auto-detection logic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["detect_format", "load_svmlight_or_csv", "LineParser"]
+
+
+def detect_format(path: str) -> str:
+    """Return 'libsvm' | 'csv' | 'tsv' (reference parser.cpp auto-detect)."""
+    with open(path) as fh:
+        for _ in range(10):
+            line = fh.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            tokens = line.split("\t") if "\t" in line else line.split(",")
+            if any(":" in t for t in tokens[1:]):
+                return "libsvm"
+            if "\t" in line:
+                return "tsv"
+            if "," in line:
+                return "csv"
+    return "tsv"
+
+
+def _has_header(path: str, sep: str) -> bool:
+    with open(path) as fh:
+        first = fh.readline().strip()
+    if not first:
+        return False
+    for tok in first.split(sep):
+        try:
+            float(tok)
+            return False
+        except ValueError:
+            continue
+    return True
+
+
+def load_svmlight_or_csv(path: str, label_idx: int = 0,
+                         header: Optional[bool] = None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a data file -> (features [N, F], label [N]).
+
+    First column (or libsvm leading token) is the label, matching the
+    reference's default label_column=0 convention.
+    """
+    fmt = detect_format(path)
+    if fmt == "libsvm":
+        return _load_libsvm(path)
+    sep = "\t" if fmt == "tsv" else ","
+    if header is None:
+        header = _has_header(path, sep)
+    try:
+        import pandas as pd
+        df = pd.read_csv(path, sep=sep, header=0 if header else None)
+        arr = df.to_numpy(dtype=np.float64)
+    except ImportError:
+        arr = np.loadtxt(path, delimiter=sep,
+                         skiprows=1 if header else 0, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    label = arr[:, label_idx].astype(np.float32)
+    feats = np.delete(arr, label_idx, axis=1)
+    return np.ascontiguousarray(feats), label
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    rows = []
+    max_feat = -1
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            toks = line.split()
+            labels.append(float(toks[0]))
+            pairs = []
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                k = int(k)
+                pairs.append((k, float(v)))
+                max_feat = max(max_feat, k)
+            rows.append(pairs)
+    n = len(rows)
+    feats = np.zeros((n, max_feat + 1), dtype=np.float64)
+    for i, pairs in enumerate(rows):
+        for k, v in pairs:
+            feats[i, k] = v
+    return feats, np.asarray(labels, dtype=np.float32)
+
+
+class LineParser:
+    """Streaming row parser for chunked loading (two_round / Sequence path;
+    reference utils/pipeline_reader.h + TextReader)."""
+
+    def __init__(self, path: str, chunk_rows: int = 65536):
+        self.path = path
+        self.fmt = detect_format(path)
+        self.chunk_rows = chunk_rows
+
+    def __iter__(self):
+        if self.fmt == "libsvm":
+            X, y = _load_libsvm(self.path)
+            for i in range(0, len(y), self.chunk_rows):
+                yield X[i:i + self.chunk_rows], y[i:i + self.chunk_rows]
+            return
+        sep = "\t" if self.fmt == "tsv" else ","
+        import pandas as pd
+        for chunk in pd.read_csv(self.path, sep=sep, header=None,
+                                 chunksize=self.chunk_rows):
+            arr = chunk.to_numpy(dtype=np.float64)
+            yield np.ascontiguousarray(arr[:, 1:]), arr[:, 0].astype(np.float32)

@@ -1,0 +1,57 @@
+"""Leveled logging with redirectable output callback.
+
+Reference: utils/log.h:71-170 (Log::Debug/Info/Warning/Fatal with thread-local
+callback redirection installed by bindings via LGBM_RegisterLogCallback).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+__all__ = ["log_debug", "log_info", "log_warning", "log_fatal",
+           "register_log_callback", "set_verbosity", "LightGBMError"]
+
+
+class LightGBMError(Exception):
+    """reference LightGBMException / LGBM_GetLastError convention."""
+
+
+_VERBOSITY = 1
+_CALLBACK: Optional[Callable[[str], None]] = None
+
+
+def set_verbosity(v: int) -> None:
+    global _VERBOSITY
+    _VERBOSITY = v
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _CALLBACK
+    _CALLBACK = cb
+
+
+def _emit(msg: str) -> None:
+    if _CALLBACK is not None:
+        _CALLBACK(msg + "\n")
+    else:
+        print(msg, file=sys.stderr)
+
+
+def log_debug(msg: str) -> None:
+    if _VERBOSITY >= 2:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def log_info(msg: str) -> None:
+    if _VERBOSITY >= 1:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def log_warning(msg: str) -> None:
+    if _VERBOSITY >= 0:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)

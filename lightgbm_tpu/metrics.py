@@ -1,0 +1,377 @@
+"""Evaluation metrics (reference src/metric/*, factory metric.cpp:18-62).
+
+Metrics take raw scores plus the ObjectiveFunction so scores are transformed
+via ``convert_output`` exactly as the reference does (metric.h Eval contract).
+Eval is off the training hot path, so metrics run host-side in numpy after a
+single device->host transfer of the converted scores.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Metric", "create_metric", "create_metrics"]
+
+
+def _as_np(x):
+    return np.asarray(x, dtype=np.float64)
+
+
+def _wavg(values, weight):
+    if weight is None:
+        return float(np.mean(values))
+    return float(np.sum(values * weight) / np.sum(weight))
+
+
+class Metric:
+    name = "metric"
+    is_higher_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        """Returns list of (name, value, is_higher_better)."""
+        raise NotImplementedError
+
+
+class _PointwiseMetric(Metric):
+    """Per-row loss averaged with weights (reference RegressionMetric shape)."""
+    transform = True
+
+    def row_loss(self, pred, label):
+        raise NotImplementedError
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        pred = raw_score
+        if self.transform and objective is not None:
+            pred = objective.convert_output(raw_score)
+        pred, label = _as_np(pred), _as_np(label)
+        w = _as_np(weight) if weight is not None else None
+        return [(self.name, _wavg(self.row_loss(pred, label), w),
+                 self.is_higher_better)]
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    def row_loss(self, p, y):
+        return (p - y) ** 2
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        [(n, v, h)] = super().eval(raw_score, label, weight, objective)
+        return [(self.name, float(np.sqrt(v)), h)]
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    def row_loss(self, p, y):
+        return np.abs(p - y)
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def row_loss(self, p, y):
+        a = self.config.alpha
+        d = y - p
+        return np.where(d >= 0, a * d, (a - 1.0) * d)
+
+
+class HuberMetric(_PointwiseMetric):
+    name = "huber"
+
+    def row_loss(self, p, y):
+        a = self.config.alpha
+        d = np.abs(p - y)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseMetric):
+    name = "fair"
+
+    def row_loss(self, p, y):
+        c = self.config.fair_c
+        x = np.abs(p - y)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    def row_loss(self, p, y):
+        eps = 1e-10
+        return p - y * np.log(np.maximum(p, eps))
+
+
+class GammaMetric(_PointwiseMetric):
+    name = "gamma"
+
+    def row_loss(self, p, y):
+        eps = 1e-10
+        psafe = np.maximum(p, eps)
+        return y / psafe + np.log(psafe) - 1.0 - np.log(np.maximum(y, eps))
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma_deviance"
+
+    def row_loss(self, p, y):
+        eps = 1e-10
+        r = y / np.maximum(p, eps)
+        return 2.0 * (np.log(np.maximum(1.0 / np.maximum(r, eps), eps)) + r - 1.0)
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def row_loss(self, p, y):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        psafe = np.maximum(p, eps)
+        a = y * np.power(psafe, 1.0 - rho) / (1.0 - rho)
+        b = np.power(psafe, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+class MAPEMetric(_PointwiseMetric):
+    name = "mape"
+
+    def row_loss(self, p, y):
+        return np.abs((y - p) / np.maximum(1.0, np.abs(y)))
+
+
+class BinaryLoglossMetric(_PointwiseMetric):
+    name = "binary_logloss"
+
+    def row_loss(self, p, y):
+        eps = 1e-15
+        p = np.clip(p, eps, 1 - eps)
+        return -(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+
+class BinaryErrorMetric(_PointwiseMetric):
+    name = "binary_error"
+
+    def row_loss(self, p, y):
+        return ((p > 0.5) != (y > 0.5)).astype(np.float64)
+
+
+class CrossEntropyMetric(BinaryLoglossMetric):
+    name = "cross_entropy"
+
+
+class CrossEntropyLambdaMetric(_PointwiseMetric):
+    name = "cross_entropy_lambda"
+
+    def row_loss(self, p, y):
+        # p here is exp-transformed "hhat"; loss per xentropy_metric.hpp
+        eps = 1e-15
+        hhat = np.maximum(p, eps)
+        return hhat - y * np.log(np.maximum(1.0 - np.exp(-hhat), eps))
+
+
+class AUCMetric(Metric):
+    """Weighted ROC AUC (reference binary_metric.hpp AUCMetric)."""
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        score = _as_np(raw_score)
+        y = _as_np(label) > 0
+        w = _as_np(weight) if weight is not None else np.ones_like(score)
+        pos_w = np.where(y, w, 0.0)
+        neg_w = np.where(~y, w, 0.0)
+        # group ties by distinct score, ascending; a positive outranks every
+        # negative in strictly lower groups and half of its own tie group
+        _, inv = np.unique(score, return_inverse=True)
+        tie_pos = np.bincount(inv, weights=pos_w)
+        tie_neg = np.bincount(inv, weights=neg_w)
+        cum_neg_below = np.concatenate([[0.0], np.cumsum(tie_neg)[:-1]])
+        auc_sum = np.sum(tie_pos * (cum_neg_below + 0.5 * tie_neg))
+        tp, tn = pos_w.sum(), neg_w.sum()
+        if tp == 0 or tn == 0:
+            return [(self.name, 1.0, True)]
+        return [(self.name, float(auc_sum / (tp * tn)), True)]
+
+
+class AveragePrecisionMetric(Metric):
+    """reference average_precision (binary_metric.hpp)."""
+    name = "average_precision"
+    is_higher_better = True
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        score = _as_np(raw_score)
+        y = _as_np(label) > 0
+        w = _as_np(weight) if weight is not None else np.ones_like(score)
+        order = np.argsort(-score, kind="stable")
+        y, w = y[order], w[order]
+        pos_w = np.where(y, w, 0.0)
+        cum_pos = np.cumsum(pos_w)
+        cum_all = np.cumsum(w)
+        total_pos = pos_w.sum()
+        if total_pos == 0:
+            return [(self.name, 1.0, True)]
+        precision = cum_pos / cum_all
+        ap = np.sum(precision * pos_w) / total_pos
+        return [(self.name, float(ap), True)]
+
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        p = _as_np(objective.convert_output(raw_score))  # [K, N]
+        y = _as_np(label).astype(np.int64)
+        eps = 1e-15
+        probs = np.clip(p[y, np.arange(p.shape[1])], eps, 1.0)
+        w = _as_np(weight) if weight is not None else None
+        return [(self.name, _wavg(-np.log(probs), w), False)]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        p = _as_np(raw_score)  # [K, N]
+        y = _as_np(label).astype(np.int64)
+        k = self.config.multi_error_top_k
+        w = _as_np(weight) if weight is not None else None
+        if k <= 1:
+            err = (np.argmax(p, axis=0) != y).astype(np.float64)
+        else:
+            # top-k error (reference multi_error_top_k)
+            rank = np.sum(p > p[y, np.arange(p.shape[1])][None, :], axis=0)
+            err = (rank >= k).astype(np.float64)
+        return [(self.name if k <= 1 else f"multi_error@{k}",
+                 _wavg(err, w), False)]
+
+
+def _dcg_at(k, gains_sorted, discounts):
+    top = gains_sorted[:k]
+    return float(np.sum(top * discounts[:len(top)]))
+
+
+class NDCGMetric(Metric):
+    """reference ndcg@k (rank_metric.hpp + dcg_calculator.cpp)."""
+    name = "ndcg"
+    is_higher_better = True
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        if query_info is None:
+            raise ValueError("ndcg metric requires query information")
+        score = _as_np(raw_score)
+        y = _as_np(label).astype(np.int64)
+        label_gain = np.asarray(self.config.label_gain, dtype=np.float64)
+        gains = label_gain[np.clip(y, 0, len(label_gain) - 1)]
+        eval_at = [int(k) for k in self.config.eval_at]
+        maxk = max(eval_at)
+        discounts = 1.0 / np.log2(np.arange(2, maxk + 2))
+        boundaries = query_info
+        sums = np.zeros(len(eval_at))
+        nq = len(boundaries) - 1
+        wsum = 0.0
+        for q in range(nq):
+            lo, hi = boundaries[q], boundaries[q + 1]
+            g = gains[lo:hi]
+            s = score[lo:hi]
+            qw = 1.0
+            wsum += qw
+            if np.all(g == g[0]):
+                sums += qw  # reference: all-same-label query counts as 1
+                continue
+            order = np.argsort(-s, kind="stable")
+            ideal = np.sort(g)[::-1]
+            for i, k in enumerate(eval_at):
+                dcg = _dcg_at(k, g[order], discounts)
+                idcg = _dcg_at(k, ideal, discounts)
+                sums[i] += qw * (dcg / idcg if idcg > 0 else 1.0)
+        return [(f"ndcg@{k}", float(sums[i] / wsum), True)
+                for i, k in enumerate(eval_at)]
+
+
+class MapMetric(Metric):
+    """reference map@k (map_metric.hpp)."""
+    name = "map"
+    is_higher_better = True
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        if query_info is None:
+            raise ValueError("map metric requires query information")
+        score = _as_np(raw_score)
+        y = _as_np(label) > 0
+        eval_at = [int(k) for k in self.config.eval_at]
+        boundaries = query_info
+        nq = len(boundaries) - 1
+        sums = np.zeros(len(eval_at))
+        for q in range(nq):
+            lo, hi = boundaries[q], boundaries[q + 1]
+            rel = y[lo:hi]
+            order = np.argsort(-score[lo:hi], kind="stable")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            ranks = np.arange(1, len(rel_sorted) + 1)
+            prec = hits / ranks
+            for i, k in enumerate(eval_at):
+                topk = rel_sorted[:k]
+                nhit = topk.sum()
+                sums[i] += (np.sum(prec[:k] * topk) / nhit) if nhit > 0 else 0.0
+        return [(f"map@{k}", float(sums[i] / nq), True)
+                for i, k in enumerate(eval_at)]
+
+
+_METRICS = {cls.name: cls for cls in (
+    L2Metric, RMSEMetric, L1Metric, QuantileMetric, HuberMetric, FairMetric,
+    PoissonMetric, GammaMetric, GammaDevianceMetric, TweedieMetric, MAPEMetric,
+    BinaryLoglossMetric, BinaryErrorMetric, CrossEntropyMetric,
+    CrossEntropyLambdaMetric, AUCMetric, AveragePrecisionMetric,
+    MultiLoglossMetric, MultiErrorMetric, NDCGMetric, MapMetric)}
+
+_METRIC_ALIASES = {
+    "mse": "l2", "mean_squared_error": "l2", "regression": "l2",
+    "regression_l2": "l2", "l2_root": "rmse", "root_mean_squared_error": "rmse",
+    "mae": "l1", "mean_absolute_error": "l1", "regression_l1": "l1",
+    "mean_absolute_percentage_error": "mape",
+    "binary": "binary_logloss",
+    "xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda",
+    "multiclass": "multi_logloss", "softmax": "multi_logloss",
+    "multiclassova": "multi_logloss",
+    "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "mean_average_precision": "map",
+}
+
+
+def create_metric(name: str, config) -> Metric:
+    name = name.strip()
+    if name.startswith("ndcg@") or name.startswith("map@"):
+        base, ks = name.split("@", 1)
+        config = config.copy(eval_at=[int(k) for k in ks.split(",")])
+        name = base
+    name = _METRIC_ALIASES.get(name, name)
+    cls = _METRICS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown metric: {name!r}")
+    return cls(config)
+
+
+def create_metrics(config, objective=None):
+    """Resolve the metric list, defaulting to the objective's natural metric
+    (reference Config metric resolution)."""
+    names = config.metric
+    if not names:
+        if objective is None or objective.name in ("none", "custom"):
+            return []
+        names = [objective.name]
+    if isinstance(names, str):
+        names = [names]
+    out = []
+    for n in names:
+        if n in ("", "none", "null", "na"):
+            continue
+        out.append(create_metric(str(n), config))
+    return out

@@ -1,0 +1,5 @@
+from .histogram import build_histogram
+from .split import find_best_split, leaf_output
+from .predict import predict_trees
+
+__all__ = ["build_histogram", "find_best_split", "leaf_output", "predict_trees"]

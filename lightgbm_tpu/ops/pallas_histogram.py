@@ -1,0 +1,14 @@
+"""Pallas TPU histogram kernel (tuned replacement for ops/histogram.py's
+XLA one-hot matmul; reference analogue: ocl/histogram256.cl:317 and
+kernels/histogram_16_64_256.cu).  Falls back to the one-hot path until the
+tuned kernel lands."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def build_histogram_pallas(bins: jnp.ndarray, weights: jnp.ndarray,
+                           num_bins: int) -> jnp.ndarray:
+    from .histogram import _onehot_impl
+    return _onehot_impl(bins, weights, num_bins)

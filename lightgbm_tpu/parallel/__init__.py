@@ -1,0 +1,13 @@
+"""Distributed tree learners over jax.sharding meshes.
+
+TPU-native replacement for the reference's network layer + parallel learners
+(src/network/, src/treelearner/*_parallel_tree_learner.cpp): the three
+parallel modes become sharding annotations of the same jitted grow step, with
+XLA collectives over ICI/DCN standing in for the hand-rolled socket/MPI
+collectives (SURVEY §2.6 mapping).
+"""
+
+from .mesh import build_mesh
+from .data_parallel import DataParallelTreeLearner
+
+__all__ = ["build_mesh", "DataParallelTreeLearner"]

@@ -1,0 +1,29 @@
+"""Device mesh construction (replaces reference Network::Init topology setup,
+src/network/linkers_socket.cpp / linkers_mpi.cpp: instead of a TCP/MPI mesh of
+machines, a jax.sharding.Mesh over local + distributed devices)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh", "maybe_init_distributed"]
+
+
+def maybe_init_distributed(config) -> None:
+    """Multi-host initialization (reference Network::Init; here
+    jax.distributed over the coordinator address from `machines`)."""
+    if config.machines and config.num_machines > 1:
+        first = config.machines.split(",")[0]
+        jax.distributed.initialize(
+            coordinator_address=first,
+            num_processes=config.num_machines,
+            process_id=None)  # auto-detect via env
+
+
+def build_mesh(config, axis_name: str = "data") -> Mesh:
+    devices = jax.devices()
+    n = config.num_tpu_devices or len(devices)
+    n = min(n, len(devices))
+    return Mesh(np.asarray(devices[:n]), (axis_name,))

@@ -1,0 +1,342 @@
+"""Flat-array decision tree model.
+
+TPU-native equivalent of the reference ``Tree`` (include/LightGBM/tree.h,
+src/io/tree.cpp).  The flat layout (parallel arrays indexed by internal-node id,
+child pointers where ``>=0`` means internal node and ``<0`` means leaf ``~idx``)
+carries over almost unchanged because it is already ideal for vectorized
+traversal on device.  Text serialization keeps the reference's model format so
+models interoperate with LightGBM tooling (src/io/tree.cpp:336 ToString).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Dict, List, Optional
+
+__all__ = ["Tree"]
+
+# decision_type_ bit layout (reference tree.h:15-21 masks)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+# missing type occupies bits 2-3: 0=None, 1=Zero, 2=NaN
+
+_MISSING_CODE = {"none": 0, "zero": 1, "nan": 2}
+_MISSING_NAME = {v: k for k, v in _MISSING_CODE.items()}
+
+_K_ZERO_LOW, _K_ZERO_HIGH = -1e-35, 1e-35
+
+
+class Tree:
+    """A single decision tree with ``max_leaves`` capacity.
+
+    ``num_leaves_`` grows as splits are applied; internal node ``i`` was created
+    by the ``i``-th split (reference Tree::Split, tree.h:62).
+    """
+
+    def __init__(self, max_leaves: int):
+        m = max_leaves
+        self.max_leaves = m
+        self.num_leaves = 1
+        self.num_cat = 0
+        self.left_child = np.zeros(m - 1, dtype=np.int32)
+        self.right_child = np.zeros(m - 1, dtype=np.int32)
+        self.split_feature = np.zeros(m - 1, dtype=np.int32)   # real feature idx
+        self.threshold_in_bin = np.zeros(m - 1, dtype=np.int32)
+        self.threshold = np.zeros(m - 1, dtype=np.float64)     # real-valued
+        self.decision_type = np.zeros(m - 1, dtype=np.int8)
+        self.split_gain = np.zeros(m - 1, dtype=np.float32)
+        self.internal_value = np.zeros(m - 1, dtype=np.float64)
+        self.internal_weight = np.zeros(m - 1, dtype=np.float64)
+        self.internal_count = np.zeros(m - 1, dtype=np.int64)
+        self.leaf_value = np.zeros(m, dtype=np.float64)
+        self.leaf_weight = np.zeros(m, dtype=np.float64)
+        self.leaf_count = np.zeros(m, dtype=np.int64)
+        self.leaf_parent = np.full(m, -1, dtype=np.int32)
+        self.leaf_depth = np.zeros(m, dtype=np.int32)
+        # categorical splits: threshold_in_bin indexes into cat boundaries
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []   # uint32 bitset words
+        self.shrinkage_ = 1.0
+        self.is_linear = False
+
+    # ------------------------------------------------------------------
+    def split(self, leaf: int, feature: int, threshold_bin: int,
+              threshold_double: float, left_value: float, right_value: float,
+              left_cnt: int, right_cnt: int, left_weight: float,
+              right_weight: float, gain: float, missing_type: str = "none",
+              default_left: bool = False) -> int:
+        """Numerical split of ``leaf``; returns the new (right) leaf id
+        (reference Tree::Split, tree.h:62)."""
+        new_node = self.num_leaves - 1
+        new_leaf = self.num_leaves
+        parent = self.leaf_parent[leaf]
+        if parent >= 0:
+            if self.left_child[parent] == ~leaf:
+                self.left_child[parent] = new_node
+            else:
+                self.right_child[parent] = new_node
+        self.split_feature[new_node] = feature
+        self.threshold_in_bin[new_node] = threshold_bin
+        self.threshold[new_node] = threshold_double
+        dt = _MISSING_CODE[missing_type] << 2
+        if default_left:
+            dt |= K_DEFAULT_LEFT_MASK
+        self.decision_type[new_node] = dt
+        self.split_gain[new_node] = gain
+        self.left_child[new_node] = ~leaf
+        self.right_child[new_node] = ~new_leaf
+        total_w = left_weight + right_weight
+        self.internal_value[new_node] = (
+            (left_value * left_weight + right_value * right_weight) / total_w
+            if total_w > 0 else 0.0)
+        self.internal_weight[new_node] = total_w
+        self.internal_count[new_node] = left_cnt + right_cnt
+        self.leaf_value[leaf] = left_value
+        self.leaf_weight[leaf] = left_weight
+        self.leaf_count[leaf] = left_cnt
+        self.leaf_value[new_leaf] = right_value
+        self.leaf_weight[new_leaf] = right_weight
+        self.leaf_count[new_leaf] = right_cnt
+        depth = self.leaf_depth[leaf] + 1
+        self.leaf_depth[leaf] = depth
+        self.leaf_depth[new_leaf] = depth
+        self.leaf_parent[leaf] = new_node
+        self.leaf_parent[new_leaf] = new_node
+        self.num_leaves += 1
+        return new_leaf
+
+    def split_categorical(self, leaf: int, feature: int, bin_bitset: List[int],
+                          threshold_double_bitset: List[int],
+                          left_value: float, right_value: float,
+                          left_cnt: int, right_cnt: int, left_weight: float,
+                          right_weight: float, gain: float) -> int:
+        """Categorical split: rows whose category is in the bitset go left
+        (reference Tree::SplitCategorical, tree.h:85).  Two bitsets are stored:
+        one over bins (train-time) and one over raw category ids (predict)."""
+        new_node = self.num_leaves - 1
+        new_leaf = self.split(leaf, feature, 0, 0.0, left_value, right_value,
+                              left_cnt, right_cnt, left_weight, right_weight,
+                              gain, "none", False)
+        self.decision_type[new_node] |= K_CATEGORICAL_MASK
+        self.threshold_in_bin[new_node] = self.num_cat
+        self.threshold[new_node] = self.num_cat
+        self.num_cat += 1
+        self.cat_boundaries.append(self.cat_boundaries[-1] + len(threshold_double_bitset))
+        self.cat_threshold.extend(int(w) for w in threshold_double_bitset)
+        if not hasattr(self, "cat_boundaries_inner"):
+            self.cat_boundaries_inner: List[int] = [0]
+            self.cat_threshold_inner: List[int] = []
+        self.cat_boundaries_inner.append(self.cat_boundaries_inner[-1] + len(bin_bitset))
+        self.cat_threshold_inner.extend(int(w) for w in bin_bitset)
+        return new_leaf
+
+    # ------------------------------------------------------------------
+    def shrinkage(self, rate: float) -> None:
+        n = self.num_leaves
+        self.leaf_value[:n] *= rate
+        self.internal_value[:max(n - 1, 0)] *= rate
+        self.shrinkage_ *= rate
+
+    def add_bias(self, val: float) -> None:
+        n = self.num_leaves
+        self.leaf_value[:n] += val
+        self.internal_value[:max(n - 1, 0)] += val
+        self.shrinkage_ = 1.0
+
+    def scale_leaf(self, leaf_values: np.ndarray) -> None:
+        self.leaf_value[:self.num_leaves] = leaf_values[:self.num_leaves]
+
+    # ------------------------------------------------------------------
+    def _cat_in_bitset(self, node: int, ival: np.ndarray, inner: bool) -> np.ndarray:
+        if inner:
+            bounds, words = self.cat_boundaries_inner, self.cat_threshold_inner
+        else:
+            bounds, words = self.cat_boundaries, self.cat_threshold
+        cat_idx = self.threshold_in_bin[node]
+        lo, hi = bounds[cat_idx], bounds[cat_idx + 1]
+        bits = np.asarray(words[lo:hi], dtype=np.uint32)
+        word = ival >> 5
+        ok = (ival >= 0) & (word < (hi - lo))
+        word_c = np.clip(word, 0, max(hi - lo - 1, 0))
+        return ok & (((bits[word_c] >> (ival & 31)) & 1) == 1)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Host-side vectorized prediction over raw feature values
+        (reference Tree::Predict -> NumericalDecision loop, tree.h:133,331)."""
+        return self.leaf_value[self.predict_leaf_index(X)]
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        n = X.shape[0]
+        if self.num_leaves == 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)  # True while `node` refers to internal node
+        leaf_out = np.zeros(n, dtype=np.int32)
+        for _ in range(self.num_leaves):  # depth bound
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            fval = X[idx, self.split_feature[nd]]
+            dt = self.decision_type[nd]
+            is_cat = (dt & K_CATEGORICAL_MASK) != 0
+            go_left = np.zeros(len(idx), dtype=bool)
+            # numerical decision
+            num_mask = ~is_cat
+            if num_mask.any():
+                go_left[num_mask] = self._numerical_go_left(
+                    nd[num_mask], fval[num_mask], dt[num_mask])
+            if is_cat.any():
+                iv = np.where(np.isnan(fval[is_cat]), -1,
+                              fval[is_cat]).astype(np.int64)
+                sub = np.zeros(int(is_cat.sum()), dtype=bool)
+                for j, (nj, vj) in enumerate(zip(nd[is_cat], iv)):
+                    sub[j] = bool(self._cat_in_bitset(int(nj),
+                                                      np.asarray([vj]), False)[0])
+                go_left[is_cat] = sub
+            child = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            is_leaf = child < 0
+            leaf_out[idx[is_leaf]] = ~child[is_leaf]
+            node[idx[~is_leaf]] = child[~is_leaf]
+            active[idx[is_leaf]] = False
+        return leaf_out
+
+    def _numerical_go_left(self, nodes, fval, dt) -> np.ndarray:
+        missing = (dt.astype(np.int32) >> 2) & 3
+        default_left = (dt & K_DEFAULT_LEFT_MASK) != 0
+        thr = self.threshold[nodes]
+        isnan = np.isnan(fval)
+        iszero = (fval > _K_ZERO_LOW) & (fval < _K_ZERO_HIGH)
+        # NaN with missing_type != nan is treated as 0 (reference tree.h:331-366)
+        fval = np.where(isnan & (missing != 2), 0.0, fval)
+        iszero = (fval > _K_ZERO_LOW) & (fval < _K_ZERO_HIGH)
+        is_missing = ((missing == 2) & isnan) | ((missing == 1) & iszero)
+        return np.where(is_missing, default_left, fval <= thr)
+
+    # -- serialization ---------------------------------------------------
+    def to_string(self, index: int) -> str:
+        """Reference-format model text block (src/io/tree.cpp:336 ToString)."""
+        n, ni = self.num_leaves, max(self.num_leaves - 1, 0)
+
+        def arr(a, fmt="{:g}"):
+            return " ".join(fmt.format(x) for x in a)
+
+        lines = [f"Tree={index}",
+                 f"num_leaves={n}",
+                 f"num_cat={self.num_cat}",
+                 f"split_feature={arr(self.split_feature[:ni], '{:d}')}",
+                 f"split_gain={arr(self.split_gain[:ni])}",
+                 f"threshold={arr(self.threshold[:ni], '{:.17g}')}",
+                 f"decision_type={arr(self.decision_type[:ni], '{:d}')}",
+                 f"left_child={arr(self.left_child[:ni], '{:d}')}",
+                 f"right_child={arr(self.right_child[:ni], '{:d}')}",
+                 f"leaf_value={arr(self.leaf_value[:n], '{:.17g}')}",
+                 f"leaf_weight={arr(self.leaf_weight[:n], '{:.17g}')}",
+                 f"leaf_count={arr(self.leaf_count[:n], '{:d}')}",
+                 f"internal_value={arr(self.internal_value[:ni], '{:g}')}",
+                 f"internal_weight={arr(self.internal_weight[:ni], '{:g}')}",
+                 f"internal_count={arr(self.internal_count[:ni], '{:d}')}"]
+        if self.num_cat > 0:
+            lines.append(f"cat_boundaries={arr(self.cat_boundaries, '{:d}')}")
+            lines.append(f"cat_threshold={arr(self.cat_threshold, '{:d}')}")
+        lines.append(f"is_linear={int(self.is_linear)}")
+        lines.append(f"shrinkage={self.shrinkage_:g}")
+        lines.append("")
+        return "\n".join(lines)
+
+    @staticmethod
+    def from_string(block: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in block.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        n = int(kv["num_leaves"])
+        t = Tree(max(n, 2))
+        t.num_leaves = n
+        t.num_cat = int(kv.get("num_cat", 0))
+        ni = max(n - 1, 0)
+
+        def parse(key, dtype, count):
+            if count == 0 or not kv.get(key):
+                return np.zeros(count, dtype=dtype)
+            vals = np.array([float(x) for x in kv[key].split()], dtype=np.float64)
+            return vals.astype(dtype)
+
+        t.split_feature[:ni] = parse("split_feature", np.int32, ni)
+        t.split_gain[:ni] = parse("split_gain", np.float32, ni)
+        t.threshold[:ni] = parse("threshold", np.float64, ni)
+        t.decision_type[:ni] = parse("decision_type", np.int8, ni)
+        t.left_child[:ni] = parse("left_child", np.int32, ni)
+        t.right_child[:ni] = parse("right_child", np.int32, ni)
+        t.leaf_value[:n] = parse("leaf_value", np.float64, n)
+        t.leaf_weight[:n] = parse("leaf_weight", np.float64, n)
+        t.leaf_count[:n] = parse("leaf_count", np.int64, n)
+        t.internal_value[:ni] = parse("internal_value", np.float64, ni)
+        t.internal_weight[:ni] = parse("internal_weight", np.float64, ni)
+        t.internal_count[:ni] = parse("internal_count", np.int64, ni)
+        if t.num_cat > 0:
+            t.cat_boundaries = [int(float(x)) for x in kv["cat_boundaries"].split()]
+            t.cat_threshold = [int(float(x)) for x in kv["cat_threshold"].split()]
+        t.shrinkage_ = float(kv.get("shrinkage", 1.0))
+        t.is_linear = bool(int(kv.get("is_linear", 0)))
+        # rebuild leaf_parent and leaf_depth by walking from the root
+        # (depth feeds stack_trees' traversal bound, ops/predict.py)
+        if ni > 0:
+            node_depth = np.zeros(ni, dtype=np.int32)
+            stack = [0]
+            while stack:
+                node = stack.pop()
+                for child in (t.left_child[node], t.right_child[node]):
+                    if child < 0:
+                        t.leaf_parent[~child] = node
+                        t.leaf_depth[~child] = node_depth[node] + 1
+                    else:
+                        node_depth[child] = node_depth[node] + 1
+                        stack.append(int(child))
+        return t
+
+    def to_json(self, index: int) -> dict:
+        """JSON dump (reference Tree::ToJSON, src/io/tree.cpp:412)."""
+        def node_json(node: int) -> dict:
+            if node < 0:
+                leaf = ~node
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_weight": float(self.leaf_weight[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            dt = int(self.decision_type[node])
+            out = {
+                "split_index": int(node),
+                "split_feature": int(self.split_feature[node]),
+                "split_gain": float(self.split_gain[node]),
+                "threshold": float(self.threshold[node]),
+                "decision_type": "==" if dt & K_CATEGORICAL_MASK else "<=",
+                "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
+                "missing_type": _MISSING_NAME[(dt >> 2) & 3],
+                "internal_value": float(self.internal_value[node]),
+                "internal_weight": float(self.internal_weight[node]),
+                "internal_count": int(self.internal_count[node]),
+                "left_child": node_json(int(self.left_child[node])),
+                "right_child": node_json(int(self.right_child[node])),
+            }
+            return out
+
+        root = ~0 if self.num_leaves == 1 else 0
+        return {"tree_index": index, "num_leaves": int(self.num_leaves),
+                "num_cat": int(self.num_cat), "shrinkage": self.shrinkage_,
+                "tree_structure": node_json(root)}
+
+    # -- device export ---------------------------------------------------
+    def to_arrays(self) -> dict:
+        """Padded arrays for the device prediction kernel (ops/predict.py)."""
+        return {
+            "left_child": self.left_child,
+            "right_child": self.right_child,
+            "split_feature": self.split_feature,
+            "threshold": self.threshold,
+            "decision_type": self.decision_type,
+            "leaf_value": self.leaf_value,
+            "num_leaves": self.num_leaves,
+        }

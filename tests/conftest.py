@@ -1,0 +1,108 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharding tests
+run without TPU hardware (mirrors the reference's localhost multi-process
+distributed tests, tests/distributed/_test_distributed.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+
+# The environment may pre-import jax with JAX_PLATFORMS=<tpu plugin> via
+# sitecustomize, freezing the platform choice before this file runs; override
+# through the config API so tests NEVER touch the (exclusive) real chip.
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+REFERENCE_EXAMPLES = "/root/reference/examples"
+
+
+def has_examples() -> bool:
+    return os.path.isdir(REFERENCE_EXAMPLES)
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    """binary_classification example data, or synthetic fallback."""
+    path = os.path.join(REFERENCE_EXAMPLES, "binary_classification")
+    if os.path.isdir(path):
+        from lightgbm_tpu.io.parser import load_svmlight_or_csv
+        X_train, y_train = load_svmlight_or_csv(
+            os.path.join(path, "binary.train"))
+        X_test, y_test = load_svmlight_or_csv(
+            os.path.join(path, "binary.test"))
+        return X_train, y_train, X_test, y_test
+    from sklearn.datasets import make_classification
+    from sklearn.model_selection import train_test_split
+    X, y = make_classification(n_samples=7500, n_features=28, random_state=42)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=500, random_state=42)
+    return X_train, y_train.astype(np.float32), X_test, y_test.astype(np.float32)
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    path = os.path.join(REFERENCE_EXAMPLES, "regression")
+    if os.path.isdir(path):
+        from lightgbm_tpu.io.parser import load_svmlight_or_csv
+        X_train, y_train = load_svmlight_or_csv(
+            os.path.join(path, "regression.train"))
+        X_test, y_test = load_svmlight_or_csv(
+            os.path.join(path, "regression.test"))
+        return X_train, y_train, X_test, y_test
+    from sklearn.datasets import make_regression
+    from sklearn.model_selection import train_test_split
+    X, y = make_regression(n_samples=7500, n_features=28, random_state=42)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=500, random_state=42)
+    return X_train, y_train.astype(np.float32), X_test, y_test.astype(np.float32)
+
+
+@pytest.fixture(scope="session")
+def multiclass_data():
+    path = os.path.join(REFERENCE_EXAMPLES, "multiclass_classification")
+    if os.path.isdir(path):
+        from lightgbm_tpu.io.parser import load_svmlight_or_csv
+        X_train, y_train = load_svmlight_or_csv(
+            os.path.join(path, "multiclass.train"))
+        X_test, y_test = load_svmlight_or_csv(
+            os.path.join(path, "multiclass.test"))
+        return X_train, y_train, X_test, y_test
+    from sklearn.datasets import make_classification
+    from sklearn.model_selection import train_test_split
+    X, y = make_classification(n_samples=7500, n_features=28, n_classes=5,
+                               n_informative=10, random_state=42)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=500, random_state=42)
+    return X_train, y_train.astype(np.float32), X_test, y_test.astype(np.float32)
+
+
+@pytest.fixture(scope="session")
+def rank_data():
+    path = os.path.join(REFERENCE_EXAMPLES, "lambdarank")
+    if os.path.isdir(path):
+        from lightgbm_tpu.io.parser import load_svmlight_or_csv
+        X_train, y_train = load_svmlight_or_csv(
+            os.path.join(path, "rank.train"))
+        X_test, y_test = load_svmlight_or_csv(os.path.join(path, "rank.test"))
+        q_train = np.loadtxt(os.path.join(path, "rank.train.query"),
+                             dtype=np.int64)
+        q_test = np.loadtxt(os.path.join(path, "rank.test.query"),
+                            dtype=np.int64)
+        return X_train, y_train, q_train, X_test, y_test, q_test
+    rng = np.random.RandomState(42)
+    n_q = 100
+    sizes = rng.randint(5, 30, n_q)
+    n = sizes.sum()
+    X = rng.randn(n, 20)
+    w = rng.randn(20)
+    y = np.clip((X @ w + rng.randn(n)) // 2 + 2, 0, 4).astype(np.float32)
+    half = n_q // 2
+    tr = sizes[:half].sum()
+    return (X[:tr], y[:tr], sizes[:half], X[tr:], y[tr:], sizes[half:])

@@ -1,0 +1,118 @@
+"""Unit tests for host-side binning (reference BinMapper behavior)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, BinType, MissingType, find_bin_mappers
+
+
+def test_few_distinct_values_one_bin_each():
+    vals = np.repeat([1.0, 2.0, 3.0, 4.0], 10)
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255, min_data_in_bin=3)
+    assert m.num_bin == 4
+    b = m.value_to_bin(np.array([1.0, 2.0, 3.0, 4.0]))
+    assert len(set(b.tolist())) == 4
+    # ordering preserved
+    assert list(b) == sorted(b)
+
+
+def test_bin_boundaries_are_midpoints():
+    vals = np.repeat([0.0, 10.0], 50)
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255)
+    assert m.num_bin == 2
+    assert m.value_to_bin(np.array([4.9]))[0] == 0
+    assert m.value_to_bin(np.array([5.1]))[0] == 1
+
+
+def test_many_distinct_respects_max_bin():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = BinMapper().find_bin(vals, len(vals), max_bin=63)
+    assert 2 <= m.num_bin <= 63
+    b = m.value_to_bin(vals)
+    assert b.min() >= 0 and b.max() < m.num_bin
+    # bins are monotonic in value
+    order = np.argsort(vals)
+    assert (np.diff(b[order]) >= 0).all()
+
+
+def test_nan_goes_to_missing_bin():
+    vals = np.concatenate([np.random.RandomState(0).randn(100),
+                           [np.nan] * 10])
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255, use_missing=True)
+    assert m.missing_type == MissingType.NAN
+    assert m.missing_bin == m.num_bin - 1
+    b = m.value_to_bin(np.array([np.nan, 0.0]))
+    assert b[0] == m.missing_bin
+    assert b[1] != m.missing_bin
+
+
+def test_no_missing_when_use_missing_false():
+    vals = np.concatenate([np.arange(100.0), [np.nan] * 5])
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255, use_missing=False)
+    assert m.missing_type == MissingType.NONE
+    assert m.missing_bin is None
+    # NaN treated as 0
+    assert m.value_to_bin(np.array([np.nan]))[0] == \
+        m.value_to_bin(np.array([0.0]))[0]
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.arange(1, 100.0), np.zeros(50)])
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255,
+                             zero_as_missing=True)
+    assert m.missing_type == MissingType.ZERO
+    assert m.value_to_bin(np.array([0.0]))[0] == m.missing_bin
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.missing_bin
+
+
+def test_trivial_feature_detected():
+    vals = np.full(100, 7.0)
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255)
+    assert m.is_trivial
+
+
+def test_categorical_binning():
+    rng = np.random.RandomState(0)
+    vals = rng.choice([0, 1, 2, 5, 9], size=1000,
+                      p=[0.4, 0.3, 0.2, 0.05, 0.05]).astype(float)
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255,
+                             bin_type=BinType.CATEGORICAL)
+    assert m.bin_type == BinType.CATEGORICAL
+    assert m.num_bin >= 5
+    b = m.value_to_bin(vals)
+    # same category -> same bin; distinct categories -> distinct bins
+    for cat in [0, 1, 2, 5, 9]:
+        assert len(set(b[vals == cat].tolist())) == 1
+    # most frequent category gets bin 1 (count-sorted)
+    assert m.value_to_bin(np.array([0.0]))[0] == 1
+    # unseen category -> bin 0
+    assert m.value_to_bin(np.array([77.0]))[0] == 0
+
+
+def test_min_data_in_bin():
+    # values with counts below min_data_in_bin should merge
+    vals = np.concatenate([np.zeros(100), [1.0], [2.0], np.full(100, 3.0)])
+    m = BinMapper().find_bin(vals, len(vals), max_bin=255, min_data_in_bin=5)
+    b = m.value_to_bin(np.array([1.0, 2.0]))
+    assert b[0] == b[1]  # merged into same bin
+
+
+def test_find_bin_mappers_matrix():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 5)
+    X[:, 2] = 1.0  # trivial
+    mappers = find_bin_mappers(X, max_bin=63)
+    assert len(mappers) == 5
+    assert mappers[2].is_trivial
+    assert not mappers[0].is_trivial
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([rng.randn(500), [np.nan] * 20])
+    m = BinMapper().find_bin(vals, len(vals), max_bin=127)
+    m2 = BinMapper.from_dict(m.to_dict())
+    test_vals = np.concatenate([rng.randn(100), [np.nan, 0.0]])
+    np.testing.assert_array_equal(m.value_to_bin(test_vals),
+                                  m2.value_to_bin(test_vals))

@@ -1,0 +1,75 @@
+"""DART / GOSS / RF boosting modes (reference test_engine.py:75,409,687)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def sk_auc(y, s):
+    from sklearn.metrics import roc_auc_score
+    return roc_auc_score(y, s)
+
+
+def test_dart(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "boosting": "dart", "metric": "auc",
+              "drop_rate": 0.1, "verbosity": -1}
+    res = {}
+    ts = lgb.Dataset(X_train, y_train)
+    bst = lgb.train(params, ts, 40,
+                    valid_sets=[lgb.Dataset(X_test, y_test, reference=ts)],
+                    evals_result=res)
+    auc = sk_auc(y_test, bst.predict(X_test))
+    assert auc > 0.75
+    # eval-curve AUC is consistent with final prediction
+    assert res["valid_0"]["auc"][-1] == pytest.approx(auc, abs=1e-5)
+
+
+def test_goss(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "boosting": "goss", "metric": "auc",
+              "top_rate": 0.2, "other_rate": 0.1, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), 40)
+    assert sk_auc(y_test, bst.predict(X_test)) > 0.75
+
+
+def test_goss_rejects_bagging(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "boosting": "goss",
+              "bagging_freq": 1, "bagging_fraction": 0.5, "verbosity": -1}
+    with pytest.raises(ValueError):
+        lgb.train(params, lgb.Dataset(X_train, y_train), 2)
+
+
+def test_rf(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_freq": 1, "bagging_fraction": 0.632,
+              "feature_fraction": 0.8, "metric": "auc", "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), 30)
+    pred = bst.predict(X_test)
+    assert sk_auc(y_test, pred) > 0.75
+    # averaged output stays in probability range after sigmoid
+    assert 0.0 < pred.mean() < 1.0
+    # model file carries the average_output marker (reference format)
+    s = bst.model_to_string()
+    assert "average_output" in s
+
+
+def test_rf_requires_bagging(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "boosting": "rf", "verbosity": -1}
+    with pytest.raises(ValueError):
+        lgb.train(params, lgb.Dataset(X_train, y_train), 2)
+
+
+def test_bagging_changes_trees(binary_data):
+    X_train, y_train, _, _ = binary_data
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+    b1 = lgb.train(base, lgb.Dataset(X_train, y_train), 5)
+    b2 = lgb.train({**base, "bagging_freq": 1, "bagging_fraction": 0.5},
+                   lgb.Dataset(X_train, y_train), 5)
+    t1, t2 = b1._gbdt.models[1], b2._gbdt.models[1]
+    assert (t1.leaf_count[:t1.num_leaves].sum() >
+            t2.leaf_count[:t2.num_leaves].sum())

@@ -1,0 +1,220 @@
+"""End-to-end training tests with metric thresholds, mirroring the reference's
+primary test strategy (tests/python_package_test/test_engine.py: e.g.
+test_binary asserts log_loss < 0.14 at :52)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def sk_logloss(y, p):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+
+
+def sk_auc(y, s):
+    from sklearn.metrics import roc_auc_score
+    return roc_auc_score(y, s)
+
+
+def test_binary():
+    """Golden parity test: same data+params+threshold as the reference
+    test_engine.py:52-72 (breast_cancer, 50 iters, logloss < 0.14)."""
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+    X, y = load_breast_cancer(return_X_y=True)
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=0.1, random_state=42)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_iteration": 50}
+    train_set = lgb.Dataset(X_train, y_train)
+    valid_set = lgb.Dataset(X_test, y_test, reference=train_set)
+    evals_result = {}
+    bst = lgb.train(params, train_set, num_boost_round=20,
+                    valid_sets=[valid_set], evals_result=evals_result)
+    pred = bst.predict(X_test)
+    ll = sk_logloss(y_test, pred)
+    assert ll < 0.14
+    assert len(evals_result["valid_0"]["binary_logloss"]) == 50
+    assert evals_result["valid_0"]["binary_logloss"][-1] == pytest.approx(
+        ll, rel=1e-4)
+
+
+def test_binary_example_data_quality(binary_data):
+    """On the reference examples' HIGGS-subset data, match the quality the
+    reference reaches (test AUC ~0.8 at 50 iters with default params)."""
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "metric": "auc", "verbosity": -1}
+    train_set = lgb.Dataset(X_train, y_train)
+    bst = lgb.train(params, train_set, num_boost_round=50)
+    auc = sk_auc(y_test, bst.predict(X_test))
+    assert auc > 0.79
+
+
+def test_regression(regression_data):
+    X_train, y_train, X_test, y_test = regression_data
+    params = {"objective": "regression", "metric": "l2", "verbosity": -1}
+    train_set = lgb.Dataset(X_train, y_train)
+    bst = lgb.train(params, train_set, num_boost_round=50)
+    pred = bst.predict(X_test)
+    mse = float(np.mean((pred - y_test) ** 2))
+    base = float(np.mean((y_test - y_train.mean()) ** 2))
+    assert mse < 0.85 * base  # clearly better than predicting the mean
+
+
+def test_training_improves_over_iterations(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1}
+    train_set = lgb.Dataset(X_train, y_train)
+    valid_set = lgb.Dataset(X_test, y_test, reference=train_set)
+    res = {}
+    lgb.train(params, train_set, num_boost_round=30, valid_sets=[valid_set],
+              evals_result=res)
+    curve = res["valid_0"]["binary_logloss"]
+    assert len(curve) == 30
+    assert curve[-1] < curve[0] * 0.9
+    assert curve[-1] < curve[len(curve) // 2]  # still improving
+
+
+def test_early_stopping(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1, "learning_rate": 0.5, "num_leaves": 63}
+    train_set = lgb.Dataset(X_train, y_train)
+    valid_set = lgb.Dataset(X_test, y_test, reference=train_set)
+    bst = lgb.train(params, train_set, num_boost_round=500,
+                    valid_sets=[valid_set],
+                    callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() < 500
+
+
+def test_continued_training(binary_data):
+    """Continued training: the new booster trains on top of the old model's
+    scores (reference semantics: the continued booster holds only its own
+    trees; totals = init raw + new raw)."""
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1}
+    ts1 = lgb.Dataset(X_train, y_train, free_raw_data=False)
+    bst1 = lgb.train(params, ts1, num_boost_round=10)
+    raw1 = bst1.predict(X_test, raw_score=True)
+    ts2 = lgb.Dataset(X_train, y_train, free_raw_data=False)
+    bst2 = lgb.train(params, ts2, num_boost_round=10, init_model=bst1)
+    total = raw1 + bst2.predict(X_test, raw_score=True)
+    p1 = 1 / (1 + np.exp(-raw1))
+    p2 = 1 / (1 + np.exp(-total))
+    assert sk_logloss(y_test, p2) < sk_logloss(y_test, p1)
+
+
+def test_custom_objective_fobj(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+
+    def logloss_obj(score, ds):
+        y = ds.get_label()
+        p = 1.0 / (1.0 + np.exp(-score))
+        return p - y, p * (1 - p)
+
+    params = {"objective": "none", "metric": "auc", "verbosity": -1}
+    train_set = lgb.Dataset(X_train, y_train)
+    bst = lgb.train(params, train_set, num_boost_round=30, fobj=logloss_obj)
+    raw = bst.predict(X_test, raw_score=True)
+    assert sk_auc(y_test, 1 / (1 + np.exp(-raw))) > 0.75
+
+
+def test_custom_feval(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+
+    def my_err(raw, ds):
+        y = ds.get_label()
+        p = 1.0 / (1.0 + np.exp(-raw))
+        return "my_err", float(np.mean((p > 0.5) != y)), False
+
+    params = {"objective": "binary", "metric": "none", "verbosity": -1}
+    train_set = lgb.Dataset(X_train, y_train)
+    valid_set = lgb.Dataset(X_test, y_test, reference=train_set)
+    res = {}
+    lgb.train(params, train_set, num_boost_round=10, valid_sets=[valid_set],
+              feval=my_err, evals_result=res)
+    assert "my_err" in res["valid_0"]
+    assert res["valid_0"]["my_err"][-1] < 0.4
+
+
+def test_model_save_load_roundtrip(binary_data, tmp_path):
+    X_train, y_train, X_test, y_test = binary_data
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=10)
+    p_orig = bst.predict(X_test)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    p_loaded = bst2.predict(X_test)
+    np.testing.assert_allclose(p_orig, p_loaded, rtol=1e-5, atol=1e-6)
+
+
+def test_weights_change_model(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1}
+    w = np.where(y_train > 0, 10.0, 1.0).astype(np.float32)
+    b1 = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=5)
+    b2 = lgb.train(params, lgb.Dataset(X_train, y_train, weight=w),
+                   num_boost_round=5)
+    p1 = b1.predict(X_train).mean()
+    p2 = b2.predict(X_train).mean()
+    assert p2 > p1  # upweighting positives shifts predictions up
+
+
+def test_min_data_in_leaf_respected(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1,
+              "min_data_in_leaf": 200, "num_leaves": 31}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=3)
+    for t in bst._gbdt.models:
+        counts = t.leaf_count[:t.num_leaves]
+        assert (counts >= 200).all()
+
+
+def test_max_depth(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1, "max_depth": 3,
+              "num_leaves": 63}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=3)
+    for t in bst._gbdt.models:
+        assert t.leaf_depth[:t.num_leaves].max() <= 3
+        assert t.num_leaves <= 8
+
+
+def test_rollback_one_iter(binary_data):
+    X_train, y_train, X_test, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1}
+    ts = lgb.Dataset(X_train, y_train)
+    bst = lgb.train(params, ts, num_boost_round=5)
+    p5 = bst.predict(X_test, raw_score=True)
+    bst.rollback_one_iter()
+    assert bst.current_iteration() == 4
+    p4 = bst.predict(X_test, raw_score=True)
+    assert not np.allclose(p4, p5)
+
+
+def test_feature_importance(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=10)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (X_train.shape[1],)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+
+
+def test_cv(binary_data):
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1}
+    res = lgb.cv(params, lgb.Dataset(X_train, y_train), num_boost_round=10,
+                 nfold=3, stratified=True)
+    assert "binary_logloss-mean" in res
+    assert len(res["binary_logloss-mean"]) == 10
+    assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
