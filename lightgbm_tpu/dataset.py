@@ -94,23 +94,47 @@ class TrainDataset:
         self.all_bin_mappers = bin_mappers
 
         # filter trivial features (reference used_feature map, dataset.cpp)
-        self.real_feature_index = [i for i, m in enumerate(bin_mappers)
-                                   if not m.is_trivial]
-        self.feature_mappers = [bin_mappers[i] for i in self.real_feature_index]
-        self.num_features = len(self.real_feature_index)
+        real_feature_index = [i for i, m in enumerate(bin_mappers)
+                              if not m.is_trivial]
+        feature_mappers = [bin_mappers[i] for i in real_feature_index]
+        if not feature_mappers:
+            raise ValueError("no usable (non-trivial) features in data")
+
+        max_nb = max(m.num_bin for m in feature_mappers)
+        bins = np.empty((n, len(feature_mappers)),
+                        np.uint8 if max_nb <= 256 else np.int32)
+        for j, (real, mapper) in enumerate(
+                zip(real_feature_index, feature_mappers)):
+            bins[:, j] = mapper.value_to_bin(data[:, real])
+        self._finish_init(bins, bin_mappers, real_feature_index,
+                          data.shape[1], metadata)
+
+    def _init_from_binned(self, bins: np.ndarray, bin_mappers,
+                          num_total_features: int, metadata: Metadata,
+                          config: Config) -> None:
+        """Init from a pre-binned matrix (binary cache load, reference
+        DatasetLoader::LoadFromBinFile)."""
+        self.num_total_features = num_total_features
+        self.metadata = metadata
+        self.config = config
+        self.all_bin_mappers = bin_mappers
+        real_feature_index = [i for i, m in enumerate(bin_mappers)
+                              if not m.is_trivial]
+        self._finish_init(np.asarray(bins), bin_mappers, real_feature_index,
+                          num_total_features, metadata)
+
+    def _finish_init(self, bins, bin_mappers, real_feature_index,
+                     num_total_features, metadata) -> None:
+        self.real_feature_index = real_feature_index
+        self.feature_mappers = [bin_mappers[i] for i in real_feature_index]
+        self.num_features = len(real_feature_index)
         if self.num_features == 0:
             raise ValueError("no usable (non-trivial) features in data")
-        self.num_data = n
+        self.num_data = bins.shape[0]
 
         nbins = np.asarray([m.num_bin for m in self.feature_mappers], np.int32)
         self.max_num_bins = int(nbins.max())
-        bins = np.empty((n, self.num_features),
-                        np.uint8 if self.max_num_bins <= 256 else np.int32)
-        for j, (real, mapper) in enumerate(
-                zip(self.real_feature_index, self.feature_mappers)):
-            bins[:, j] = mapper.value_to_bin(data[:, real])
         self.bins = bins
-
         self.num_bins_per_feature = jnp.asarray(nbins)
         self.has_missing_per_feature = jnp.asarray(
             np.asarray([m.missing_bin is not None for m in self.feature_mappers]))

@@ -104,8 +104,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             break
         if should_stop:
             break
-    if not finished_early and evals_result:
-        booster.best_iteration = booster.current_iteration()
+    if not finished_early:
+        if evals_result:
+            booster.best_iteration = booster.current_iteration()
+        # final metrics -> best_score (reference engine.py fills best_score
+        # from the last evaluation when no early stopping fired)
+        for item in (evaluation_result_list if nbr > 0 else []):
+            booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
     return booster
 
 

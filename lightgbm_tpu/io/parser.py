@@ -26,7 +26,12 @@ def detect_format(path: str) -> str:
             line = line.strip()
             if not line:
                 continue
-            tokens = line.split("\t") if "\t" in line else line.split(",")
+            if "\t" in line:
+                tokens = line.split("\t")
+            elif "," in line:
+                tokens = line.split(",")
+            else:
+                tokens = line.split()
             if any(":" in t for t in tokens[1:]):
                 return "libsvm"
             if "\t" in line:

@@ -1,0 +1,247 @@
+"""Plotting utilities (reference python-package/lightgbm/plotting.py).
+
+plot_importance / plot_split_value_histogram / plot_metric use matplotlib;
+plot_tree / create_tree_digraph use graphviz.  All imports are deferred so the
+package works without either library installed.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .log import LightGBMError
+
+__all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
+           "plot_tree", "create_tree_digraph"]
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, Booster):
+        return booster
+    if hasattr(booster, "booster_"):
+        return booster.booster_
+    raise TypeError("booster must be Booster or LGBMModel.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple] = None, ylim: Optional[Tuple] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """Bar chart of feature importances (reference plotting.py plot_importance)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance.")
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = bst.feature_importance(importance_type=importance_type)
+    feature_name = bst.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if importance_type == "gain" else str(int(x)),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with @index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs):
+    """Histogram of a feature's split thresholds across the model
+    (reference plotting.py plot_split_value_histogram)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError(
+            "You must install matplotlib to plot split value histogram.")
+    bst = _to_booster(booster)
+    feature_names = bst.feature_name()
+    if isinstance(feature, str):
+        fidx = feature_names.index(feature)
+    else:
+        fidx = int(feature)
+    models = bst._gbdt.models if bst._gbdt else bst._loaded_trees
+    values = []
+    for t in models:
+        ni = t.num_leaves - 1
+        for node in range(ni):
+            if t.split_feature[node] == fidx and \
+                    not (t.decision_type[node] & 1):
+                values.append(t.threshold[node])
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    centres = (bin_edges[:-1] + bin_edges[1:]) / 2
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centres, hist, align="center",
+           width=width_coef * (bin_edges[1] - bin_edges[0]), **kwargs)
+    if title:
+        title = title.replace("@feature@", str(feature)).replace(
+            "@index/name@", "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names: Optional[list] = None, ax=None,
+                xlim=None, ylim=None, title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "auto",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot metric curves recorded by record_evaluation / fit eval
+    (reference plotting.py plot_metric)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric.")
+    if isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif hasattr(booster, "evals_result_"):
+        eval_results = deepcopy(booster.evals_result_)
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    msets = eval_results[names[0]]
+    if metric is None:
+        metric = next(iter(msets.keys()))
+    for name in names:
+        if metric not in eval_results[name]:
+            continue
+        results = eval_results[name][metric]
+        ax.plot(range(len(results)), results, label=name)
+    ax.legend(loc="best")
+    if title:
+        ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric if ylabel == "auto" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _tree_to_graph(tree_json: Dict, feature_names, precision: int,
+                   orientation: str, **kwargs):
+    from graphviz import Digraph
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+
+    def fmt(v):
+        return f"{v:.{precision}f}" if isinstance(v, float) else str(v)
+
+    def add(node: Dict, parent: Optional[str] = None, decision=None):
+        if "split_index" in node:
+            name = f"split{node['split_index']}"
+            fidx = node["split_feature"]
+            fname = (feature_names[fidx] if feature_names else f"Column_{fidx}")
+            label = (f"{fname} {node['decision_type']} "
+                     f"{fmt(node['threshold'])}\n"
+                     f"gain: {fmt(node['split_gain'])}")
+            graph.node(name, label=label, shape="rectangle")
+            add(node["left_child"], name, "yes")
+            add(node["right_child"], name, "no")
+        else:
+            name = f"leaf{node['leaf_index']}"
+            label = (f"leaf {node['leaf_index']}: "
+                     f"{fmt(node['leaf_value'])}\n"
+                     f"count: {node.get('leaf_count', 0)}")
+            graph.node(name, label=label)
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    add(tree_json["tree_structure"])
+    return graph
+
+
+def create_tree_digraph(booster, tree_index: int = 0, precision: int = 3,
+                        orientation: str = "horizontal", **kwargs):
+    """Graphviz digraph of one tree (reference plotting.py create_tree_digraph)."""
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree.")
+    bst = _to_booster(booster)
+    model = bst.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError("tree_index is out of range.")
+    return _tree_to_graph(model["tree_info"][tree_index],
+                          model.get("feature_names"), precision, orientation,
+                          **kwargs)
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              precision: int = 3, orientation: str = "horizontal", **kwargs):
+    """Render one tree into a matplotlib axis (reference plotting.py plot_tree)."""
+    try:
+        import matplotlib.image as mpimg
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree.")
+    import io
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                precision=precision, orientation=orientation,
+                                **kwargs)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    s = io.BytesIO(graph.pipe(format="png"))
+    img = mpimg.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

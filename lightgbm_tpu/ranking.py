@@ -1,0 +1,276 @@
+"""Learning-to-rank objectives: lambdarank and rank_xendcg.
+
+TPU-native equivalent of the reference ranking objectives
+(src/objective/rank_objective.hpp: RankingObjective :25, LambdarankNDCG :98,
+RankXENDCG :285).  The reference parallelizes with one OpenMP thread per
+query over ragged per-query arrays; here queries are padded to a fixed
+``[num_queries, max_query_len]`` layout and the pairwise lambda computation is
+one vmapped dense ``[M, M]`` masked pass per query — MXU/VPU-friendly, no
+ragged control flow.  Queries are processed in fixed-size chunks via
+``lax.map`` to bound the O(M^2) intermediate memory.
+
+Behavioral parity notes (vs rank_objective.hpp):
+- sigmoid table (:252 ConstructSigmoidTable) is unnecessary — the VPU
+  evaluates the exact sigmoid; the table is a CPU-only trick.
+- label_gain = 2^label - 1 and discount 1/log2(2+pos) as in
+  src/metric/dcg_calculator.cpp:33-52.
+- truncation: only pairs whose better-scored member sits above
+  ``lambdarank_truncation_level`` contribute (:168-172 loop bounds).
+- lambdarank_norm: ΔNDCG /= (0.01 + |Δscore|) when query scores are not all
+  equal, plus the log2(1+Σλ)/Σλ final rescale (:201-208).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .objectives import ObjectiveFunction
+
+__all__ = ["LambdarankNDCG", "RankXENDCG", "make_query_layout"]
+
+_K_EPS = 1e-15
+# process queries in chunks to bound the [CHUNK, M, M] pairwise intermediate
+_TARGET_CHUNK_ELEMS = 1 << 24  # ~16M f32 elements ≈ 64 MB
+
+
+def make_query_layout(query_boundaries: np.ndarray):
+    """Padded [Q, M] index layout for per-query vectorized ops."""
+    sizes = np.diff(query_boundaries)
+    Q = len(sizes)
+    M = int(sizes.max()) if Q else 1
+    idx = np.full((Q, M), -1, np.int64)
+    for q in range(Q):
+        lo, hi = query_boundaries[q], query_boundaries[q + 1]
+        idx[q, : hi - lo] = np.arange(lo, hi)
+    valid = idx >= 0
+    return np.where(valid, idx, 0).astype(np.int32), valid
+
+
+def _max_dcg_at_k(labels: np.ndarray, k: int, label_gain: np.ndarray) -> float:
+    """Ideal DCG over the top-k labels (reference
+    DCGCalculator::CalMaxDCGAtK, dcg_calculator.cpp:55)."""
+    top = np.sort(labels)[::-1][:k]
+    if len(top) == 0:
+        return 0.0
+    disc = 1.0 / np.log2(2.0 + np.arange(len(top)))
+    return float((label_gain[top.astype(np.int64)] * disc).sum())
+
+
+class _RankingBase(ObjectiveFunction):
+    """Shared query layout plumbing (reference RankingObjective,
+    rank_objective.hpp:25)."""
+
+    def init(self, metadata, num_data):
+        if metadata.query_boundaries is None:
+            raise ValueError(
+                f"{self.name} objective requires query information "
+                "(set group= on the Dataset); reference "
+                "RankingObjective::Init raises the same")
+        qb = np.asarray(metadata.query_boundaries)
+        self.num_queries = len(qb) - 1
+        pad_idx, pad_valid = make_query_layout(qb)
+        self.pad_idx = jnp.asarray(pad_idx)
+        self.pad_valid = jnp.asarray(pad_valid)
+        self.max_query_len = pad_idx.shape[1]
+        label = np.asarray(metadata.label)
+        if label.min() < 0:
+            raise ValueError("ranking labels must be non-negative integers")
+        self._label_np = label
+        self.labels_pad = jnp.asarray(
+            np.where(pad_valid, label[pad_idx], 0.0).astype(np.float32))
+        self.num_data = num_data
+        # chunk size bounding [C, M, M] pairwise buffers
+        m = max(self.max_query_len, 1)
+        self.chunk = max(1, min(self.num_queries,
+                                _TARGET_CHUNK_ELEMS // (m * m)))
+
+    def _scatter_back(self, lam_pad, hess_pad, weight):
+        n = self.num_data
+        flat_idx = self.pad_idx.reshape(-1)
+        vmask = self.pad_valid.reshape(-1)
+        lam = jnp.zeros((n,), lam_pad.dtype).at[flat_idx].add(
+            jnp.where(vmask, lam_pad.reshape(-1), 0.0))
+        hess = jnp.zeros((n,), hess_pad.dtype).at[flat_idx].add(
+            jnp.where(vmask, hess_pad.reshape(-1), 0.0))
+        if weight is not None:
+            # reference RankingObjective::GetGradients weights both terms
+            lam = lam * weight
+            hess = hess * weight
+        return lam, hess
+
+    def boost_from_score(self, label, weight, class_id=0):
+        return 0.0
+
+    def _pad_queries(self, arr_pad):
+        """Pad Q up to a multiple of the chunk size for lax.map."""
+        q = arr_pad.shape[0]
+        rem = (-q) % self.chunk
+        if rem:
+            pad_width = ((0, rem),) + ((0, 0),) * (arr_pad.ndim - 1)
+            arr_pad = jnp.pad(arr_pad, pad_width)
+        return arr_pad.reshape((-1, self.chunk) + arr_pad.shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=("sigmoid", "trunc", "norm"))
+def _lambdarank_pad(scores, labels, valid, inv_max_dcg, gains, sigmoid,
+                    trunc, norm):
+    """All-queries lambdarank gradients on padded [Q, M] arrays."""
+
+    def one_query(s, lab, v, imd, gain):
+        m = s.shape[0]
+        neg_inf = jnp.asarray(-jnp.inf, s.dtype)
+        s_valid = jnp.where(v, s, neg_inf)
+        order = jnp.argsort(-s_valid, stable=True)      # sorted positions
+        rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+        disc = 1.0 / jnp.log2(2.0 + rank.astype(s.dtype))
+
+        best = jnp.max(jnp.where(v, s, -jnp.inf))
+        worst = jnp.min(jnp.where(v, s, jnp.inf))
+
+        lab_a = lab[:, None]
+        lab_b = lab[None, :]
+        pair_valid = (v[:, None] & v[None, :] & (lab_a > lab_b)
+                      & (jnp.minimum(rank[:, None], rank[None, :]) < trunc))
+
+        ds = s[:, None] - s[None, :]                    # high - low score
+        dcg_gap = gain[:, None] - gain[None, :]
+        paired_disc = jnp.abs(disc[:, None] - disc[None, :])
+        delta_ndcg = dcg_gap * paired_disc * imd
+        if norm:
+            delta_ndcg = jnp.where(best != worst,
+                                   delta_ndcg / (0.01 + jnp.abs(ds)),
+                                   delta_ndcg)
+        p_lambda = 1.0 / (1.0 + jnp.exp(sigmoid * ds))
+        p_hess = p_lambda * (1.0 - p_lambda)
+        lam_pair = jnp.where(pair_valid,
+                             -sigmoid * delta_ndcg * p_lambda, 0.0)
+        hess_pair = jnp.where(pair_valid,
+                              sigmoid * sigmoid * delta_ndcg * p_hess, 0.0)
+        # row a is the high side (+), col b the low side (-)
+        lam = lam_pair.sum(axis=1) - lam_pair.sum(axis=0)
+        hess = hess_pair.sum(axis=1) + hess_pair.sum(axis=0)
+        sum_lambdas = -2.0 * lam_pair.sum()
+        if norm:
+            factor = jnp.where(sum_lambdas > 0,
+                               jnp.log2(1.0 + sum_lambdas)
+                               / jnp.maximum(sum_lambdas, _K_EPS), 1.0)
+            lam = lam * factor
+            hess = hess * factor
+        return lam, hess
+
+    return jax.vmap(one_query)(scores, labels, valid, inv_max_dcg, gains)
+
+
+class LambdarankNDCG(_RankingBase):
+    """Pairwise NDCG-weighted lambdas (reference LambdarankNDCG,
+    rank_objective.hpp:98)."""
+    name = "lambdarank"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0:
+            raise ValueError("sigmoid param must be greater than zero")
+        self.norm = bool(config.lambdarank_norm)
+        self.trunc = int(config.lambdarank_truncation_level)
+        self.label_gain = np.asarray(config.label_gain, np.float64)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self._label_np.max() >= len(self.label_gain):
+            raise ValueError(
+                f"label {int(self._label_np.max())} exceeds label_gain size "
+                f"{len(self.label_gain)} (reference DCGCalculator::CheckLabel)")
+        qb = np.asarray(metadata.query_boundaries)
+        inv = np.zeros(self.num_queries)
+        for q in range(self.num_queries):
+            md = _max_dcg_at_k(self._label_np[qb[q]:qb[q + 1]].astype(np.int64),
+                               self.trunc, self.label_gain)
+            inv[q] = 1.0 / md if md > 0 else 0.0
+        self.inv_max_dcg = jnp.asarray(inv.astype(np.float32))
+        gains_np = self.label_gain[
+            np.asarray(self.labels_pad).astype(np.int64)]
+        self.gains_pad = jnp.asarray(gains_np.astype(np.float32))
+
+    def get_gradients(self, score, label, weight):
+        s_pad = score[self.pad_idx]
+        q = self.num_queries
+
+        if not hasattr(self, "_chunked_static"):
+            # iteration-invariant inputs, chunked once
+            self._chunked_static = (self._pad_queries(self.labels_pad),
+                                    self._pad_queries(self.pad_valid),
+                                    self._pad_queries(self.inv_max_dcg),
+                                    self._pad_queries(self.gains_pad))
+        sc = self._pad_queries(s_pad)
+        lc, vc, ic, gc = self._chunked_static
+
+        def chunk_fn(args):
+            s, lab, v, imd, g = args
+            return _lambdarank_pad(s, lab, v, imd, g, self.sigmoid,
+                                   self.trunc, self.norm)
+
+        lam_c, hess_c = jax.lax.map(chunk_fn, (sc, lc, vc, ic, gc))
+        lam_pad = lam_c.reshape(-1, self.max_query_len)[:q]
+        hess_pad = hess_c.reshape(-1, self.max_query_len)[:q]
+        return self._scatter_back(lam_pad, hess_pad, weight)
+
+    def to_string(self):
+        return "lambdarank"
+
+
+@jax.jit
+def _xendcg_pad(scores, labels, valid, gammas):
+    """All-queries XE-NDCG gradients on padded [Q, M] arrays
+    (reference RankXENDCG::GetGradientsForOneQuery, rank_objective.hpp:301)."""
+
+    def one_query(s, lab, v, gamma):
+        cnt = v.sum()
+        neg_inf = jnp.asarray(-jnp.inf, s.dtype)
+        rho = jax.nn.softmax(jnp.where(v, s, neg_inf))
+        rho = jnp.where(v, rho, 0.0)
+        phi = jnp.where(v, jnp.exp2(jnp.floor(lab)) - gamma, 0.0)
+        inv_denom = 1.0 / jnp.maximum(phi.sum(), _K_EPS)
+        # third-order approximation of the XE-NDCG gradient (arXiv:1911.09798)
+        l1 = -phi * inv_denom + rho
+        p1 = jnp.where(v, l1 / jnp.maximum(1.0 - rho, _K_EPS), 0.0)
+        l2 = rho * (p1.sum() - p1)
+        p2 = jnp.where(v, l2 / jnp.maximum(1.0 - rho, _K_EPS), 0.0)
+        lam = l1 + l2 + rho * (p2.sum() - p2)
+        hess = rho * (1.0 - rho)
+        small = cnt <= 1
+        lam = jnp.where(v & ~small, lam, 0.0)
+        hess = jnp.where(v & ~small, hess, 0.0)
+        return lam, hess
+
+    return jax.vmap(one_query)(scores, labels, valid, gammas)
+
+
+class RankXENDCG(_RankingBase):
+    """Listwise cross-entropy NDCG surrogate (reference RankXENDCG,
+    rank_objective.hpp:285; arXiv:1911.09798)."""
+    name = "rank_xendcg"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.seed = int(config.objective_seed)
+        self._call_count = 0
+
+    def get_gradients(self, score, label, weight):
+        s_pad = score[self.pad_idx]
+        # fresh per-item gammas each iteration (reference draws from one
+        # persistent RNG stream per query)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                 self._call_count)
+        self._call_count += 1
+        gammas = jax.random.uniform(key, s_pad.shape, s_pad.dtype)
+        lam_pad, hess_pad = _xendcg_pad(s_pad, self.labels_pad,
+                                        self.pad_valid, gammas)
+        return self._scatter_back(lam_pad, hess_pad, weight)
+
+    def to_string(self):
+        return "rank_xendcg"

@@ -1,0 +1,463 @@
+"""scikit-learn estimator API.
+
+Mirrors the reference python-package/lightgbm/sklearn.py surface
+(LGBMModel :349, LGBMRegressor :839, LGBMClassifier :865, LGBMRanker :986)
+including the objective/eval-function wrappers (:17,106) that translate
+sklearn-style ``func(y_true, y_pred)`` signatures into the native
+``(grad, hess)`` / ``(name, value, is_higher_better)`` protocols.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import early_stopping as _early_stopping_cb
+from .callback import log_evaluation as _log_evaluation_cb
+from .config import resolve_aliases
+from .engine import train as _train
+from .log import LightGBMError
+
+try:  # graceful degradation when scikit-learn is absent
+    from sklearn.base import BaseEstimator as _SKBase
+    from sklearn.base import ClassifierMixin as _SKClassifier
+    from sklearn.base import RegressorMixin as _SKRegressor
+    _SKLEARN_INSTALLED = True
+except ImportError:  # pragma: no cover
+    class _SKBase:  # minimal stand-in
+        def get_params(self, deep=True):
+            import inspect
+            sig = inspect.signature(self.__init__)
+            return {k: getattr(self, k) for k in sig.parameters
+                    if k not in ("self", "kwargs")}
+
+        def set_params(self, **params):
+            for k, v in params.items():
+                setattr(self, k, v)
+            return self
+
+    class _SKClassifier:
+        pass
+
+    class _SKRegressor:
+        pass
+    _SKLEARN_INSTALLED = False
+
+__all__ = ["LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"]
+
+
+class _ObjectiveFunctionWrapper:
+    """Translate sklearn-style objective ``func(y_true, y_pred[, weight|group])``
+    into the native fobj ``(preds, dataset) -> (grad, hess)`` protocol
+    (reference sklearn.py:17-105)."""
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = self.func(labels, preds)
+        elif argc == 3:
+            grad, hess = self.func(labels, preds, dataset.get_weight())
+        elif argc == 4:
+            grad, hess = self.func(labels, preds, dataset.get_weight(),
+                                   dataset.get_group())
+        else:
+            raise TypeError(
+                f"self-defined objective takes 2-4 arguments, got {argc}")
+        return grad, hess
+
+
+class _EvalFunctionWrapper:
+    """Translate sklearn-style metric ``func(y_true, y_pred[, weight|group])``
+    into the native feval protocol (reference sklearn.py:106-200)."""
+
+    def __init__(self, func: Callable):
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, preds)
+        if argc == 3:
+            return self.func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return self.func(labels, preds, dataset.get_weight(),
+                             dataset.get_group())
+        raise TypeError(
+            f"self-defined eval function takes 2-4 arguments, got {argc}")
+
+
+class LGBMModel(_SKBase):
+    """Base sklearn estimator (reference LGBMModel, sklearn.py:349)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[Any] = None,
+                 class_weight: Optional[Any] = None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_score: Dict = {}
+        self._best_iteration = -1
+        self._objective = objective
+        self._n_features = -1
+        self._n_classes = -1
+        self.fitted_ = False
+
+    # -- param plumbing ---------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = super().get_params(deep=deep)
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, f"_{key}"):
+                setattr(self, f"_{key}", value)
+            self._other_params[key] = value
+        return self
+
+    def _process_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("objective", None)
+        for k in ("class_weight", "importance_type", "n_estimators", "n_jobs"):
+            params.pop(k, None)
+        # sklearn-name -> native-name translation
+        ren = {"subsample": "bagging_fraction",
+               "subsample_freq": "bagging_freq",
+               "colsample_bytree": "feature_fraction",
+               "min_split_gain": "min_gain_to_split",
+               "min_child_weight": "min_sum_hessian_in_leaf",
+               "min_child_samples": "min_data_in_leaf",
+               "reg_alpha": "lambda_l1",
+               "reg_lambda": "lambda_l2",
+               "subsample_for_bin": "bin_construct_sample_cnt",
+               "random_state": "seed"}
+        out = {}
+        for k, v in params.items():
+            out[ren.get(k, k)] = v
+        if out.get("seed") is None:
+            out.pop("seed", None)
+        if out.get("bagging_freq") == 0 and out.get("bagging_fraction", 1.0) < 1.0:
+            out["bagging_freq"] = 1
+        obj = self.objective
+        if callable(obj):
+            self._fobj = _ObjectiveFunctionWrapper(obj)
+            out["objective"] = "none"
+        else:
+            self._fobj = None
+            if obj is not None:
+                out["objective"] = obj
+        out["boosting_type"] = self.boosting_type
+        return out
+
+    # -- fit --------------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose="warn",
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None, init_model=None) -> "LGBMModel":
+        self._objective = self.objective
+        params = self._process_params()
+        if "objective" not in params and not callable(self.objective):
+            params["objective"] = self._default_objective()
+
+        y_proc, sample_weight = self._process_label(y, sample_weight)
+        params = self._extend_params_for_label(params)
+
+        evals_result: Dict = {}
+        feval = None
+        if eval_metric is not None:
+            mets = eval_metric if isinstance(eval_metric, list) else [eval_metric]
+            str_m = [m for m in mets if isinstance(m, str)]
+            fn_m = [_EvalFunctionWrapper(m) for m in mets if callable(m)]
+            if str_m:
+                params["metric"] = str_m
+            if fn_m:
+                feval = fn_m
+
+        train_set = Dataset(X, label=y_proc, weight=sample_weight, group=group,
+                            init_score=init_score, params=params,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature,
+                            free_raw_data=False)
+        valid_sets: List[Dataset] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+
+                def _at(lst, i):
+                    return lst[i] if lst is not None and len(lst) > i else None
+
+                if vx is X and vy is y:
+                    valid_sets.append(train_set)
+                    continue
+                vy_proc, vw = self._process_label(
+                    np.asarray(vy), _at(eval_sample_weight, i), fit=False,
+                    class_weight=_at(eval_class_weight, i))
+                valid_sets.append(Dataset(
+                    vx, label=vy_proc, weight=vw, group=_at(eval_group, i),
+                    init_score=_at(eval_init_score, i), reference=train_set,
+                    params=params))
+
+        callbacks = list(callbacks or [])
+        if early_stopping_rounds is not None and early_stopping_rounds > 0:
+            callbacks.append(_early_stopping_cb(early_stopping_rounds,
+                                                verbose=bool(verbose)))
+        if verbose not in ("warn", False, None) and int(bool(verbose)):
+            callbacks.append(_log_evaluation_cb(
+                1 if verbose is True else int(verbose)))
+
+        self._Booster = _train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=eval_names,
+            feval=feval, fobj=self._fobj, init_model=init_model,
+            callbacks=callbacks, evals_result=evals_result)
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        self._n_features = train_set.num_feature()
+        self._objective = params.get("objective")
+        self.fitted_ = True
+        return self
+
+    # hooks specialized per estimator ------------------------------------
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _process_label(self, y, sample_weight, fit=True,
+                       class_weight="__train__"):
+        y = np.asarray(y).reshape(-1)
+        if class_weight == "__train__":
+            # eval sets get their own eval_class_weight (or none), never the
+            # training class_weight (reference sklearn.py _get_weight_from_
+            # constructed_dataset semantics)
+            class_weight = self.class_weight if fit else None
+        if class_weight is not None:
+            if isinstance(class_weight, str):  # 'balanced'
+                from sklearn.utils.class_weight import compute_sample_weight
+                w = compute_sample_weight(class_weight, y)
+            else:
+                w = np.ones(len(y), np.float64)
+                for cls, cw in class_weight.items():
+                    w[y == cls] = cw
+            sample_weight = (w if sample_weight is None
+                             else w * np.asarray(sample_weight))
+        return y, sample_weight
+
+    def _extend_params_for_label(self, params):
+        return params
+
+    # -- predict ----------------------------------------------------------
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        self._check_fitted()
+        return self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=-1 if num_iteration is None else num_iteration,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs)
+
+    def _check_fitted(self):
+        if self._Booster is None:
+            raise LightGBMError(
+                "Estimator not fitted, call fit before exploiting the model.")
+
+    # -- attributes (reference sklearn.py properties) ---------------------
+    @property
+    def n_features_(self) -> int:
+        self._check_fitted()
+        return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        self._check_fitted()
+        return self._n_features
+
+    @property
+    def best_score_(self) -> Dict:
+        self._check_fitted()
+        return self._best_score
+
+    @property
+    def best_iteration_(self) -> int:
+        self._check_fitted()
+        return self._best_iteration
+
+    @property
+    def objective_(self):
+        self._check_fitted()
+        return self._objective
+
+    @property
+    def booster_(self) -> Booster:
+        self._check_fitted()
+        return self._Booster
+
+    @property
+    def evals_result_(self) -> Dict:
+        self._check_fitted()
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.feature_importance(
+            importance_type=self.importance_type)
+
+    @property
+    def feature_name_(self) -> List[str]:
+        self._check_fitted()
+        return self._Booster.feature_name()
+
+
+class LGBMRegressor(LGBMModel, _SKRegressor):
+    """LightGBM regressor (reference LGBMRegressor, sklearn.py:839)."""
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel, _SKClassifier):
+    """LightGBM classifier (reference LGBMClassifier, sklearn.py:865)."""
+
+    def _default_objective(self) -> str:
+        return "binary"
+
+    def _process_label(self, y, sample_weight, fit=True,
+                       class_weight="__train__"):
+        y = np.asarray(y).reshape(-1)
+        if (np.issubdtype(y.dtype, np.number)
+                and np.array_equal(self._classes, np.arange(self._n_classes))):
+            enc = y.astype(np.float64)
+        else:
+            enc = np.asarray([self._class_map[v] for v in y], np.float64)
+        return super()._process_label(enc, sample_weight, fit, class_weight)
+
+    def _extend_params_for_label(self, params):
+        if self._n_classes > 2:
+            obj = params.get("objective", "binary")
+            if obj in ("binary", None):
+                params["objective"] = "multiclass"
+            if params.get("objective") in ("multiclass", "multiclassova"):
+                params["num_class"] = self._n_classes
+        return params
+
+    def _default_objective_multiclass(self):
+        return "multiclass"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y).reshape(-1)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        self._class_map = {c: i for i, c in enumerate(self._classes)}
+        return super().fit(X, y, **kwargs)
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        result = self.predict_proba(X, raw_score, start_iteration,
+                                    num_iteration, pred_leaf, pred_contrib,
+                                    **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:  # binary probabilities
+            idx = (result > 0.5).astype(int)
+        else:
+            idx = np.argmax(result, axis=1)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False,
+                      start_iteration: int = 0,
+                      num_iteration: Optional[int] = None,
+                      pred_leaf: bool = False, pred_contrib: bool = False,
+                      **kwargs):
+        self._check_fitted()
+        result = self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=-1 if num_iteration is None else num_iteration,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes <= 2 and np.ndim(result) == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    @property
+    def classes_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        self._check_fitted()
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """LightGBM ranker (reference LGBMRanker, sklearn.py:986)."""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            eval_at=(1, 2, 3, 4, 5), early_stopping_rounds=None,
+            verbose="warn", feature_name="auto",
+            categorical_feature="auto", callbacks=None, init_model=None):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if eval_set is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set is not None")
+        self._eval_at = list(eval_at)
+        extra = {"eval_at": list(eval_at)}
+        self._other_params.update(extra)
+        setattr(self, "eval_at", list(eval_at))
+        return super().fit(
+            X, y, sample_weight=sample_weight, init_score=init_score,
+            group=group, eval_set=eval_set, eval_names=eval_names,
+            eval_sample_weight=eval_sample_weight,
+            eval_init_score=eval_init_score, eval_group=eval_group,
+            eval_metric=eval_metric,
+            early_stopping_rounds=early_stopping_rounds, verbose=verbose,
+            feature_name=feature_name, categorical_feature=categorical_feature,
+            callbacks=callbacks, init_model=init_model)

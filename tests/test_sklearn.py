@@ -1,0 +1,170 @@
+"""sklearn-estimator API tests (modeled on reference
+tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_classifier_binary(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=30, num_leaves=31)
+    clf.fit(X_train, y_train)
+    acc = (clf.predict(X_test) == y_test).mean()
+    assert acc > 0.7
+    proba = clf.predict_proba(X_test)
+    assert proba.shape == (len(y_test), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert clf.n_classes_ == 2
+    assert set(clf.classes_) == set(np.unique(y_train))
+    assert clf.n_features_ == X_train.shape[1]
+    assert clf.feature_importances_.shape == (X_train.shape[1],)
+
+
+def test_classifier_multiclass():
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=600, n_features=10, n_classes=3,
+                               n_informative=6, random_state=7)
+    clf = lgb.LGBMClassifier(n_estimators=20)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 3)
+    assert (clf.predict(X) == y).mean() > 0.8
+
+
+def test_classifier_string_labels():
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=300, n_features=8, random_state=3)
+    ys = np.where(y == 1, "spam", "ham")
+    clf = lgb.LGBMClassifier(n_estimators=10)
+    clf.fit(X, ys)
+    pred = clf.predict(X)
+    assert set(pred) <= {"spam", "ham"}
+    assert (pred == ys).mean() > 0.8
+
+
+def test_regressor(regression_data):
+    X_train, y_train, X_test, y_test = regression_data
+    reg = lgb.LGBMRegressor(n_estimators=40, num_leaves=31)
+    reg.fit(X_train, y_train,
+            eval_set=[(X_test, y_test)], eval_metric="l2")
+    pred = reg.predict(X_test)
+    mse = np.mean((pred - y_test) ** 2)
+    base = np.mean((y_test.mean() - y_test) ** 2)
+    assert mse < base * 0.8
+    assert "valid_0" in reg.evals_result_
+    assert "l2" in reg.evals_result_["valid_0"]
+
+
+def test_regressor_early_stopping(regression_data):
+    X_train, y_train, X_test, y_test = regression_data
+    reg = lgb.LGBMRegressor(n_estimators=100, learning_rate=0.3)
+    reg.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+            early_stopping_rounds=5, verbose=False)
+    assert reg.best_iteration_ > 0
+    assert ("valid_0", ) and reg.best_score_
+
+
+def test_ranker(rank_data):
+    X_train, y_train, q_train, X_test, y_test, q_test = rank_data
+    rk = lgb.LGBMRanker(n_estimators=20)
+    rk.fit(X_train, y_train, group=q_train,
+           eval_set=[(X_test, y_test)], eval_group=[q_test],
+           eval_at=(1, 3))
+    pred = rk.predict(X_test)
+    assert pred.shape == (len(y_test),)
+    with pytest.raises(ValueError):
+        lgb.LGBMRanker().fit(X_train, y_train)  # no group
+
+
+def test_custom_objective(regression_data):
+    X_train, y_train, _, _ = regression_data
+
+    def l2_obj(y_true, y_pred):
+        return (y_pred - y_true), np.ones_like(y_true)
+
+    reg = lgb.LGBMRegressor(n_estimators=20, objective=l2_obj)
+    reg.fit(X_train, y_train)
+    ref = lgb.LGBMRegressor(n_estimators=20)
+    ref.fit(X_train, y_train)
+    # custom L2 ~ built-in L2 (boost_from_average differs; compare deltas)
+    p1 = reg.predict(X_train) + y_train.mean()
+    p2 = ref.predict(X_train)
+    assert np.corrcoef(p1, p2)[0, 1] > 0.99
+
+
+def test_custom_eval_metric(binary_data):
+    X_train, y_train, X_test, y_test = binary_data
+
+    def err(y_true, y_pred):
+        return "custom_err", float(np.mean((y_pred > 0.5) != y_true)), False
+
+    clf = lgb.LGBMClassifier(n_estimators=10)
+    clf.fit(X_train, y_train, eval_set=[(X_test, y_test)], eval_metric=err)
+    assert "custom_err" in clf.evals_result_["valid_0"]
+
+
+def test_sklearn_integration():
+    from sklearn.model_selection import GridSearchCV, cross_val_score
+    from sklearn.datasets import make_classification
+    X, y = make_classification(n_samples=200, n_features=6, random_state=1)
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    scores = cross_val_score(clf, X, y, cv=3)
+    assert scores.mean() > 0.6
+    gs = GridSearchCV(lgb.LGBMClassifier(n_estimators=5),
+                      {"num_leaves": [7, 15]}, cv=2)
+    gs.fit(X, y)
+    assert gs.best_params_["num_leaves"] in (7, 15)
+
+
+def test_clone_and_params():
+    from sklearn.base import clone
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=9, min_child_samples=4)
+    p = clf.get_params()
+    assert p["num_leaves"] == 9 and p["min_child_samples"] == 4
+    c2 = clone(clf)
+    assert c2.get_params()["num_leaves"] == 9
+
+
+def test_binary_cache_roundtrip(tmp_path, binary_data):
+    X_train, y_train, _, _ = binary_data
+    ds = lgb.Dataset(X_train, label=y_train, free_raw_data=False)
+    ds.construct()
+    f = str(tmp_path / "cache.bin")
+    ds.save_binary(f)
+    ds2 = lgb.Dataset.from_binary(f)
+    assert ds2.num_data() == ds.num_data()
+    assert ds2.num_feature() == ds.num_feature()
+    b1 = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                   num_boost_round=5)
+    b2 = lgb.train({"objective": "binary", "verbosity": -1}, ds2,
+                   num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X_train[:100]),
+                               b2.predict(X_train[:100]), rtol=1e-5)
+
+
+def test_plotting_importance(binary_data):
+    pytest.importorskip("matplotlib")
+    import matplotlib
+    matplotlib.use("Agg")
+    X_train, y_train, _, _ = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    clf.fit(X_train, y_train)
+    ax = lgb.plot_importance(clf)
+    assert ax is not None
+    ax2 = lgb.plot_split_value_histogram(clf, 0)
+    assert ax2 is not None
+
+
+def test_plot_metric(binary_data):
+    pytest.importorskip("matplotlib")
+    import matplotlib
+    matplotlib.use("Agg")
+    X_train, y_train, X_test, y_test = binary_data
+    clf = lgb.LGBMClassifier(n_estimators=5)
+    clf.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+            eval_metric="binary_logloss")
+    ax = lgb.plot_metric(clf)
+    assert ax is not None
