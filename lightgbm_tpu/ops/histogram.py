@@ -83,7 +83,9 @@ def _pick_impl(impl: str) -> str:
     if impl != "auto":
         return impl
     backend = jax.default_backend()
-    return "segment" if backend == "cpu" else "onehot"
+    if backend == "cpu":
+        return "segment"
+    return "pallas" if backend == "tpu" else "onehot"
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "impl", "chunk"))

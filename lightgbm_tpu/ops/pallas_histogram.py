@@ -1,14 +1,127 @@
-"""Pallas TPU histogram kernel (tuned replacement for ops/histogram.py's
-XLA one-hot matmul; reference analogue: ocl/histogram256.cl:317 and
-kernels/histogram_16_64_256.cu).  Falls back to the one-hot path until the
-tuned kernel lands."""
+"""Pallas TPU histogram kernel — the hot op of GBDT training.
+
+TPU-native replacement for the reference's histogram kernels
+(src/io/dense_bin.hpp:99 ConstructHistogramInner on CPU,
+src/treelearner/ocl/histogram256.cl:317 on GPU,
+src/treelearner/kernels/histogram_16_64_256.cu on CUDA).
+
+TPUs have no cheap random-access scatter, so the per-row bin update is
+reformulated as a one-hot contraction on the MXU — but unlike the plain XLA
+``einsum`` path (ops/histogram.py), this kernel:
+
+- keeps each feature-group's ``[fg, B, C]`` accumulator resident in VMEM
+  across the whole row loop (the XLA scan round-trips the full histogram
+  through HBM every chunk);
+- works in a feature-major ``[F, N]`` layout: rows ride the 128-wide lane
+  dimension, and the one-hot operand is a single ``[fg*B, chunk]`` matmul
+  operand per (chunk, group) grid step;
+- is specialized per bin width (16/64/256) through static shapes, mirroring
+  the reference GPU kernels' 16/64/256 variants;
+- streams ``bins`` chunks HBM->VMEM through the grid pipeline (double
+  buffered by Pallas automatically).
+
+The contraction dtype is configurable: f32 (default — matches the reference
+GPU single-precision histograms, docs/GPU-Performance.rst:88) or bf16 inputs
+with f32 accumulation (``hist_dtype="bfloat16"``, ~2x MXU rate; the reference
+exposes the same trade-off inverted as ``gpu_use_dp``).
+"""
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["build_histogram_pallas", "build_histogram_pallas_tr"]
+
+
+def _pick_tiles(f: int, b: int, itemsize: int):
+    """(row_chunk, feature_group): keep the one-hot operand ~<=4MB VMEM.
+
+    fg must be a multiple of 8 (TPU sublane granularity); the row chunk must
+    be a multiple of 128 (lane granularity).
+    """
+    fg = 8
+    budget = 4 * 1024 * 1024
+    chunk = max(128, (budget // (fg * b * itemsize)) // 128 * 128)
+    return chunk, fg
+
+
+def _hist_kernel(bins_ref, w_ref, out_ref, *, num_bins: int, acc_dtype):
+    """One (row-chunk, feature-group) grid step.
+
+    bins_ref: [fg, chunk] int32 — this group's bin ids for this row chunk.
+    w_ref: [chunk, C] f32 — per-row channel weights.
+    out_ref: [fg, B, C] f32 — revisited accumulator for this group.
+    """
+    step = pl.program_id(1)  # row-chunk index — innermost (reduction) dim
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    fg, chunk = bins_ref.shape
+    c = w_ref.shape[1]
+    blk = bins_ref[...]
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (fg, num_bins, chunk), 1)
+    onehot = (bin_ids == blk[:, None, :]).astype(acc_dtype)   # [fg, B, chunk]
+    part = jax.lax.dot_general(
+        onehot.reshape(fg * num_bins, chunk), w_ref[...].astype(acc_dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [fg*B, C]
+    out_ref[...] += part.reshape(fg, num_bins, c)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "hist_dtype"))
+def build_histogram_pallas_tr(bins_tr: jnp.ndarray, weights: jnp.ndarray,
+                              num_bins: int,
+                              hist_dtype: str = "float32") -> jnp.ndarray:
+    """[F, N] int bins x [N, C] f32 weights -> [F, B, C] f32 histogram."""
+    f, n = bins_tr.shape
+    c = weights.shape[1]
+    acc_dtype = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
+
+    chunk, fg = _pick_tiles(f, num_bins, jnp.dtype(acc_dtype).itemsize)
+    pad = (-n) % chunk
+    fpad = (-f) % fg
+    if pad or fpad:
+        # padded rows/features land in bin 0 with weight 0 / get sliced off
+        bins_tr = jnp.pad(bins_tr, ((0, fpad), (0, pad)))
+        weights = jnp.pad(weights, ((0, pad), (0, 0)))
+    nchunks = (n + pad) // chunk
+    fp = f + fpad
+
+    kernel = functools.partial(_hist_kernel, num_bins=num_bins,
+                               acc_dtype=acc_dtype)
+    # row-chunk (reduction) dim is INNERMOST so each group's accumulator
+    # block stays resident in VMEM across its whole row loop
+    hist = pl.pallas_call(
+        kernel,
+        grid=(fp // fg, nchunks),
+        in_specs=[
+            pl.BlockSpec((fg, chunk), lambda g, i: (g, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, c), lambda g, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((fg, num_bins, c), lambda g, i: (g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fp, num_bins, c), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * (n + pad) * fp * num_bins * c,
+            bytes_accessed=(n + pad) * (fp * 4 + c * 4),
+            transcendentals=0),
+        interpret=(jax.default_backend() == "cpu"),
+    )(bins_tr.astype(jnp.int32), weights)
+    return hist[:f]
 
 
 def build_histogram_pallas(bins: jnp.ndarray, weights: jnp.ndarray,
-                           num_bins: int) -> jnp.ndarray:
-    from .histogram import _onehot_impl
-    return _onehot_impl(bins, weights, num_bins)
+                           num_bins: int,
+                           hist_dtype: str = "float32") -> jnp.ndarray:
+    """[N, F] row-major wrapper around the feature-major kernel."""
+    return build_histogram_pallas_tr(bins.T, weights, num_bins,
+                                     hist_dtype=hist_dtype)

@@ -19,7 +19,7 @@ def naive_histogram(bins, weights, num_bins):
     return out
 
 
-@pytest.mark.parametrize("impl", ["segment", "onehot"])
+@pytest.mark.parametrize("impl", ["segment", "onehot", "pallas"])
 def test_histogram_matches_naive(impl):
     rng = np.random.RandomState(0)
     n, f, b = 500, 7, 16
@@ -31,7 +31,7 @@ def test_histogram_matches_naive(impl):
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("impl", ["segment", "onehot"])
+@pytest.mark.parametrize("impl", ["segment", "onehot", "pallas"])
 def test_histogram_nondivisible_chunk(impl):
     rng = np.random.RandomState(1)
     n, f, b = 4097, 3, 256  # forces padding in the chunked onehot path
