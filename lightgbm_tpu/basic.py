@@ -446,6 +446,10 @@ class Booster:
     # -- model io ---------------------------------------------------------
     def model_to_string(self, num_iteration: int = -1,
                         start_iteration: int = 0) -> str:
+        # like the reference, default to best_iteration when early stopping
+        # fired (python-package basic.py save_model num_iteration=None)
+        if num_iteration < 0 and self.best_iteration > 0:
+            num_iteration = self.best_iteration
         if self._gbdt is not None:
             return self._gbdt.save_model_to_string(start_iteration,
                                                    num_iteration)
@@ -468,6 +472,8 @@ class Booster:
         return self
 
     def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
+        if num_iteration < 0 and self.best_iteration > 0:
+            num_iteration = self.best_iteration
         models = (self._gbdt.models if self._gbdt else self._loaded_trees)
         k = self.num_model_per_iteration()
         trees = self._trees_for_range(start_iteration, num_iteration) \

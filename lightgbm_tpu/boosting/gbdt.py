@@ -241,6 +241,8 @@ class GBDT:
             lc = state.left_child
             rc = state.right_child
             n_leaves = state.n_leaves
+            icn, clm = ((state.node_is_cat, state.node_cat_mask)
+                        if tree.num_cat > 0 else (None, None))
         else:
             ni = tree.num_leaves - 1
             pad = self._L - 1
@@ -250,10 +252,14 @@ class GBDT:
             lc = jnp.asarray(_padded(tree.left_child[:ni], pad), jnp.int32)
             rc = jnp.asarray(_padded(tree.right_child[:ni], pad), jnp.int32)
             n_leaves = jnp.int32(tree.num_leaves)
+            icn = clm = None
+            if tree.num_cat > 0:
+                icn, clm = self._tree_cat_masks(tree, pad)
         leaf_idx = traverse_binned(sf, tb, dl, lc, rc, n_leaves, bins,
                                    ds.num_bins_per_feature,
                                    ds.has_missing_per_feature,
-                                   max_steps=self._L)
+                                   max_steps=self._L,
+                                   is_cat_node=icn, cat_left_mask=clm)
         leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
         return score.at[cls].add(leaf_vals[leaf_idx])
 
@@ -262,6 +268,27 @@ class GBDT:
                enumerate(self.train_data.real_feature_index)}
         ni = tree.num_leaves - 1
         return np.asarray([inv[f] for f in tree.split_feature[:ni]], np.int32)
+
+    def _tree_cat_masks(self, tree: Tree, pad: int):
+        """Bin-space left-masks for a tree's categorical nodes, reconstructed
+        from the raw-category bitsets via the train mappers (works for loaded
+        models too, where only the raw bitset exists)."""
+        ds = self.train_data
+        B = ds.max_num_bins
+        inv = {real: inner for inner, real in enumerate(ds.real_feature_index)}
+        ni = tree.num_leaves - 1
+        masks = np.zeros((pad, B), bool)
+        is_cat = np.zeros((pad,), bool)
+        for node in range(ni):
+            if not (tree.decision_type[node] & 1):
+                continue
+            is_cat[node] = True
+            mapper = ds.feature_mappers[inv[tree.split_feature[node]]]
+            cats = np.asarray(mapper.bin_2_categorical, np.int64)
+            if len(cats):
+                in_set = tree._cat_in_bitset(node, cats, False)
+                masks[node, 1:1 + len(cats)] = in_set
+        return jnp.asarray(is_cat), jnp.asarray(masks)
 
     # ------------------------------------------------------------------
     def eval(self) -> Dict[str, List[tuple]]:

@@ -143,7 +143,8 @@ def predict_trees(stacked: StackedTrees, X: jnp.ndarray,
 @functools.partial(jax.jit, static_argnames=("max_steps",))
 def traverse_binned(split_feature, threshold_bin, default_left, left_child,
                     right_child, n_leaves, bins, num_bins_f, has_missing_f,
-                    max_steps: int) -> jnp.ndarray:
+                    max_steps: int, is_cat_node=None,
+                    cat_left_mask=None) -> jnp.ndarray:
     """Leaf index per row for ONE freshly-grown tree, in bin space.
 
     Used for incremental validation-set score updates (reference
@@ -164,6 +165,11 @@ def traverse_binned(split_feature, threshold_bin, default_left, left_child,
         is_missing = has_missing_f[feat] & (fbin == missing_bin)
         go_left = jnp.where(is_missing, default_left[nd],
                             fbin <= threshold_bin[nd])
+        if is_cat_node is not None:
+            # categorical: bin-space bitset lookup (Tree::CategoricalDecision
+            # in bin space, tree.h:368)
+            go_left = jnp.where(is_cat_node[nd], cat_left_mask[nd, fbin],
+                                go_left)
         child = jnp.where(go_left, left_child[nd], right_child[nd])
         return jnp.where(internal, child, node)
 

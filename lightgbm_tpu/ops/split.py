@@ -41,6 +41,8 @@ class SplitResult(NamedTuple):
     right_count: jnp.ndarray
     left_output: jnp.ndarray
     right_output: jnp.ndarray
+    is_cat: jnp.ndarray          # bool: categorical subset split
+    cat_mask: jnp.ndarray        # [B] bool: bins going LEFT (cat splits only)
 
 
 def _threshold_l1(s, l1):
@@ -75,8 +77,27 @@ def find_best_split(
     max_delta_step,
     monotone: Optional[jnp.ndarray] = None,   # [F] int8 in {-1,0,1}
     output_lo: jnp.ndarray = None, output_hi: jnp.ndarray = None,
+    is_cat_f: Optional[jnp.ndarray] = None,   # [F] bool, None = no cats (static)
+    cat_l2: float = 10.0, cat_smooth: float = 10.0,
+    max_cat_threshold: int = 32, max_cat_to_onehot: int = 4,
+    min_data_per_group: float = 100.0,
 ) -> SplitResult:
-    """Scan all candidate splits of one leaf, return the argmax candidate."""
+    """Scan all candidate splits of one leaf, return the argmax candidate.
+
+    Candidate "directions" (leading axis of the scan tensor):
+      0: numerical, missing -> right     1: numerical, missing -> left
+      2: categorical one-hot (bin == t goes left)
+      3: categorical sorted-subset, ascending-prefix of grad/hess order
+      4: categorical sorted-subset, descending-prefix
+    Categorical scans mirror FindBestThresholdCategoricalInner
+    (feature_histogram.hpp:278): sort candidate bins by
+    sum_g/(sum_h+cat_smooth), take prefixes from both ends capped at
+    max_cat_threshold and (used+1)/2 bins, with l2+cat_l2 regularization.
+    Deviation (documented): the sequential ``cnt_cur_group`` accumulator is
+    approximated by requiring both children to hold >= min_data_per_group
+    rows; bin 0 (missing/other) always stays right so the raw-category
+    bitset round-trips through the model file exactly.
+    """
     f, b, _ = hist.shape
     bins = jnp.arange(b, dtype=jnp.int32)
 
@@ -93,15 +114,54 @@ def find_best_split(
     # direction B: missing -> left.   left = cum[t] + missing bin stats
     left_b = cum + miss_stats[:, None, :]
     left = jnp.stack([left_a, left_b], axis=0)          # [2, F, B, 3]
-    right = total[None, None, None, :] - left
 
+    num_valid = bins[None, None, :] < (num_bins_f[None, :, None] - 1)
+
+    use_cats = is_cat_f is not None
+    n_dirs = 5 if use_cats else 2
+    # one-hot (dir 2) uses plain l2, subset scans use l2+cat_l2 (reference
+    # feature_histogram.hpp:312,384 `l2 += cat_l2` only in the non-onehot path)
+    l2_list = [l2, l2, l2, l2 + cat_l2, l2 + cat_l2] if use_cats else [l2, l2]
+    l2_per_dir = jnp.asarray(l2_list, hist.dtype).reshape(-1, 1, 1)
+
+    if use_cats:
+        g_fb, h_fb, c_fb = hist[..., 0], hist[..., 1], hist[..., 2]
+        cat_bin_ok = (bins[None, :] >= 1) & (bins[None, :] < num_bins_f[:, None])
+        use_onehot_f = num_bins_f <= max_cat_to_onehot          # [F]
+
+        # -- sorted-subset order (reference: include bins with count >=
+        #    cat_smooth, sort by g/(h+cat_smooth) ascending)
+        include = cat_bin_ok & (c_fb >= cat_smooth)
+        score = jnp.where(include, g_fb / (h_fb + cat_smooth), jnp.inf)
+        order = jnp.argsort(score, axis=1)                      # [F, B]
+        rank = jnp.argsort(order, axis=1).astype(jnp.int32)     # bin -> position
+        n_used = include.sum(axis=1).astype(jnp.int32)          # [F]
+        sorted_hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+        pos = bins[None, :]                                     # [F, B] prefix pos
+        sorted_hist = jnp.where((pos < n_used[:, None])[:, :, None],
+                                sorted_hist, 0.0)
+        asc_cum = jnp.cumsum(sorted_hist, axis=1)               # prefix pos+1 bins
+        total_inc = asc_cum[:, -1:, :]                          # [F, 1, 3]
+        # descending prefix of length p = included total - ascending prefix of
+        # length (n_used - p)
+        comp_idx = jnp.clip(n_used[:, None] - pos - 2, 0, b - 1)
+        comp = jnp.take_along_axis(asc_cum, comp_idx[:, :, None], axis=1)
+        desc_left = jnp.where((pos + 1 < n_used[:, None])[:, :, None],
+                              total_inc - comp, total_inc)
+
+        max_num_cat = jnp.minimum(max_cat_threshold, (n_used + 1) // 2)  # [F]
+
+        left = jnp.concatenate([left, hist[None], asc_cum[None],
+                                desc_left[None]], axis=0)       # [5, F, B, 3]
+
+    right = total[None, None, None, :] - left
     lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
     rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
 
-    l_out = leaf_output(lg, lh, l1, l2, max_delta_step)
-    r_out = leaf_output(rg, rh, l1, l2, max_delta_step)
-    gain = (leaf_gain(lg, lh, l1, l2, max_delta_step) +
-            leaf_gain(rg, rh, l1, l2, max_delta_step))
+    l_out = leaf_output(lg, lh, l1, l2_per_dir, max_delta_step)
+    r_out = leaf_output(rg, rh, l1, l2_per_dir, max_delta_step)
+    gain = (leaf_gain(lg, lh, l1, l2_per_dir, max_delta_step) +
+            leaf_gain(rg, rh, l1, l2_per_dir, max_delta_step))
 
     parent_gain = leaf_gain(sum_g, sum_h, l1, l2, max_delta_step)
     improvement = gain - parent_gain - min_gain_to_split
@@ -110,10 +170,27 @@ def find_best_split(
     valid = (lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)
     valid &= (lc > 0) & (rc > 0)
     valid &= (lh >= min_sum_hessian) & (rh >= min_sum_hessian)
-    # threshold must leave at least one bin on the right (t <= num_bin-2);
-    # degenerate candidates (e.g. direction B with everything left) are
-    # already removed by the count>0 masks
-    valid &= bins[None, None, :] < (num_bins_f[None, :, None] - 1)
+
+    if use_cats:
+        is_cat_row = is_cat_f[None, :, None]
+        dir_idx = jnp.arange(n_dirs).reshape(-1, 1, 1)
+        # numerical dirs only on numerical features; threshold must leave at
+        # least one bin right (t <= num_bin-2)
+        dir_valid = jnp.where(dir_idx < 2, ~is_cat_row & num_valid, True)
+        # one-hot: cat features with few bins; t must be a real category bin
+        onehot_ok = is_cat_row & use_onehot_f[None, :, None] & cat_bin_ok[None]
+        dir_valid &= jnp.where(dir_idx == 2, onehot_ok, True)
+        # sorted-subset: prefix length p=pos+1 within n_used and max_num_cat
+        p = bins[None, None, :] + 1
+        subset_ok = (is_cat_row & ~use_onehot_f[None, :, None]
+                     & (p <= n_used[None, :, None])
+                     & (p <= max_num_cat[None, :, None])
+                     & (lc >= min_data_per_group) & (rc >= min_data_per_group))
+        dir_valid &= jnp.where(dir_idx >= 3, subset_ok, True)
+        valid &= dir_valid
+    else:
+        valid &= num_valid
+
     valid &= feature_mask[None, :, None]
 
     if monotone is not None:
@@ -136,6 +213,20 @@ def find_best_split(
     def pick(arr):
         return arr.reshape(-1)[best]
 
+    if use_cats:
+        best_rank = rank[feat]                                  # [B]
+        best_used = n_used[feat]
+        cat_mask = jnp.where(
+            dir_i == 2, bins == thr,
+            jnp.where(dir_i == 3, best_rank <= thr,
+                      (best_rank >= best_used - (thr + 1))
+                      & (best_rank < best_used)))
+        is_cat = dir_i >= 2
+        cat_mask = cat_mask & is_cat
+    else:
+        is_cat = jnp.asarray(False)
+        cat_mask = jnp.zeros((b,), bool)
+
     found = best_gain > K_EPSILON
     return SplitResult(
         gain=jnp.where(found, best_gain, _NEG_INF),
@@ -145,4 +236,5 @@ def find_best_split(
         left_sum_g=pick(lg), left_sum_h=pick(lh), left_count=pick(lc),
         right_sum_g=pick(rg), right_sum_h=pick(rh), right_count=pick(rc),
         left_output=pick(l_out), right_output=pick(r_out),
+        is_cat=is_cat, cat_mask=cat_mask,
     )

@@ -64,14 +64,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
             shard_map,
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
-                      P(), P(), P(), P(), P()),          # feature meta + rng
+                      P(), P(), P(), P(), P(), P()),     # feature meta + rng
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
             )._replace(row_leaf=P(ax)),
             check_vma=False)
-        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key):
+        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf):
             return grow_tree(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
-                             mono, key)
+                             mono, key, icf)
 
         return sharded
 
@@ -92,7 +92,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
             self.num_bins_rep, self.has_missing_rep,
             jax.device_put(self.feature_mask(), self._rep_sharding),
             jax.device_put(self.monotone, self._rep_sharding),
-            jax.device_put(key, self._rep_sharding))
+            jax.device_put(key, self._rep_sharding),
+            jax.device_put(self.is_cat_f, self._rep_sharding))
         if self.pad:
             state = state._replace(row_leaf=state.row_leaf[:self.dataset.num_data])
         return state

@@ -279,6 +279,12 @@ class Tree:
         if t.num_cat > 0:
             t.cat_boundaries = [int(float(x)) for x in kv["cat_boundaries"].split()]
             t.cat_threshold = [int(float(x)) for x in kv["cat_threshold"].split()]
+            # categorical nodes store the cat-slot index in `threshold`
+            # (reference tree.cpp ToString/Tree(const char*) round-trip)
+            cat_nodes = (t.decision_type[:ni] & K_CATEGORICAL_MASK) != 0
+            t.threshold_in_bin[:ni] = np.where(
+                cat_nodes, t.threshold[:ni].astype(np.int32),
+                t.threshold_in_bin[:ni])
         t.shrinkage_ = float(kv.get("shrinkage", 1.0))
         t.is_linear = bool(int(kv.get("is_linear", 0)))
         # rebuild leaf_parent and leaf_depth by walking from the root

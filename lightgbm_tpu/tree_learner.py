@@ -53,6 +53,13 @@ class GrowerConfig(NamedTuple):
     hist_impl: str = "auto"
     feature_fraction_bynode: float = 1.0
     axis_name: Optional[str] = None   # set under shard_map for data-parallel
+    # categorical splits (compile-time gate: no overhead when dataset has none)
+    use_categorical: bool = False
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
 
 
 class TreeState(NamedTuple):
@@ -68,6 +75,8 @@ class TreeState(NamedTuple):
     best_right: jnp.ndarray      # [L, 3]
     best_left_out: jnp.ndarray   # [L]
     best_right_out: jnp.ndarray  # [L]
+    best_is_cat: jnp.ndarray     # [L] bool
+    best_cat_mask: jnp.ndarray   # [L, B] bool: bins going left
     # per-leaf current stats
     leaf_value: jnp.ndarray      # [L]
     leaf_sum: jnp.ndarray        # [L, 3]
@@ -83,6 +92,8 @@ class TreeState(NamedTuple):
     internal_value: jnp.ndarray  # [L-1]
     internal_weight: jnp.ndarray  # [L-1]
     internal_count: jnp.ndarray  # [L-1]
+    node_is_cat: jnp.ndarray     # [L-1] bool
+    node_cat_mask: jnp.ndarray   # [L-1, B] bool
 
 
 def _child_weights(grad_m, hess_m, mask, left_m, right_m):
@@ -94,12 +105,17 @@ def _child_weights(grad_m, hess_m, mask, left_m, right_m):
 
 
 def _scan_leaf(hist, sums, depth, cfg: GrowerConfig, num_bins_f, has_missing_f,
-               feature_mask, monotone) -> SplitResult:
+               feature_mask, monotone, is_cat_f=None) -> SplitResult:
     res = find_best_split(
         hist, sums[0], sums[1], sums[2], num_bins_f, has_missing_f,
         feature_mask, cfg.lambda_l1, cfg.lambda_l2, cfg.min_data_in_leaf,
         cfg.min_sum_hessian_in_leaf, cfg.min_gain_to_split,
-        cfg.max_delta_step, monotone)
+        cfg.max_delta_step, monotone,
+        is_cat_f=is_cat_f if cfg.use_categorical else None,
+        cat_l2=cfg.cat_l2, cat_smooth=cfg.cat_smooth,
+        max_cat_threshold=cfg.max_cat_threshold,
+        max_cat_to_onehot=cfg.max_cat_to_onehot,
+        min_data_per_group=cfg.min_data_per_group)
     if cfg.max_depth > 0:
         res = res._replace(gain=jnp.where(depth >= cfg.max_depth,
                                           _NEG_INF, res.gain))
@@ -118,6 +134,8 @@ def _store_best(state: TreeState, leaf, res: SplitResult) -> TreeState:
             jnp.stack([res.right_sum_g, res.right_sum_h, res.right_count])),
         best_left_out=state.best_left_out.at[leaf].set(res.left_output),
         best_right_out=state.best_right_out.at[leaf].set(res.right_output),
+        best_is_cat=state.best_is_cat.at[leaf].set(res.is_cat),
+        best_cat_mask=state.best_cat_mask.at[leaf].set(res.cat_mask),
     )
 
 
@@ -133,6 +151,7 @@ def grow_tree(cfg: GrowerConfig,
               feature_mask: jnp.ndarray,  # [F] bool, per-tree col sample
               monotone: jnp.ndarray,      # [F] int8
               rng_key: jnp.ndarray,       # for per-node feature sampling
+              is_cat_f: Optional[jnp.ndarray] = None,  # [F] bool
               ) -> TreeState:
     """Grow one tree; returns the final TreeState (all device arrays)."""
     n, f = bins.shape
@@ -165,8 +184,11 @@ def grow_tree(cfg: GrowerConfig,
     root_sums = root_hist[0].sum(axis=0)  # feature 0's bins cover every row once
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
+    if is_cat_f is None:
+        is_cat_f = jnp.zeros((f,), bool)
     root_res = _scan_leaf(root_hist, root_sums, jnp.int32(0), cfg, num_bins_f,
-                          has_missing_f, node_feature_mask(0), monotone)
+                          has_missing_f, node_feature_mask(0), monotone,
+                          is_cat_f)
 
     fdt = grad.dtype
     state = TreeState(
@@ -180,6 +202,8 @@ def grow_tree(cfg: GrowerConfig,
         best_right=jnp.zeros((L, 3), fdt),
         best_left_out=jnp.zeros((L,), fdt),
         best_right_out=jnp.zeros((L,), fdt),
+        best_is_cat=jnp.zeros((L,), bool),
+        best_cat_mask=jnp.zeros((L, B), bool),
         leaf_value=jnp.zeros((L,), fdt).at[0].set(root_out),
         leaf_sum=jnp.zeros((L, 3), fdt).at[0].set(root_sums),
         leaf_depth=jnp.zeros((L,), jnp.int32),
@@ -193,6 +217,8 @@ def grow_tree(cfg: GrowerConfig,
         internal_value=jnp.zeros((L - 1,), fdt),
         internal_weight=jnp.zeros((L - 1,), fdt),
         internal_count=jnp.zeros((L - 1,), fdt),
+        node_is_cat=jnp.zeros((L - 1,), bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
     )
     state = _store_best(state, 0, root_res)
 
@@ -213,6 +239,10 @@ def grow_tree(cfg: GrowerConfig,
             missing_bin = num_bins_f[feat] - 1
             is_missing = has_missing_f[feat] & (fcol == missing_bin)
             go_left = jnp.where(is_missing, dleft, fcol <= thr)
+            if cfg.use_categorical:
+                split_cat = state.best_is_cat[best_leaf]
+                cat_mask = state.best_cat_mask[best_leaf]
+                go_left = jnp.where(split_cat, cat_mask[fcol], go_left)
             in_leaf = state.row_leaf == best_leaf
             row_leaf = jnp.where(in_leaf & ~go_left, new_leaf, state.row_leaf)
 
@@ -239,6 +269,10 @@ def grow_tree(cfg: GrowerConfig,
                 split_feature=state.split_feature.at[node].set(feat),
                 threshold_bin=state.threshold_bin.at[node].set(thr),
                 default_left=state.default_left.at[node].set(dleft),
+                node_is_cat=state.node_is_cat.at[node].set(
+                    state.best_is_cat[best_leaf]),
+                node_cat_mask=state.node_cat_mask.at[node].set(
+                    state.best_cat_mask[best_leaf]),
                 split_gain=state.split_gain.at[node].set(gain),
                 internal_value=state.internal_value.at[node].set(
                     state.leaf_value[best_leaf]),
@@ -267,9 +301,11 @@ def grow_tree(cfg: GrowerConfig,
 
             fmask = node_feature_mask(step + 1)
             res_l = _scan_leaf(hist_l, new_state.leaf_sum[best_leaf], depth,
-                               cfg, num_bins_f, has_missing_f, fmask, monotone)
+                               cfg, num_bins_f, has_missing_f, fmask, monotone,
+                               is_cat_f)
             res_r = _scan_leaf(hist_r, new_state.leaf_sum[new_leaf], depth,
-                               cfg, num_bins_f, has_missing_f, fmask, monotone)
+                               cfg, num_bins_f, has_missing_f, fmask, monotone,
+                               is_cat_f)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
             return new_state
@@ -305,19 +341,48 @@ def state_to_tree(state: TreeState, feature_meta, real_feature_map=None) -> Tree
     t.leaf_parent[:n_leaves] = np.asarray(state.leaf_parent[:n_leaves])
     t.leaf_depth[:n_leaves] = np.asarray(state.leaf_depth[:n_leaves])
     dflt = np.asarray(state.default_left[:ni])
-    from .binning import MissingType
-    from .tree import K_DEFAULT_LEFT_MASK
+    node_is_cat = np.asarray(state.node_is_cat[:ni])
+    node_cat_mask = np.asarray(state.node_cat_mask[:ni])
+    from .tree import K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK
+    t.cat_boundaries_inner = [0]
+    t.cat_threshold_inner = []
     for node in range(ni):
         fi = int(sf_inner[node])
         mapper = feature_meta[fi]
         t.split_feature[node] = (real_feature_map[fi]
                                  if real_feature_map is not None else fi)
-        t.threshold[node] = mapper.bin_to_value(int(t.threshold_in_bin[node]))
-        mt = {"none": 0, "zero": 1, "nan": 2}[mapper.missing_type]
-        dt = mt << 2
-        if dflt[node]:
-            dt |= K_DEFAULT_LEFT_MASK
-        t.decision_type[node] = dt
+        if node_is_cat[node]:
+            # bins going left -> bin bitset (train/valid traversal) + raw
+            # category bitset (model file / external predict), mirroring
+            # Tree::SplitCategorical's dual storage (tree.h:85)
+            left_bins = np.nonzero(node_cat_mask[node])[0]
+            nb = mapper.num_bin
+            bin_words = [0] * ((nb + 31) >> 5)
+            cats = []
+            for bb in left_bins:
+                bin_words[bb >> 5] |= 1 << (bb & 31)
+                if bb >= 1 and bb - 1 < len(mapper.bin_2_categorical):
+                    cats.append(int(mapper.bin_2_categorical[bb - 1]))
+            max_cat = max(cats) if cats else 0
+            raw_words = [0] * ((max_cat >> 5) + 1)
+            for c in cats:
+                raw_words[c >> 5] |= 1 << (c & 31)
+            t.threshold_in_bin[node] = t.num_cat
+            t.threshold[node] = t.num_cat
+            t.num_cat += 1
+            t.cat_boundaries.append(t.cat_boundaries[-1] + len(raw_words))
+            t.cat_threshold.extend(raw_words)
+            t.cat_boundaries_inner.append(t.cat_boundaries_inner[-1]
+                                          + len(bin_words))
+            t.cat_threshold_inner.extend(bin_words)
+            t.decision_type[node] = K_CATEGORICAL_MASK | (2 << 2)  # NaN missing
+        else:
+            t.threshold[node] = mapper.bin_to_value(int(t.threshold_in_bin[node]))
+            mt = {"none": 0, "zero": 1, "nan": 2}[mapper.missing_type]
+            dt = mt << 2
+            if dflt[node]:
+                dt |= K_DEFAULT_LEFT_MASK
+            t.decision_type[node] = dt
     return t
 
 
@@ -345,7 +410,14 @@ class SerialTreeLearner:
             max_delta_step=float(config.max_delta_step),
             hist_impl=config.histogram_impl,
             feature_fraction_bynode=float(config.feature_fraction_bynode),
+            use_categorical=bool(np.any(dataset.is_categorical)),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_cat_threshold=int(config.max_cat_threshold),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=float(config.min_data_per_group),
         )
+        self.is_cat_f = jnp.asarray(dataset.is_categorical.astype(bool))
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         mono = np.zeros(dataset.num_features, np.int8)
         if config.monotone_constraints:
@@ -380,5 +452,5 @@ class SerialTreeLearner:
         state = grow_tree(self.grower_cfg, ds.device_bins, grad, hess,
                           sample_mask, ds.num_bins_per_feature,
                           ds.has_missing_per_feature, self.feature_mask(),
-                          self.monotone, key)
+                          self.monotone, key, self.is_cat_f)
         return state
