@@ -1,0 +1,8 @@
+"""``python -m lightgbm_tpu`` — the CLI entry point (reference src/main.cpp)."""
+
+import sys
+
+from .application import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,207 @@
+"""CLI application: train / predict / convert_model / refit / save_binary.
+
+TPU-native counterpart of the reference CLI (src/main.cpp:11,
+src/application/application.cpp:31-271): same conf-file + key=value
+parameter surface, same task dispatch, driving the JAX engine instead of
+the C++ boosting stack.  Run as ``python -m lightgbm_tpu config=train.conf``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+from .log import log_info
+
+__all__ = ["Application", "main"]
+
+
+def _parse_args(argv: List[str]) -> Dict[str, str]:
+    """key=value args; `config=FILE` merges the conf file (cmdline wins),
+    mirroring Application::LoadParameters (application.cpp:52-85)."""
+    cmdline: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise ValueError(f"unrecognized argument {a!r} (expected key=value)")
+        k, v = a.split("=", 1)
+        cmdline[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    conf = cmdline.get("config")
+    if conf:
+        with open(conf) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                params[k.strip()] = v.strip()
+    params.update(cmdline)
+    params.pop("config", None)
+    return params
+
+
+def _load_side_file(path: str) -> Optional[np.ndarray]:
+    """Optional .weight / .query companion files (reference Metadata
+    loads `<data>.weight` and `<data>.query`, src/io/metadata.cpp)."""
+    if os.path.exists(path):
+        return np.loadtxt(path, dtype=np.float64, ndmin=1)
+    return None
+
+
+class Application:
+    """Parse params once, then Run() dispatches on config.task
+    (reference application.cpp:31; Run at include/LightGBM/application.h)."""
+
+    def __init__(self, argv: List[str]):
+        self.raw_params = _parse_args(argv)
+        self.config = Config(self.raw_params)
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        task = self.config.task
+        if task in ("train", "refit"):
+            self._train(refit=(task == "refit"))
+        elif task in ("predict", "prediction", "test"):
+            self._predict()
+        elif task == "convert_model":
+            self._convert_model()
+        elif task == "save_binary":
+            self._save_binary()
+        else:
+            raise ValueError(f"unknown task {task!r}")
+
+    # ------------------------------------------------------------------
+    def _load_xy(self, path: str):
+        from .io.parser import load_svmlight_or_csv
+        label_idx = 0
+        lc = str(self.config.label_column)
+        if lc and lc not in ("", "auto"):
+            if lc.startswith("name:"):
+                raise NotImplementedError("label_column=name: needs header "
+                                          "ingestion; use column index")
+            label_idx = int(lc)
+        X, y = load_svmlight_or_csv(path, label_idx=label_idx,
+                                    header=bool(self.config.header))
+        return X, y
+
+    def _build_dataset(self, path: str):
+        from .basic import Dataset
+        X, y = self._load_xy(path)
+        weight = _load_side_file(path + ".weight")
+        group = _load_side_file(path + ".query")
+        ds = Dataset(X, label=y, weight=weight,
+                     group=group.astype(np.int64) if group is not None else None,
+                     params=self.raw_params)
+        return ds, X, y
+
+    def _train(self, refit: bool = False) -> None:
+        from . import callback as cb
+        from .basic import Booster
+        from .engine import train
+
+        if not self.config.data:
+            raise ValueError("task=train requires data=FILE")
+        train_set, X, y = self._build_dataset(self.config.data)
+
+        valid_sets, valid_names = [], []
+        for i, v in enumerate(p for p in str(self.config.valid).split(",") if p):
+            Xv, yv = self._load_xy(v)
+            wv = _load_side_file(v + ".weight")
+            gv = _load_side_file(v + ".query")
+            valid_sets.append(train_set.create_valid(
+                Xv, label=yv, weight=wv,
+                group=gv.astype(np.int64) if gv is not None else None))
+            valid_names.append(os.path.basename(v))
+
+        out_model = self.config.output_model or "LightGBM_model.txt"
+
+        if refit:
+            if not self.config.input_model:
+                raise ValueError("task=refit requires input_model=FILE")
+            booster = Booster(model_file=self.config.input_model,
+                              train_set=train_set,
+                              params=self.raw_params)
+            booster.refit(X, y, decay_rate=self.config.refit_decay_rate)
+            booster.save_model(out_model)
+            log_info(f"Finished refit; model saved to {out_model}")
+            return
+
+        callbacks = []
+        if self.config.metric_freq > 0 and self.config.verbosity >= 0:
+            callbacks.append(cb.log_evaluation(self.config.metric_freq))
+        if self.config.snapshot_freq > 0:
+            callbacks.append(_snapshot_callback(self.config.snapshot_freq,
+                                                out_model))
+        init_model = self.config.input_model or None
+        booster = train(self.raw_params, train_set,
+                        num_boost_round=self.config.num_iterations,
+                        valid_sets=valid_sets, valid_names=valid_names,
+                        init_model=init_model, callbacks=callbacks)
+        booster.save_model(out_model)
+        log_info(f"Finished training; model saved to {out_model}")
+
+    def _predict(self) -> None:
+        from .basic import Booster
+        if not self.config.input_model:
+            raise ValueError("task=predict requires input_model=FILE")
+        if not self.config.data:
+            raise ValueError("task=predict requires data=FILE")
+        booster = Booster(model_file=self.config.input_model)
+        X, _ = self._load_xy(self.config.data)
+        out = booster.predict(
+            X,
+            start_iteration=self.config.start_iteration_predict,
+            num_iteration=self.config.num_iteration_predict,
+            raw_score=bool(self.config.predict_raw_score),
+            pred_leaf=bool(self.config.predict_leaf_index),
+            pred_contrib=bool(self.config.predict_contrib))
+        path = self.config.output_result or "LightGBM_predict_result.txt"
+        out2d = np.atleast_2d(np.asarray(out, dtype=np.float64))
+        if out2d.shape[0] == 1 and np.ndim(out) == 1:
+            out2d = out2d.T
+        np.savetxt(path, out2d, delimiter="\t", fmt="%.10g")
+        log_info(f"Finished prediction; results saved to {path}")
+
+    def _convert_model(self) -> None:
+        from .basic import Booster
+        from .convert import model_to_if_else
+        if not self.config.input_model:
+            raise ValueError("task=convert_model requires input_model=FILE")
+        booster = Booster(model_file=self.config.input_model)
+        code = model_to_if_else(booster)
+        path = self.config.convert_model or "gbdt_prediction.cpp"
+        with open(path, "w") as fh:
+            fh.write(code)
+        log_info(f"Finished converting model; code saved to {path}")
+
+    def _save_binary(self) -> None:
+        if not self.config.data:
+            raise ValueError("task=save_binary requires data=FILE")
+        ds, _, _ = self._build_dataset(self.config.data)
+        out = self.config.data + ".bin"
+        ds.save_binary(out)
+        log_info(f"Finished saving binary dataset to {out}")
+
+
+def _snapshot_callback(freq: int, out_model: str):
+    """Periodic model snapshots (reference GBDT::Train snapshot_freq,
+    gbdt.cpp:277-281)."""
+    def _cb(env):
+        it = env.iteration + 1
+        if it % freq == 0:
+            env.model.save_model(f"{out_model}.snapshot_iter_{it}")
+    _cb.order = 100
+    return _cb
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]")
+        return 1
+    Application(argv).run()
+    return 0

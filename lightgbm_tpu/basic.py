@@ -443,6 +443,61 @@ class Booster:
             return self._train_set.get_feature_names()
         return [f"Column_{i}" for i in range(self.num_feature())]
 
+    def refit(self, data, label, weight=None, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing tree structures on new data (reference
+        Booster.refit -> LGBM_BoosterRefit -> GBDT::RefitTree, gbdt.cpp:285:
+        leaf values are recomputed from the new data's gradients via
+        FitByExistingTree and blended with refit_decay_rate)."""
+        import jax.numpy as jnp
+        from .config import Config as _Config
+        from .objectives import create_objective
+
+        data = np.asarray(data, dtype=np.float64)
+        label = np.asarray(label, dtype=np.float64)
+        n = data.shape[0]
+        k = self.num_model_per_iteration()
+        trees = (self._gbdt.models if self._gbdt else self._loaded_trees)
+        if not trees:
+            raise LightGBMError("refit requires a trained model")
+
+        if self._gbdt is not None:
+            cfg = self._gbdt.config
+            obj = self._gbdt.objective
+        else:
+            params = dict(self.params)
+            obj_str = self._loaded_meta.get("objective", "regression")
+            params.setdefault("objective", obj_str.split()[0])
+            if k > 1 and "num_class" not in params:
+                params["num_class"] = k
+            cfg = _Config(params)
+            obj = create_objective(cfg)
+        l1, l2 = float(cfg.lambda_l1), float(cfg.lambda_l2)
+
+        w = (np.asarray(weight, np.float64) if weight is not None
+             else np.ones(n))
+        score = np.zeros((k, n))
+        lbl = jnp.asarray(label)
+        wgt = jnp.asarray(w)
+        n_iter = len(trees) // k
+        for it in range(n_iter):
+            sc = jnp.asarray(score[0] if k == 1 else score)
+            grad, hess = obj.get_gradients(sc, lbl, wgt)
+            grad = np.atleast_2d(np.asarray(grad))
+            hess = np.atleast_2d(np.asarray(hess))
+            for cls in range(k):
+                tree = trees[it * k + cls]
+                leaf = tree.predict_leaf_index(data)
+                nl = tree.num_leaves
+                sum_g = np.bincount(leaf, weights=grad[cls], minlength=nl)
+                sum_h = np.bincount(leaf, weights=hess[cls], minlength=nl)
+                thr_g = np.sign(sum_g) * np.maximum(np.abs(sum_g) - l1, 0.0)
+                new_out = -thr_g / (sum_h + l2 + 1e-15) * tree.shrinkage_
+                tree.leaf_value[:nl] = (decay_rate * tree.leaf_value[:nl]
+                                        + (1.0 - decay_rate) * new_out[:nl])
+                score[cls] += tree.leaf_value[leaf]
+        return self
+
     # -- model io ---------------------------------------------------------
     def model_to_string(self, num_iteration: int = -1,
                         start_iteration: int = 0) -> str:

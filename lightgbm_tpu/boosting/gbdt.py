@@ -272,7 +272,11 @@ class GBDT:
     def _tree_cat_masks(self, tree: Tree, pad: int):
         """Bin-space left-masks for a tree's categorical nodes, reconstructed
         from the raw-category bitsets via the train mappers (works for loaded
-        models too, where only the raw bitset exists)."""
+        models too, where only the raw bitset exists).  Cached on the tree —
+        masks are immutable once the tree is built."""
+        cached = getattr(tree, "_cat_mask_cache", None)
+        if cached is not None and cached[0] == pad:
+            return cached[1], cached[2]
         ds = self.train_data
         B = ds.max_num_bins
         inv = {real: inner for inner, real in enumerate(ds.real_feature_index)}
@@ -288,7 +292,9 @@ class GBDT:
             if len(cats):
                 in_set = tree._cat_in_bitset(node, cats, False)
                 masks[node, 1:1 + len(cats)] = in_set
-        return jnp.asarray(is_cat), jnp.asarray(masks)
+        out = (jnp.asarray(is_cat), jnp.asarray(masks))
+        tree._cat_mask_cache = (pad, out[0], out[1])
+        return out
 
     # ------------------------------------------------------------------
     def eval(self) -> Dict[str, List[tuple]]:

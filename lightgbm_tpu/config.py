@@ -116,6 +116,9 @@ _PARAMS: List[ParamSpec] = [
     _p("verbosity", int, 1, ("verbose",)),
     _p("input_model", str, "", ("model_input", "model_in")),
     _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
+    _p("convert_model", str, "gbdt_prediction.cpp",
+       ("convert_model_file",)),
+    _p("convert_model_language", str, "cpp", ()),
     _p("saved_feature_importance_type", int, 0),
     _p("snapshot_freq", int, -1, ("save_period",)),
     _p("linear_tree", bool, False, ("linear_trees",)),
@@ -194,6 +197,11 @@ _PARAMS: List[ParamSpec] = [
     _p("histogram_impl", str, "auto", (),
        "in:auto|onehot|segment|pallas",
        "histogram kernel implementation override"),
+    _p("grow_strategy", str, "compact", (),
+       "in:compact|dense",
+       "compact = partition-order segments + histogram subtraction "
+       "(reference DataPartition + subtraction trick); dense = full-N "
+       "masked histogram passes per split"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

@@ -30,7 +30,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     params = resolve_aliases(dict(params))
     if fobj is not None:
         params["objective"] = "none"
-    nbr = params.pop("num_iterations", num_boost_round)
+    nbr = int(params.pop("num_iterations", num_boost_round))
     if early_stopping_rounds is None:
         early_stopping_rounds = params.get("early_stopping_round", 0) or None
 

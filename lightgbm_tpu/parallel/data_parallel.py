@@ -70,8 +70,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
             )._replace(row_leaf=P(ax)),
             check_vma=False)
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf):
-            return grow_tree(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
-                             mono, key, icf)
+            from ..tree_learner import grow_tree_compact
+            grow = (grow_tree_compact
+                    if self.config.grow_strategy == "compact" else grow_tree)
+            return grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
+                        mono, key, icf)
 
         return sharded
 

@@ -122,6 +122,89 @@ def _scan_leaf(hist, sums, depth, cfg: GrowerConfig, num_bins_f, has_missing_f,
     return res
 
 
+def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
+                     root_sums) -> TreeState:
+    """Fresh single-leaf TreeState (shared by both growers)."""
+    L, B = cfg.num_leaves, cfg.num_bins
+    return TreeState(
+        row_leaf=jnp.zeros((n,), jnp.int32),
+        n_leaves=jnp.int32(1),
+        best_gain=jnp.full((L,), _NEG_INF, fdt),
+        best_feature=jnp.zeros((L,), jnp.int32),
+        best_threshold=jnp.zeros((L,), jnp.int32),
+        best_default_left=jnp.zeros((L,), bool),
+        best_left=jnp.zeros((L, 3), fdt),
+        best_right=jnp.zeros((L, 3), fdt),
+        best_left_out=jnp.zeros((L,), fdt),
+        best_right_out=jnp.zeros((L,), fdt),
+        best_is_cat=jnp.zeros((L,), bool),
+        best_cat_mask=jnp.zeros((L, B), bool),
+        leaf_value=jnp.zeros((L,), fdt).at[0].set(root_out),
+        leaf_sum=jnp.zeros((L, 3), fdt).at[0].set(root_sums),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        split_feature=jnp.zeros((L - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+        default_left=jnp.zeros((L - 1,), bool),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        split_gain=jnp.zeros((L - 1,), fdt),
+        internal_value=jnp.zeros((L - 1,), fdt),
+        internal_weight=jnp.zeros((L - 1,), fdt),
+        internal_count=jnp.zeros((L - 1,), fdt),
+        node_is_cat=jnp.zeros((L - 1,), bool),
+        node_cat_mask=jnp.zeros((L - 1, B), bool),
+    )
+
+
+def _apply_split_bookkeeping(state: TreeState, best_leaf, gain, feat, thr,
+                             dleft, split_cat, cat_mask) -> TreeState:
+    """Record split `node` in the flat tree arrays and update per-leaf stats
+    (reference Tree::Split, tree.h:62; shared by both growers).  Does NOT
+    touch row_leaf / partition structures — those are grower-specific."""
+    node = state.n_leaves - 1
+    new_leaf = state.n_leaves
+    parent = state.leaf_parent[best_leaf]
+    has_parent = parent >= 0
+    pc = jnp.maximum(parent, 0)
+    was_left = state.left_child[pc] == ~best_leaf
+    left_child = state.left_child.at[pc].set(
+        jnp.where(has_parent & was_left, node, state.left_child[pc]))
+    right_child = state.right_child.at[pc].set(
+        jnp.where(has_parent & ~was_left, node, state.right_child[pc]))
+    left_child = left_child.at[node].set(~best_leaf)
+    right_child = right_child.at[node].set(~new_leaf)
+
+    psum_w = state.leaf_sum[best_leaf]
+    depth = state.leaf_depth[best_leaf] + 1
+
+    return state._replace(
+        n_leaves=state.n_leaves + 1,
+        left_child=left_child,
+        right_child=right_child,
+        split_feature=state.split_feature.at[node].set(feat),
+        threshold_bin=state.threshold_bin.at[node].set(thr),
+        default_left=state.default_left.at[node].set(dleft),
+        node_is_cat=state.node_is_cat.at[node].set(split_cat),
+        node_cat_mask=state.node_cat_mask.at[node].set(cat_mask),
+        split_gain=state.split_gain.at[node].set(gain),
+        internal_value=state.internal_value.at[node].set(
+            state.leaf_value[best_leaf]),
+        internal_weight=state.internal_weight.at[node].set(psum_w[1]),
+        internal_count=state.internal_count.at[node].set(psum_w[2]),
+        leaf_parent=state.leaf_parent.at[best_leaf].set(node)
+                                    .at[new_leaf].set(node),
+        leaf_depth=state.leaf_depth.at[best_leaf].set(depth)
+                                   .at[new_leaf].set(depth),
+        leaf_value=state.leaf_value
+            .at[best_leaf].set(state.best_left_out[best_leaf])
+            .at[new_leaf].set(state.best_right_out[best_leaf]),
+        leaf_sum=state.leaf_sum
+            .at[best_leaf].set(state.best_left[best_leaf])
+            .at[new_leaf].set(state.best_right[best_leaf]),
+    )
+
+
 def _store_best(state: TreeState, leaf, res: SplitResult) -> TreeState:
     return state._replace(
         best_gain=state.best_gain.at[leaf].set(res.gain),
@@ -191,35 +274,7 @@ def grow_tree(cfg: GrowerConfig,
                           is_cat_f)
 
     fdt = grad.dtype
-    state = TreeState(
-        row_leaf=jnp.zeros((n,), jnp.int32),
-        n_leaves=jnp.int32(1),
-        best_gain=jnp.full((L,), _NEG_INF, fdt),
-        best_feature=jnp.zeros((L,), jnp.int32),
-        best_threshold=jnp.zeros((L,), jnp.int32),
-        best_default_left=jnp.zeros((L,), bool),
-        best_left=jnp.zeros((L, 3), fdt),
-        best_right=jnp.zeros((L, 3), fdt),
-        best_left_out=jnp.zeros((L,), fdt),
-        best_right_out=jnp.zeros((L,), fdt),
-        best_is_cat=jnp.zeros((L,), bool),
-        best_cat_mask=jnp.zeros((L, B), bool),
-        leaf_value=jnp.zeros((L,), fdt).at[0].set(root_out),
-        leaf_sum=jnp.zeros((L, 3), fdt).at[0].set(root_sums),
-        leaf_depth=jnp.zeros((L,), jnp.int32),
-        leaf_parent=jnp.full((L,), -1, jnp.int32),
-        split_feature=jnp.zeros((L - 1,), jnp.int32),
-        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-        default_left=jnp.zeros((L - 1,), bool),
-        left_child=jnp.zeros((L - 1,), jnp.int32),
-        right_child=jnp.zeros((L - 1,), jnp.int32),
-        split_gain=jnp.zeros((L - 1,), fdt),
-        internal_value=jnp.zeros((L - 1,), fdt),
-        internal_weight=jnp.zeros((L - 1,), fdt),
-        internal_count=jnp.zeros((L - 1,), fdt),
-        node_is_cat=jnp.zeros((L - 1,), bool),
-        node_cat_mask=jnp.zeros((L - 1, B), bool),
-    )
+    state = _init_tree_state(cfg, n, fdt, root_out, root_sums)
     state = _store_best(state, 0, root_res)
 
     def body(step, state: TreeState) -> TreeState:
@@ -228,11 +283,13 @@ def grow_tree(cfg: GrowerConfig,
         found = gain > K_EPSILON
 
         def do_split(state: TreeState) -> TreeState:
-            node = state.n_leaves - 1
             new_leaf = state.n_leaves
             feat = state.best_feature[best_leaf]
             thr = state.best_threshold[best_leaf]
             dleft = state.best_default_left[best_leaf]
+            split_cat = (state.best_is_cat[best_leaf]
+                         if cfg.use_categorical else jnp.asarray(False))
+            cat_mask = state.best_cat_mask[best_leaf]
 
             # -- partition (reference DataPartition::Split; here O(N) where)
             fcol = jnp.take(bins, feat, axis=1).astype(jnp.int32)
@@ -240,55 +297,14 @@ def grow_tree(cfg: GrowerConfig,
             is_missing = has_missing_f[feat] & (fcol == missing_bin)
             go_left = jnp.where(is_missing, dleft, fcol <= thr)
             if cfg.use_categorical:
-                split_cat = state.best_is_cat[best_leaf]
-                cat_mask = state.best_cat_mask[best_leaf]
                 go_left = jnp.where(split_cat, cat_mask[fcol], go_left)
             in_leaf = state.row_leaf == best_leaf
             row_leaf = jnp.where(in_leaf & ~go_left, new_leaf, state.row_leaf)
 
-            # -- tree arrays (reference Tree::Split, tree.h:62)
-            parent = state.leaf_parent[best_leaf]
-            has_parent = parent >= 0
-            pc = jnp.maximum(parent, 0)
-            was_left = state.left_child[pc] == ~best_leaf
-            left_child = state.left_child.at[pc].set(
-                jnp.where(has_parent & was_left, node, state.left_child[pc]))
-            right_child = state.right_child.at[pc].set(
-                jnp.where(has_parent & ~was_left, node, state.right_child[pc]))
-            left_child = left_child.at[node].set(~best_leaf)
-            right_child = right_child.at[node].set(~new_leaf)
-
-            psum_ = state.leaf_sum[best_leaf]
             depth = state.leaf_depth[best_leaf] + 1
-
-            new_state = state._replace(
-                row_leaf=row_leaf,
-                n_leaves=state.n_leaves + 1,
-                left_child=left_child,
-                right_child=right_child,
-                split_feature=state.split_feature.at[node].set(feat),
-                threshold_bin=state.threshold_bin.at[node].set(thr),
-                default_left=state.default_left.at[node].set(dleft),
-                node_is_cat=state.node_is_cat.at[node].set(
-                    state.best_is_cat[best_leaf]),
-                node_cat_mask=state.node_cat_mask.at[node].set(
-                    state.best_cat_mask[best_leaf]),
-                split_gain=state.split_gain.at[node].set(gain),
-                internal_value=state.internal_value.at[node].set(
-                    state.leaf_value[best_leaf]),
-                internal_weight=state.internal_weight.at[node].set(psum_[1]),
-                internal_count=state.internal_count.at[node].set(psum_[2]),
-                leaf_parent=state.leaf_parent.at[best_leaf].set(node)
-                                            .at[new_leaf].set(node),
-                leaf_depth=state.leaf_depth.at[best_leaf].set(depth)
-                                           .at[new_leaf].set(depth),
-                leaf_value=state.leaf_value
-                    .at[best_leaf].set(state.best_left_out[best_leaf])
-                    .at[new_leaf].set(state.best_right_out[best_leaf]),
-                leaf_sum=state.leaf_sum
-                    .at[best_leaf].set(state.best_left[best_leaf])
-                    .at[new_leaf].set(state.best_right[best_leaf]),
-            )
+            new_state = _apply_split_bookkeeping(
+                state, best_leaf, gain, feat, thr, dleft, split_cat,
+                cat_mask)._replace(row_leaf=row_leaf)
 
             # -- both children's histograms in ONE pass (subsumes the
             #    subtraction trick, see module docstring)
@@ -314,6 +330,236 @@ def grow_tree(cfg: GrowerConfig,
 
     state = jax.lax.fori_loop(0, L - 1, body, state)
     return state
+
+
+# ---------------------------------------------------------------------------
+# Compact (partition-order) grower
+# ---------------------------------------------------------------------------
+#
+# TPU-native equivalent of the reference's DataPartition + histogram-pool +
+# subtraction-trick pipeline (data_partition.hpp:101, serial_tree_learner.cpp
+# :311-320,418-420): rows live in a permutation `order` where every leaf owns
+# a CONTIGUOUS segment.  Per split:
+#   1. stable-partition the split leaf's segment into left|right using only
+#      cumsum + searchsorted + gather (TPU has fast gathers but slow scatters;
+#      the classic index-list Split would need a scatter),
+#   2. build the histogram of the SMALLER child only, over its now-contiguous
+#      rows gathered at a power-of-two padded size (lax.switch over size
+#      buckets keeps shapes static under jit),
+#   3. larger child = parent - smaller from a [L, F, B, 3] histogram pool —
+#      bit-for-bit the reference subtraction trick.
+# Total histogram row-work per tree drops from O(N * num_leaves) for the
+# dense masked grower to O(N * avg_depth / 2).
+
+
+def _bucket_sizes(n: int, min_bucket: int = 1024):
+    """Power-of-two padded gather sizes up to >= n."""
+    sizes = []
+    s = min_bucket
+    while s < n:
+        sizes.append(s)
+        s *= 2
+    sizes.append(s)  # >= n
+    return sizes
+
+
+def _partition_segment(order, s, k, go_left_of_rows, kp: int):
+    """Stable-partition `order[s:s+k]` by a row predicate, touching only a
+    static kp-sized window.  Returns (new order, n_left).
+
+    Scatter-free: positions are recomputed with cumulative sums and the
+    inverse permutation is materialized with searchsorted + gather
+    (reference DataPartition::Split does the same split with per-thread
+    index lists, data_partition.hpp:101).
+    """
+    seg = jax.lax.dynamic_slice(order, (s,), (kp,))
+    i = jnp.arange(kp, dtype=jnp.int32)
+    valid = i < k
+    gl = go_left_of_rows(seg) & valid
+    gr = (~gl) & valid
+    cum_l = jnp.cumsum(gl.astype(jnp.int32))
+    cum_r = jnp.cumsum(gr.astype(jnp.int32))
+    n_left = cum_l[-1]
+    li = jnp.searchsorted(cum_l, i + 1, side="left").astype(jnp.int32)
+    ri = jnp.searchsorted(cum_r, i - n_left + 1, side="left").astype(jnp.int32)
+    src = jnp.where(i < n_left, li, jnp.where(valid, ri, i))
+    new_seg = seg[jnp.clip(src, 0, kp - 1)]
+    order = jax.lax.dynamic_update_slice(order, new_seg, (s,))
+    return order, n_left
+
+
+def grow_tree_compact(cfg: GrowerConfig,
+                      bins: jnp.ndarray,          # [N, F] uint8 row-major
+                      grad: jnp.ndarray,
+                      hess: jnp.ndarray,
+                      sample_mask: jnp.ndarray,
+                      num_bins_f: jnp.ndarray,
+                      has_missing_f: jnp.ndarray,
+                      feature_mask: jnp.ndarray,
+                      monotone: jnp.ndarray,
+                      rng_key: jnp.ndarray,
+                      is_cat_f: Optional[jnp.ndarray] = None,
+                      ) -> TreeState:
+    """Grow one tree with the partition-order strategy; same TreeState out."""
+    n, f = bins.shape
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    ax = cfg.axis_name
+    fdt = grad.dtype
+
+    grad_m = grad * sample_mask
+    hess_m = hess * sample_mask
+    if is_cat_f is None:
+        is_cat_f = jnp.zeros((f,), bool)
+
+    buckets = _bucket_sizes(n)
+    bucket_arr = jnp.asarray(buckets, jnp.int32)
+    max_bucket = buckets[-1]
+    bins_flat = bins.reshape(-1).astype(jnp.int32)
+
+    def psum_(h):
+        return jax.lax.psum(h, ax) if ax is not None else h
+
+    def node_feature_mask(step):
+        if cfg.feature_fraction_bynode >= 1.0:
+            return feature_mask
+        k = jax.random.fold_in(rng_key, step)
+        r = jax.random.uniform(k, (f,))
+        m = feature_mask & (r < cfg.feature_fraction_bynode)
+        return jnp.where(m.any(), m, feature_mask)
+
+    def scan_child(hist, sums, depth, fmask):
+        return _scan_leaf(hist, sums, depth, cfg, num_bins_f, has_missing_f,
+                          fmask, monotone, is_cat_f)
+
+    # ---- root ----------------------------------------------------------
+    root_hist = psum_(build_histogram(
+        bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
+        impl=cfg.hist_impl))
+    root_sums = root_hist[0].sum(axis=0)
+    root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
+                           cfg.lambda_l2, cfg.max_delta_step)
+    root_res = scan_child(root_hist, root_sums, jnp.int32(0),
+                          node_feature_mask(0))
+
+    state = _init_tree_state(cfg, n, fdt, root_out, root_sums)
+    state = _store_best(state, 0, root_res)
+
+    # histogram pool (reference HistogramPool, feature_histogram.hpp:1095;
+    # here a dense [L, F, B, 3] HBM array — no LRU needed, HBM is the pool)
+    pool = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
+    order = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                             jnp.zeros((max_bucket,), jnp.int32)])
+    leaf_start = jnp.zeros((L,), jnp.int32)
+    leaf_count = jnp.zeros((L,), jnp.int32).at[0].set(n)
+
+    def body(step, carry):
+        state, order, leaf_start, leaf_count, pool = carry
+        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
+        gain = state.best_gain[best_leaf]
+        found = gain > K_EPSILON
+
+        def do_split(carry):
+            state, order, leaf_start, leaf_count, pool = carry
+            new_leaf = state.n_leaves
+            feat = state.best_feature[best_leaf]
+            thr = state.best_threshold[best_leaf]
+            dleft = state.best_default_left[best_leaf]
+            split_cat = (state.best_is_cat[best_leaf]
+                         if cfg.use_categorical else jnp.asarray(False))
+            cat_mask = state.best_cat_mask[best_leaf]
+
+            s = leaf_start[best_leaf]
+            k = leaf_count[best_leaf]
+
+            missing_bin = num_bins_f[feat] - 1
+            fm = has_missing_f[feat]
+
+            def go_left_of_rows(rows):
+                fbin = bins_flat[rows * f + feat]
+                gl = jnp.where(fm & (fbin == missing_bin), dleft, fbin <= thr)
+                if cfg.use_categorical:
+                    gl = jnp.where(split_cat, cat_mask[fbin], gl)
+                return gl
+
+            # -- partition the segment (bucketed static window)
+            pidx = jnp.searchsorted(bucket_arr, k, side="left")
+            order, n_left = jax.lax.switch(
+                pidx,
+                [functools.partial(
+                    lambda o, kp: _partition_segment(o, s, k, go_left_of_rows,
+                                                     kp), kp=kp)
+                 for kp in buckets],
+                order)
+
+            n_right = k - n_left
+            leaf_start = leaf_start.at[best_leaf].set(s).at[new_leaf].set(
+                s + n_left)
+            leaf_count = leaf_count.at[best_leaf].set(n_left).at[new_leaf].set(
+                n_right)
+
+            # -- smaller child by GLOBAL bagged count (uniform across shards
+            #    under shard_map, so every shard subtracts the same way)
+            left_smaller = state.best_left[best_leaf, 2] <= \
+                state.best_right[best_leaf, 2]
+            s_h = jnp.where(left_smaller, s, s + n_left)
+            k_h = jnp.where(left_smaller, n_left, n_right)
+
+            def hist_child(kp: int):
+                rows = jax.lax.dynamic_slice(order, (s_h,), (kp,))
+                validh = (jnp.arange(kp, dtype=jnp.int32) < k_h).astype(fdt)
+                w = jnp.stack([grad_m[rows], hess_m[rows],
+                               sample_mask[rows]], axis=1) * validh[:, None]
+                return build_histogram(bins[rows], w, B, impl=cfg.hist_impl)
+
+            hidx = jnp.searchsorted(bucket_arr, k_h, side="left")
+            hist_small = psum_(jax.lax.switch(
+                hidx, [functools.partial(hist_child, kp) for kp in buckets]))
+
+            parent_hist = pool[best_leaf]
+            hist_other = parent_hist - hist_small
+            hist_l = jnp.where(left_smaller, hist_small, hist_other)
+            hist_r = jnp.where(left_smaller, hist_other, hist_small)
+            pool = pool.at[best_leaf].set(hist_l).at[new_leaf].set(hist_r)
+
+            depth = state.leaf_depth[best_leaf] + 1
+            new_state = _apply_split_bookkeeping(
+                state, best_leaf, gain, feat, thr, dleft, split_cat, cat_mask)
+
+            fmask = node_feature_mask(step + 1)
+            res_l = scan_child(hist_l, new_state.leaf_sum[best_leaf], depth,
+                               fmask)
+            res_r = scan_child(hist_r, new_state.leaf_sum[new_leaf], depth,
+                               fmask)
+            new_state = _store_best(new_state, best_leaf, res_l)
+            new_state = _store_best(new_state, new_leaf, res_r)
+            return (new_state, order, leaf_start, leaf_count, pool)
+
+        return jax.lax.cond(found, do_split, lambda c: c, carry)
+
+    carry = (state, order, leaf_start, leaf_count, pool)
+    state, order, leaf_start, leaf_count, _ = jax.lax.fori_loop(
+        0, L - 1, body, carry)
+
+    # -- row -> leaf vector for the train-score fast path (one scatter per
+    #    tree; segments -> positions via a tiny sort + searchsorted).
+    #    Zero-count leaves (possible per-shard under data-parallel) are
+    #    sentineled too: an empty segment shares its start with a real one
+    #    and must lose the searchsorted tie.
+    starts = jnp.where((jnp.arange(L) < state.n_leaves) & (leaf_count > 0),
+                       leaf_start, jnp.int32(n + max_bucket + 1))
+    ord_leaves = jnp.argsort(starts).astype(jnp.int32)
+    sorted_starts = starts[ord_leaves]
+    pos_leaf = ord_leaves[
+        jnp.searchsorted(sorted_starts, jnp.arange(n, dtype=jnp.int32),
+                         side="right") - 1]
+    row_leaf = jnp.zeros((n,), jnp.int32).at[order[:n]].set(
+        pos_leaf, unique_indices=True, mode="promise_in_bounds")
+    return state._replace(row_leaf=row_leaf)
+
+
+grow_tree_compact_jit = jax.jit(grow_tree_compact,
+                                static_argnames=("cfg",))
 
 
 def state_to_tree(state: TreeState, feature_meta, real_feature_map=None) -> Tree:
@@ -449,8 +695,10 @@ class SerialTreeLearner:
         ds = self.dataset
         key = jax.random.PRNGKey(self.config.feature_fraction_seed * 7919 +
                                  iteration)
-        state = grow_tree(self.grower_cfg, ds.device_bins, grad, hess,
-                          sample_mask, ds.num_bins_per_feature,
-                          ds.has_missing_per_feature, self.feature_mask(),
-                          self.monotone, key, self.is_cat_f)
+        grow = (grow_tree_compact_jit
+                if self.config.grow_strategy == "compact" else grow_tree)
+        state = grow(self.grower_cfg, ds.device_bins, grad, hess,
+                     sample_mask, ds.num_bins_per_feature,
+                     ds.has_missing_per_feature, self.feature_mask(),
+                     self.monotone, key, self.is_cat_f)
         return state

@@ -1,0 +1,123 @@
+"""CLI application tests (reference: src/application/ dispatch + the
+examples/*/train.conf golden configs used by test_consistency.py:68)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.application import Application
+
+EXAMPLES = "/root/reference/examples"
+BINARY = os.path.join(EXAMPLES, "binary_classification")
+
+
+@pytest.fixture
+def binary_dir(tmp_path, monkeypatch):
+    """Run inside the reference binary_classification example dir so the
+    conf file's relative data paths resolve; outputs go to tmp."""
+    monkeypatch.chdir(BINARY)
+    return tmp_path
+
+
+def test_train_conf_golden(binary_dir):
+    """Drive the reference's own train.conf end to end (fewer iters)."""
+    model = str(binary_dir / "model.txt")
+    app = Application([f"config={BINARY}/train.conf",
+                       "num_trees=20", f"output_model={model}",
+                       "verbosity=-1"])
+    assert app.config.objective == "binary"
+    assert app.config.num_leaves > 1
+    app.run()
+    assert os.path.exists(model)
+    bst = lgb.Booster(model_file=model)
+    from lightgbm_tpu.io.parser import load_svmlight_or_csv
+    X, y = load_svmlight_or_csv(os.path.join(BINARY, "binary.test"))
+    p = bst.predict(X)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, p) > 0.8
+
+
+def test_predict_task(binary_dir):
+    model = str(binary_dir / "model.txt")
+    Application([f"config={BINARY}/train.conf", "num_trees=10",
+                 f"output_model={model}", "verbosity=-1"]).run()
+    out = str(binary_dir / "preds.txt")
+    Application(["task=predict", f"data={BINARY}/binary.test",
+                 f"input_model={model}", f"output_result={out}",
+                 "verbosity=-1"]).run()
+    preds = np.loadtxt(out)
+    assert preds.shape[0] == 500
+    assert np.all((preds >= 0) & (preds <= 1))
+
+
+def test_convert_model_compiles(binary_dir):
+    model = str(binary_dir / "model.txt")
+    Application([f"config={BINARY}/train.conf", "num_trees=5",
+                 f"output_model={model}", "verbosity=-1"]).run()
+    code_path = str(binary_dir / "pred.cpp")
+    Application(["task=convert_model", f"input_model={model}",
+                 f"convert_model={code_path}", "verbosity=-1"]).run()
+    src = open(code_path).read()
+    assert "PredictTree0" in src and "void Predict" in src
+    # the generated C++ must actually compile
+    obj = str(binary_dir / "pred.o")
+    r = subprocess.run(["g++", "-c", "-o", obj, code_path],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_refit_task(binary_dir):
+    model = str(binary_dir / "model.txt")
+    Application([f"config={BINARY}/train.conf", "num_trees=10",
+                 f"output_model={model}", "verbosity=-1"]).run()
+    refitted = str(binary_dir / "refitted.txt")
+    Application(["task=refit", f"data={BINARY}/binary.train",
+                 f"input_model={model}", f"output_model={refitted}",
+                 "verbosity=-1"]).run()
+    assert os.path.exists(refitted)
+    from lightgbm_tpu.io.parser import load_svmlight_or_csv
+    X, y = load_svmlight_or_csv(os.path.join(BINARY, "binary.test"))
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(y, lgb.Booster(model_file=refitted).predict(X))
+    assert auc > 0.75  # structure kept, leaves refit
+
+
+def test_save_binary_task(binary_dir, monkeypatch):
+    # save_binary writes next to the data file; copy data to tmp first
+    import shutil
+    data = str(binary_dir / "binary.train")
+    shutil.copy(os.path.join(BINARY, "binary.train"), data)
+    Application(["task=save_binary", f"data={data}", "verbosity=-1"]).run()
+    assert os.path.exists(data + ".bin")
+
+
+def test_python_m_entrypoint(binary_dir):
+    """`python -m lightgbm_tpu` end to end in a subprocess."""
+    model = str(binary_dir / "m.txt")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu",
+         f"config={BINARY}/train.conf", "num_trees=5",
+         f"output_model={model}", "verbosity=-1"],
+        capture_output=True, text=True, env=env, cwd=BINARY,
+        timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(model)
+
+
+def test_booster_refit_api():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 5)
+    y = X[:, 0] + 0.1 * rng.randn(1000)
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    lgb.Dataset(X, y), 10)
+    before = bst.predict(X)
+    # refit on shifted labels moves predictions toward the new target
+    bst.refit(X, y + 1.0, decay_rate=0.0)
+    after = bst.predict(X)
+    assert after.mean() > before.mean() + 0.5
