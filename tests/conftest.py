@@ -3,12 +3,17 @@ run without TPU hardware (mirrors the reference's localhost multi-process
 distributed tests, tests/distributed/_test_distributed.py)."""
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags +
-                               " --xla_force_host_platform_device_count=8")
+# Rewrite (not just append) any existing device-count flag so a stale value
+# can't win; must run before any jax import, so it cannot be shared with the
+# identical bootstrap in __graft_entry__.py (importing lightgbm_tpu imports
+# jax).
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (_flags +
+                           " --xla_force_host_platform_device_count=8")
 
 # The environment may pre-import jax with JAX_PLATFORMS=<tpu plugin> via
 # sitecustomize, freezing the platform choice before this file runs; override
