@@ -62,6 +62,11 @@ class Application:
 
     # ------------------------------------------------------------------
     def run(self) -> None:
+        if self.config.num_machines > 1 and self.config.machines:
+            # reference Application::InitTrain -> Network::Init
+            # (application.cpp:170): join the cluster before any device work
+            from .parallel.mesh import maybe_init_distributed
+            maybe_init_distributed(self.config)
         task = self.config.task
         if task in ("train", "refit"):
             self._train(refit=(task == "refit"))

@@ -35,7 +35,12 @@ class GBDT:
         self.objective = objective
         self.num_class = objective.num_model_per_iteration
         self.shrinkage_rate = config.learning_rate
-        self.models: List[Tree] = []   # iteration-major, class-minor
+        self._models: List[Tree] = []  # iteration-major, class-minor
+        # device-side TreeStates not yet converted to host Trees (the fused
+        # training path defers the device->host pull so the TPU pipeline
+        # never stalls on python; flushed lazily via the `models` property)
+        self._pending: List[tuple] = []
+        self._fused_step = None
         self.iter_ = 0
         self.best_iteration = -1
         self.average_output = False    # RF sets True (reference rf.hpp:27)
@@ -63,14 +68,29 @@ class GBDT:
         self.eval_results: Dict[str, Dict[str, List[float]]] = {}
         self._L = self.tree_learner.grower_cfg.num_leaves
 
+    @property
+    def models(self) -> List[Tree]:
+        """Host-side tree list; converts any pending device states first."""
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value):
+        self._models = list(value)
+
     def _create_tree_learner(self, config, train_data):
-        # reference TreeLearner::CreateTreeLearner 4x3 factory
-        # (src/treelearner/tree_learner.cpp); parallel modes live in
-        # parallel/ and are selected by tree_learner= config
+        # reference TreeLearner::CreateTreeLearner factory
+        # (src/treelearner/tree_learner.cpp); each tree_learner= value maps
+        # to a distinct collective program (no silent fallback)
         if config.tree_learner == "serial" or config.num_machines <= 1:
             return SerialTreeLearner(config, train_data)
-        from ..parallel.data_parallel import DataParallelTreeLearner
-        return DataParallelTreeLearner(config, train_data)
+        from .. import parallel
+        learner_cls = {
+            "data": parallel.DataParallelTreeLearner,
+            "voting": parallel.VotingParallelTreeLearner,
+            "feature": parallel.FeatureParallelTreeLearner,
+        }[config.tree_learner]
+        return learner_cls(config, train_data)
 
     # ------------------------------------------------------------------
     def add_valid(self, valid: ValidDataset, name: str):
@@ -87,8 +107,9 @@ class GBDT:
             for it in range(self.iter_):
                 for cls in range(self.num_class):
                     tree = self.models[it * self.num_class + cls]
-                    score = self._add_tree_to_score(score, cls, tree,
-                                                    valid.device_bins)
+                    score = self._add_tree_to_score(
+                        score, cls, tree, valid.device_bins,
+                        raw=getattr(valid, "raw", None))
         self.valid_scores.append(score)
 
     # ------------------------------------------------------------------
@@ -117,7 +138,9 @@ class GBDT:
         need = (cfg.bagging_freq > 0 and
                 (cfg.bagging_fraction < 1.0 or use_pos_neg))
         if not need:
-            return jnp.ones((n,), jnp.float32)
+            if not hasattr(self, "_ones_mask"):
+                self._ones_mask = jnp.ones((n,), jnp.float32)
+            return self._ones_mask
         if iteration % cfg.bagging_freq != 0 and hasattr(self, "_last_mask"):
             return self._last_mask
         rng = np.random.RandomState(cfg.bagging_seed + iteration)
@@ -144,6 +167,85 @@ class GBDT:
         return self.objective.get_gradients(score, label, weight)
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Fused device path: gradients -> grow -> score update in ONE jitted
+    # step, states pulled to host lazily in batches.  This is the TPU
+    # counterpart of keeping the reference's TrainOneIter entirely inside
+    # the OpenMP region — no python between device ops, so the XLA stream
+    # never drains between trees.
+    def _can_fuse(self) -> bool:
+        from ..tree_learner import SerialTreeLearner
+        return (type(self) is GBDT
+                and self.num_class == 1
+                and not self.objective.need_renew_tree_output
+                and not self.valid_sets
+                and not self.config.linear_tree
+                and type(self.tree_learner) is SerialTreeLearner)
+
+    def _build_fused_step(self):
+        obj = self.objective
+        learner = self.tree_learner
+        ds = self.train_data
+        label, weight = ds.label, ds.weight
+
+        @jax.jit
+        def step(score_row, mask, fmask, key, lr):
+            g, h = obj.get_gradients(score_row, label, weight)
+            state = learner.grow_traced(g, h, mask, fmask, key)
+            delta = jnp.where(state.n_leaves > 1,
+                              (state.leaf_value * lr)[state.row_leaf],
+                              jnp.zeros_like(score_row))
+            # drop the [N]-sized fields before the state is retained
+            slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
+            return score_row + delta, slim
+
+        return step
+
+    def _train_one_iter_fused(self) -> bool:
+        if getattr(self, "_saw_stump", False):
+            # a flushed earlier iteration produced no splits -> stop now
+            # (a few iterations later than the reference's immediate stop,
+            # gbdt.cpp:418-434; the extra stump trees add zero score)
+            return True
+        init = self._boost_from_average(0)
+        if self._fused_step is None:
+            self._fused_step = self._build_fused_step()
+        learner = self.tree_learner
+        mask = self._bagging_mask(self.iter_)
+        new_score, slim = self._fused_step(
+            self.train_score[0], mask, learner.feature_mask(),
+            learner.iter_key(self.iter_),
+            jnp.float32(self.shrinkage_rate))
+        self.train_score = new_score[None, :]
+        self._pending.append((slim, float(init), self.shrinkage_rate))
+        self.iter_ += 1
+        # stall check on an iteration that finished long ago, so reading the
+        # scalar doesn't drain the pipeline
+        lag = 8
+        if len(self._pending) >= lag:
+            if int(self._pending[-lag][0].n_leaves) <= 1:
+                self._flush_pending()
+                return True
+        return getattr(self, "_saw_stump", False)
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        states = jax.device_get([p[0] for p in pending])
+        for state, (_, init, lr) in zip(states, pending):
+            tree = state_to_tree(state, self.train_data.feature_mappers,
+                                 self.train_data.real_feature_index)
+            if tree.num_leaves > 1:
+                tree.shrinkage(lr)
+                if init != 0.0:
+                    tree.add_bias(init)
+            else:
+                self._saw_stump = True
+                if init != 0.0:
+                    tree.leaf_value[0] = init
+            self._models.append(tree)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """Train one boosting iteration (reference GBDT::TrainOneIter,
@@ -151,6 +253,9 @@ class GBDT:
         k = self.num_class
         init_scores = [0.0] * k
         if grad is None or hess is None:
+            if self._can_fuse():
+                return self._train_one_iter_fused()
+            self._flush_pending()
             for cls in range(k):
                 init_scores[cls] = self._boost_from_average(cls)
             grad, hess = self._get_gradients()
@@ -183,6 +288,14 @@ class GBDT:
                                             self.iter_)
             tree = state_to_tree(state, self.train_data.feature_mappers,
                                  self.train_data.real_feature_index)
+            row_out = None
+            if (self.config.linear_tree and tree.num_leaves > 1
+                    and self.train_data.raw_device is not None):
+                from ..linear import fit_linear_leaves
+                row_out = fit_linear_leaves(
+                    tree, state.row_leaf, self.train_data.raw_device,
+                    grad[cls] * mask, hess[cls] * mask,
+                    float(self.config.linear_lambda))
             if tree.num_leaves > 1:
                 any_split = True
                 if obj.need_renew_tree_output:
@@ -193,17 +306,23 @@ class GBDT:
                         self.train_data.metadata.weight,
                         np.asarray(state.row_leaf), tree.num_leaves)
                 tree.shrinkage(self.shrinkage_rate)
+                if row_out is not None:
+                    # finalize the per-row linear outputs here (add_bias
+                    # resets tree.shrinkage_, so scaling can't be deferred)
+                    row_out = row_out * jnp.float32(self.shrinkage_rate)
                 if self.bias_before_score_update:
                     # RF: the tree IS a standalone predictor incl. the init
                     # (reference rf.hpp:136-141 AddBias before UpdateScore)
                     if init_scores[cls] != 0.0:
                         tree.add_bias(init_scores[cls])
-                    self._update_scores(cls, tree, state)
+                        if row_out is not None:
+                            row_out = row_out + jnp.float32(init_scores[cls])
+                    self._update_scores(cls, tree, state, row_out)
                 else:
                     # GBDT: scores first, THEN fold the init bias into the
                     # stored tree — the running scores already received the
                     # init via BoostFromAverage (reference gbdt.cpp:411-416)
-                    self._update_scores(cls, tree, state)
+                    self._update_scores(cls, tree, state, row_out)
                     if init_scores[cls] != 0.0:
                         tree.add_bias(init_scores[cls])
             else:
@@ -217,22 +336,32 @@ class GBDT:
                         "that meet the split requirements")
         return not any_split
 
-    def _update_scores(self, cls: int, tree: Tree, state):
+    def _update_scores(self, cls: int, tree: Tree, state, row_out=None):
         # train: fast path via row->leaf vector (reference ScoreUpdater
         # AddScore(tree, data_partition), score_updater.hpp)
         leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
         if tree.num_leaves > 1:
-            self.train_score = self.train_score.at[cls].add(
-                leaf_vals[state.row_leaf])
+            if row_out is not None:
+                # linear leaves: per-row fitted outputs (already shrinkage-
+                # scaled and bias-adjusted by the caller)
+                self.train_score = self.train_score.at[cls].add(row_out)
+            else:
+                self.train_score = self.train_score.at[cls].add(
+                    leaf_vals[state.row_leaf])
         else:
             self.train_score = self.train_score.at[cls].add(tree.leaf_value[0])
         for i, valid in enumerate(self.valid_sets):
             self.valid_scores[i] = self._add_tree_to_score(
-                self.valid_scores[i], cls, tree, valid.device_bins, state)
+                self.valid_scores[i], cls, tree, valid.device_bins, state,
+                raw=getattr(valid, "raw", None))
 
-    def _add_tree_to_score(self, score, cls, tree: Tree, bins, state=None):
+    def _add_tree_to_score(self, score, cls, tree: Tree, bins, state=None,
+                           raw=None):
         if tree.num_leaves <= 1:
             return score.at[cls].add(float(tree.leaf_value[0]))
+        if tree.is_linear and raw is not None:
+            vals = tree.predict(np.asarray(raw))
+            return score.at[cls].add(jnp.asarray(vals, jnp.float32))
         ds = self.train_data
         if state is not None:
             sf = state.split_feature
@@ -255,11 +384,16 @@ class GBDT:
             icn = clm = None
             if tree.num_cat > 0:
                 icn, clm = self._tree_cat_masks(tree, pad)
+        bm = ds.bundle_map
         leaf_idx = traverse_binned(sf, tb, dl, lc, rc, n_leaves, bins,
                                    ds.num_bins_per_feature,
                                    ds.has_missing_per_feature,
                                    max_steps=self._L,
-                                   is_cat_node=icn, cat_left_mask=clm)
+                                   is_cat_node=icn, cat_left_mask=clm,
+                                   bundle_of=(None if bm is None
+                                              else bm.bundle_of_f),
+                                   offset_of=(None if bm is None
+                                              else bm.offset_of_f))
         leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
         return score.at[cls].add(leaf_vals[leaf_idx])
 
@@ -333,9 +467,14 @@ class GBDT:
             for arr_i in range(len(self.valid_scores)):
                 self.valid_scores[arr_i] = self._add_tree_to_score(
                     self.valid_scores[arr_i], cls, t2,
-                    self.valid_sets[arr_i].device_bins)
+                    self.valid_sets[arr_i].device_bins,
+                    raw=getattr(self.valid_sets[arr_i], "raw", None))
+            train_raw = (np.asarray(self.train_data.raw_device)
+                         if getattr(self.train_data, "raw_device", None)
+                         is not None else None)
             self.train_score = self._add_tree_to_score(
-                self.train_score, cls, t2, self.train_data.device_bins)
+                self.train_score, cls, t2, self.train_data.device_bins,
+                raw=train_raw)
         self.iter_ -= 1
         if self.iter_ == 0:
             # the rolled-back trees carried the boost-from-average bias; let
@@ -368,7 +507,14 @@ class GBDT:
         if end <= start_iteration or not self.models:
             return np.zeros((n, k) if k > 1 else n)
         trees = self.models[start_iteration * k: end * k]
-        bins = jnp.asarray(self.train_data.bin_external(X))
+        if any(t.is_linear for t in trees):
+            # linear leaves need raw values: host traversal via Tree.predict
+            out = np.zeros((k, n))
+            for i, tree in enumerate(trees):
+                out[i % k] += tree.predict(X)
+            return out[0] if k == 1 else out.T
+        bins = jnp.asarray(self.train_data.to_device_space(
+            self.train_data.bin_external(X)))
         score = jnp.zeros((k, n), jnp.float32)
         for i, tree in enumerate(trees):
             score = self._add_tree_to_score(score, i % k, tree, bins)
@@ -445,4 +591,7 @@ def _negated(tree: Tree) -> Tree:
     import copy
     t2 = copy.copy(tree)
     t2.leaf_value = -tree.leaf_value
+    if tree.is_linear:
+        t2.leaf_const = -tree.leaf_const
+        t2.leaf_coeff = [[-c for c in cs] for cs in tree.leaf_coeff]
     return t2

@@ -1,13 +1,271 @@
-"""SHAP feature contributions (reference Tree::PredictContrib, tree.h:139,
-recursive TreeSHAP in tree.cpp).  Full implementation lands with the M5
-feature set; until then fail loudly rather than silently."""
+"""SHAP feature contributions, device-side.
+
+TPU-native equivalent of the reference's recursive TreeSHAP
+(Tree::PredictContrib, include/LightGBM/tree.h:139; TreeSHAP recursion in
+src/io/tree.cpp).  The recursion does not vectorize, so this uses the
+per-leaf decomposition (the same reformulation GPUTreeShap uses): for a leaf
+l with unique path features U, per row x,
+
+    phi_i += v_l * (o_i - z_i) * sum_k c_k(i) * k! (u-1-k)! / u!
+
+where o_j = 1 iff x satisfies ALL of feature j's splits on the path,
+z_j = product of child-cover fractions of feature j's splits, and c_k(i) are
+the coefficients of prod_{j in U\\{i}} (z_j + o_j t).  Host code precomputes
+the per-leaf path tables once per model; the device evaluates all
+(row, leaf, feature) terms with fixed-shape scans — O(L * D^2) per row.
+
+Output layout matches the reference: per-class blocks of [F feature columns
++ bias column], bias = expected value, each row's block summing to the raw
+prediction.
+"""
 
 from __future__ import annotations
 
+import functools
+import math
+from typing import List, NamedTuple
+
 import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["predict_contrib"]
+
+_K_ZERO = 1e-35
+_EPS = 1e-12
 
 
-def predict_contrib(trees, X: np.ndarray, num_class: int) -> np.ndarray:
-    raise NotImplementedError(
-        "predict(pred_contrib=True) (SHAP values) is not implemented yet "
-        "in lightgbm_tpu; planned for the constraints/extras milestone")
+class _TreePaths(NamedTuple):
+    """Per-tree path tables (one leaf per row, padded to max depth D)."""
+    step_node: np.ndarray     # [L, D] int32 internal node id (-1 pad)
+    step_dir: np.ndarray      # [L, D] bool: path goes LEFT at this node
+    slot_of_step: np.ndarray  # [L, D] int32: unique-feature slot of step
+    slot_feat: np.ndarray     # [L, D] int32 real feature id (-1 pad)
+    slot_z: np.ndarray        # [L, D] f64 cover-fraction product (1.0 pad)
+    n_slots: np.ndarray       # [L] int32 (u per leaf)
+    leaf_value: np.ndarray    # [L]
+    expected: float           # E[f] = sum_l v_l * prod(path covers)
+
+
+def _tree_paths(tree) -> _TreePaths:
+    nl = tree.num_leaves
+    if nl <= 1:
+        return _TreePaths(np.full((1, 1), -1, np.int32),
+                          np.zeros((1, 1), bool),
+                          np.zeros((1, 1), np.int32),
+                          np.full((1, 1), -1, np.int32),
+                          np.ones((1, 1)),
+                          np.zeros(1, np.int32),
+                          np.asarray([tree.leaf_value[0]]),
+                          float(tree.leaf_value[0]))
+    paths = []  # per leaf: list of (node, went_left, cover_frac)
+    weights = tree.internal_weight
+    lweights = tree.leaf_weight
+    counts = tree.internal_count
+    lcounts = tree.leaf_count
+
+    def node_weight(code):
+        if code >= 0:
+            w = weights[code]
+            return w if w > 0 else float(counts[code])
+        leaf = ~code
+        w = lweights[leaf]
+        return w if w > 0 else float(lcounts[leaf])
+
+    def walk(code, path):
+        if code < 0:
+            paths.append((~code, list(path)))
+            return
+        w = node_weight(code)
+        for child, went_left in ((tree.left_child[code], True),
+                                 (tree.right_child[code], False)):
+            frac = node_weight(child) / max(w, _EPS)
+            path.append((code, went_left, frac))
+            walk(child, path)
+            path.pop()
+
+    walk(0, [])
+    paths.sort(key=lambda p: p[0])
+    D = max(1, max(len(p) for _, p in paths))
+    L = nl
+    step_node = np.full((L, D), -1, np.int32)
+    step_dir = np.zeros((L, D), bool)
+    slot_of_step = np.zeros((L, D), np.int32)
+    slot_feat = np.full((L, D), -1, np.int32)
+    slot_z = np.ones((L, D))
+    n_slots = np.zeros(L, np.int32)
+    leaf_value = np.zeros(L)
+    expected = 0.0
+    for leaf, path in paths:
+        leaf_value[leaf] = tree.leaf_value[leaf]
+        cover = 1.0
+        slots = {}
+        for s, (node, went_left, frac) in enumerate(path):
+            cover *= frac
+            feat = int(tree.split_feature[node])
+            if feat not in slots:
+                slots[feat] = len(slots)
+            j = slots[feat]
+            step_node[leaf, s] = node
+            step_dir[leaf, s] = went_left
+            slot_of_step[leaf, s] = j
+            slot_feat[leaf, j] = feat
+            slot_z[leaf, j] *= frac
+        n_slots[leaf] = len(slots)
+        expected += tree.leaf_value[leaf] * cover
+    return _TreePaths(step_node, step_dir, slot_of_step, slot_feat, slot_z,
+                      n_slots, leaf_value, float(expected))
+
+
+def _go_left_matrix(tree, X: np.ndarray) -> np.ndarray:
+    """[N, M] bool: would row go left at each internal node (same decision
+    semantics as ops/predict._traverse_one_tree)."""
+    ni = max(tree.num_leaves - 1, 1)
+    n = X.shape[0]
+    out = np.zeros((n, ni), bool)
+    for node in range(tree.num_leaves - 1):
+        fval = X[:, tree.split_feature[node]]
+        d = int(tree.decision_type[node])
+        missing_type = (d >> 2) & 3
+        default_left = (d & 2) != 0
+        isnan = np.isnan(fval)
+        if d & 1:  # categorical
+            ival = np.where(isnan, -1, fval).astype(np.int64)
+            cat_idx = int(tree.threshold[node])
+            lo = tree.cat_boundaries[cat_idx]
+            hi = tree.cat_boundaries[cat_idx + 1]
+            words = np.asarray(tree.cat_threshold[lo:hi], np.uint32)
+            word = ival >> 5
+            ok = (ival >= 0) & (word < (hi - lo))
+            wv = words[np.clip(word, 0, hi - lo - 1)]
+            out[:, node] = ok & (((wv >> (ival & 31)) & 1) == 1)
+        else:
+            fv = np.where(isnan & (missing_type != 2), 0.0, fval)
+            iszero = np.abs(fv) < _K_ZERO
+            is_missing = ((missing_type == 2) & isnan) | \
+                         ((missing_type == 1) & iszero)
+            out[:, node] = np.where(is_missing, default_left,
+                                    fv <= tree.threshold[node])
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("num_features",))
+def _tree_contrib(go_left, step_node, step_dir, slot_of_step, slot_feat,
+                  slot_z, n_slots, leaf_value, fact_w, num_features: int):
+    """phi [N, F+1] for one tree given the row decisions at each node."""
+    L, D = step_node.shape
+    n = go_left.shape[0]
+
+    def per_leaf(leaf_i):
+        nodes = step_node[leaf_i]            # [D]
+        valid = nodes >= 0
+        gl = go_left[:, jnp.clip(nodes, 0, go_left.shape[1] - 1)]  # [N, D]
+        passes = jnp.where(valid[None, :],
+                           gl == step_dir[leaf_i][None, :], True)
+        # o per slot: AND over this slot's steps
+        slot_mask = (slot_of_step[leaf_i][None, :] ==
+                     jnp.arange(D)[:, None]) & valid[None, :]      # [D, D]
+        o = jnp.all(jnp.where(slot_mask[None, :, :], passes[:, None, :],
+                              True), axis=2)                       # [N, D]
+        u = n_slots[leaf_i]
+        slot_valid = jnp.arange(D) < u
+        of = jnp.where(slot_valid[None, :], o.astype(jnp.float32), 0.0)
+        zf = jnp.where(slot_valid, slot_z[leaf_i].astype(jnp.float32), 1.0)
+
+        # poly = prod_j (z_j + o_j t): coefficients [N, D+1]; padded slots
+        # contribute the neutral factor (z=1, o=0)
+        def mul(poly, jo_jz):
+            jo, jz = jo_jz
+            shifted = jnp.concatenate(
+                [jnp.zeros((n, 1), poly.dtype), poly[:, :-1]], axis=1)
+            return poly * jz + shifted * jo[:, None], None
+
+        init = jnp.zeros((n, D + 1), jnp.float32).at[:, 0].set(1.0)
+        poly, _ = jax.lax.scan(mul, init, (of.T, zf))
+
+        w_u = fact_w[u]                                            # [D+1]
+
+        def unwind(i):
+            oi = of[:, i]
+            zi = zf[i]
+            # divide poly by (z_i + o_i t):
+            #   o_i=1: synthetic division top-down  c_{k-1} = p_k - c_k z_i
+            #   o_i=0: plain scale                  c_k = p_k / z_i
+            def div_step(c_prev, k):
+                c = poly[:, k] - c_prev * zi
+                return c, c
+
+            ks = jnp.arange(D, 0, -1)
+            _, cs_o1 = jax.lax.scan(div_step, jnp.zeros((n,)), ks)
+            cs_o1 = jnp.moveaxis(cs_o1, 0, 1)[:, ::-1]             # [N, D]
+            cs_o0 = poly[:, :D] / jnp.maximum(zi, _EPS)
+            cs = jnp.where(oi[:, None] > 0, cs_o1, cs_o0)
+            s = (cs * w_u[None, :D]).sum(axis=1)
+            return (oi - zi) * s                                   # [N]
+
+        contrib = jax.vmap(unwind)(jnp.arange(D))                  # [D, N]
+        contrib = contrib.T * leaf_value[leaf_i]
+        contrib = jnp.where(slot_valid[None, :], contrib, 0.0)
+        return contrib, slot_feat[leaf_i]
+
+    def body(acc, leaf_i):
+        contrib, feats = per_leaf(leaf_i)
+        idx = jnp.clip(feats, 0, num_features - 1)
+        upd = jnp.where((feats >= 0)[None, :], contrib, 0.0)
+        acc = acc.at[:, idx].add(upd)
+        return acc, None
+
+    phi = jnp.zeros((n, num_features + 1), jnp.float32)
+    phi, _ = jax.lax.scan(body, phi, jnp.arange(L))
+    return phi
+
+
+def _fact_weights(D: int) -> np.ndarray:
+    """[u, k] -> k! (u-1-k)! / u! lookup (0 where k >= u)."""
+    w = np.zeros((D + 1, D + 1))
+    for u in range(1, D + 1):
+        for k in range(u):
+            w[u, k] = (math.factorial(k) * math.factorial(u - 1 - k)
+                       / math.factorial(u))
+    return w
+
+
+def predict_contrib(trees: List, X: np.ndarray, num_class: int) -> np.ndarray:
+    """[N, (F+1) * num_class] SHAP values (reference PredictContrib layout:
+    per-class blocks of F feature columns + bias column)."""
+    X = np.ascontiguousarray(np.asarray(X, np.float64))
+    n, f = X.shape
+    out = np.zeros((n, (f + 1) * num_class))
+    if not trees:
+        return out
+    paths = [_tree_paths(t) for t in trees]
+    # pad every tree to common (L, D) so _tree_contrib compiles ONCE for the
+    # whole model (padded leaves: value 0, neutral slots -> zero phi)
+    Dmax = max(max(p.step_node.shape[1] for p in paths), 1)
+    Lmax = max(max(p.step_node.shape[0] for p in paths), 1)
+    fact_w = jnp.asarray(_fact_weights(Dmax), jnp.float32)
+    for i, (tree, p) in enumerate(zip(trees, paths)):
+        cls = i % num_class
+        lo = cls * (f + 1)
+        if tree.num_leaves <= 1:
+            out[:, lo + f] += tree.leaf_value[0]
+            continue
+        L, D = p.step_node.shape
+        pad = ((0, Lmax - L), (0, Dmax - D))
+        gl_np = _go_left_matrix(tree, X)
+        gl = jnp.asarray(np.pad(
+            gl_np, ((0, 0), (0, max(Lmax - 1, 1) - gl_np.shape[1]))))
+        phi = _tree_contrib(
+            gl,
+            jnp.asarray(np.pad(p.step_node, pad, constant_values=-1)),
+            jnp.asarray(np.pad(p.step_dir, pad)),
+            jnp.asarray(np.pad(p.slot_of_step, pad)),
+            jnp.asarray(np.pad(p.slot_feat, pad, constant_values=-1)),
+            jnp.asarray(np.pad(p.slot_z, pad, constant_values=1.0),
+                        jnp.float32),
+            jnp.asarray(np.pad(p.n_slots, (0, Lmax - L))),
+            jnp.asarray(np.pad(p.leaf_value, (0, Lmax - L)), jnp.float32),
+            fact_w, num_features=f)
+        out[:, lo:lo + f + 1] += np.asarray(phi, np.float64)
+        out[:, lo + f] += p.expected
+    return out

@@ -5,9 +5,12 @@ TPU-native equivalent of the reference Dataset/FeatureGroup/Metadata stack
 Storage deviates deliberately: a single dense packed bin matrix
 ``uint8/int32[rows, features]`` sharded over the row axis (SURVEY §7 /
 BASELINE.json north star) instead of column-group Dense/SparseBin objects —
-the MXU histogram formulation wants exactly this layout.  Trivial features are
-filtered (reference feature_pre_filter) and sparse features are handled via
-EFB bundling (efb.py) rather than sparse storage.
+the MXU histogram formulation wants exactly this layout.  Trivial features
+are filtered (reference feature_pre_filter), and sparse features are
+collapsed into shared columns via EFB bundling (efb.py, enabled by
+``enable_bundle``) rather than stored sparsely: the device matrix holds one
+column per BUNDLE, and histograms are expanded back to per-feature space
+on device before the split scan.
 """
 
 from __future__ import annotations
@@ -108,12 +111,19 @@ class TrainDataset:
             bins[:, j] = mapper.value_to_bin(data[:, real])
         self._finish_init(bins, bin_mappers, real_feature_index,
                           data.shape[1], metadata)
+        # linear leaves regress on RAW values (reference LinearTreeLearner
+        # keeps the Dataset's raw_data_ alive via linear_tree)
+        if getattr(config, "linear_tree", False):
+            self.raw_device = jnp.asarray(data, jnp.float32)
+        else:
+            self.raw_device = None
 
     def _init_from_binned(self, bins: np.ndarray, bin_mappers,
                           num_total_features: int, metadata: Metadata,
                           config: Config) -> None:
         """Init from a pre-binned matrix (binary cache load, reference
         DatasetLoader::LoadFromBinFile)."""
+        self.raw_device = None   # raw values aren't in the binary cache
         self.num_total_features = num_total_features
         self.metadata = metadata
         self.config = config
@@ -138,9 +148,30 @@ class TrainDataset:
         self.num_bins_per_feature = jnp.asarray(nbins)
         self.has_missing_per_feature = jnp.asarray(
             np.asarray([m.missing_bin is not None for m in self.feature_mappers]))
-        self.device_bins = jnp.asarray(bins)
         self.is_categorical = np.asarray(
             [m.bin_type == BinType.CATEGORICAL for m in self.feature_mappers])
+
+        # EFB: store the device matrix at bundle width when it helps
+        # (reference Dataset::Construct -> FindGroups/FastFeatureBundling,
+        # dataset.cpp:100,239)
+        self.bundle_map = None
+        self.bundles = None
+        cfg = self.config
+        if (getattr(cfg, "enable_bundle", True) and self.num_features >= 4):
+            from .efb import find_bundles, make_bundle_map, bundle_rows
+            bundles = find_bundles(bins, self.feature_mappers,
+                                   self.is_categorical, max_bin=cfg.max_bin)
+            if len(bundles) <= self.num_features * 3 // 4:
+                bmap, n_bundles, max_bb = make_bundle_map(
+                    bundles, self.feature_mappers, self.num_features)
+                self.bundles = bundles
+                self.bundle_map = bmap
+                self.max_num_bins = max(self.max_num_bins, max_bb)
+                self.num_bundles = n_bundles
+                bundled = bundle_rows(bins, bundles, self.feature_mappers)
+                self.device_bins = jnp.asarray(bundled)
+        if self.bundle_map is None:
+            self.device_bins = jnp.asarray(bins)
 
         self.label = jnp.asarray(metadata.label)
         self.weight = (jnp.asarray(metadata.weight)
@@ -163,6 +194,15 @@ class TrainDataset:
             out[:, j] = self.feature_mappers[j].value_to_bin(data[:, real])
         return out
 
+    def to_device_space(self, per_feature_bins: np.ndarray) -> np.ndarray:
+        """Re-encode a per-feature bin matrix into the device layout
+        (bundle columns when EFB is active, identity otherwise)."""
+        if self.bundle_map is None:
+            return per_feature_bins
+        from .efb import bundle_rows
+        return bundle_rows(per_feature_bins, self.bundles,
+                           self.feature_mappers)
+
     def create_valid(self, data: np.ndarray, metadata: Metadata) -> "ValidDataset":
         return ValidDataset(self, data, metadata)
 
@@ -180,7 +220,10 @@ class ValidDataset:
         self.metadata = metadata
         self.num_data = metadata.num_data
         self.bins = train.bin_external(data)
-        self.device_bins = jnp.asarray(self.bins)
+        self.device_bins = jnp.asarray(train.to_device_space(self.bins))
+        # raw values kept only when linear leaves need them at score-update
+        self.raw = (np.asarray(data, np.float64)
+                    if train.raw_device is not None else None)
         self.label = jnp.asarray(metadata.label)
         self.weight = (jnp.asarray(metadata.weight)
                        if metadata.weight is not None else None)

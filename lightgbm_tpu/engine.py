@@ -28,6 +28,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
           verbose_eval="warn") -> Booster:
     """Train a model (reference engine.py:15 train())."""
     params = resolve_aliases(dict(params))
+    if int(params.get("num_machines", 1)) > 1 and params.get("machines"):
+        # must run before ANY jax computation initializes the local backend
+        # (reference Network::Init happens first too, application.cpp:170)
+        from .config import Config
+        from .parallel.mesh import maybe_init_distributed
+        maybe_init_distributed(Config(params))
     if fobj is not None:
         params["objective"] = "none"
     nbr = int(params.pop("num_iterations", num_boost_round))

@@ -1,0 +1,142 @@
+"""Linear leaves: per-leaf linear models fit on the branch features.
+
+TPU-native equivalent of the reference LinearTreeLearner
+(src/treelearner/linear_tree_learner.cpp:123-125 CalculateLinear): the
+reference accumulates per-leaf X^T.H.X / X^T.g with OpenMP and solves each
+leaf with vendored Eigen; here ALL leaves are accumulated in one pass
+(segment-sum of per-row outer products, MXU/VPU friendly) and solved as one
+batched ``jnp.linalg.solve`` — with the same numerical-failure fallback to
+the constant leaf.
+
+Model semantics mirror the reference: output = leaf_const + sum coeff*x over
+the leaf's branch features; rows with NaN in any used feature fall back to
+the constant ``leaf_value`` (linear_tree_learner's HAS_NAN path, tree.h
+AddPredictionToScore<true>).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fit_linear_leaves"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def _fit(X, row_leaf, leaf_feats, feat_mask, grad, hess, lam,
+         num_leaves: int):
+    """Batched per-leaf weighted least squares.
+
+    X: [N, F] raw f32; leaf_feats: [L, K] int32 (0-padded);
+    feat_mask: [L, K] f32 1/0; returns (beta [L, K+1], ok [L], row_out [N],
+    row_nan [N])."""
+    n = X.shape[0]
+    L = num_leaves
+    k = leaf_feats.shape[1]
+
+    rf = leaf_feats[row_leaf]                    # [N, K]
+    fm = feat_mask[row_leaf]                     # [N, K]
+    Xr = jnp.take_along_axis(X, rf, axis=1)      # [N, K]
+    row_nan = jnp.any(jnp.isnan(Xr) * (fm > 0), axis=1)
+    Xr = jnp.nan_to_num(Xr) * fm
+    Xa = jnp.concatenate([Xr, jnp.ones((n, 1), Xr.dtype)], axis=1)  # [N,K+1]
+
+    w = jnp.where(row_nan, 0.0, hess)
+    g = jnp.where(row_nan, 0.0, grad)
+    outer = (Xa[:, :, None] * Xa[:, None, :]) * w[:, None, None]
+    XtHX = jax.ops.segment_sum(outer.reshape(n, -1), row_leaf,
+                               num_segments=L).reshape(L, k + 1, k + 1)
+    Xtg = jax.ops.segment_sum(Xa * g[:, None], row_leaf, num_segments=L)
+
+    # ridge on feature rows only (reference adds linear_lambda to the
+    # coefficient block, keeping the constant unpenalized); padded feature
+    # rows are replaced by identity rows so the batched solve stays
+    # well-posed for every leaf
+    eye = jnp.eye(k + 1)
+    diag_mask = feat_mask_ext(feat_mask)                    # [L, K+1]
+    A = XtHX * diag_mask[:, :, None] * diag_mask[:, None, :]
+    ridge = jnp.concatenate([jnp.full((k,), lam), jnp.zeros((1,))])
+    A = A + jnp.diag(ridge)[None]
+    pad = 1.0 - diag_mask                                   # [L, K+1]
+    A = A + jnp.einsum("lk,kj->lkj", pad, eye)
+
+    beta = jnp.linalg.solve(A, -Xtg[..., None])[..., 0]     # [L, K+1]
+    ok = jnp.all(jnp.isfinite(beta), axis=1)
+    # needs enough data for a stable fit (reference skips leaves whose
+    # hessian mass is tiny)
+    hsum = jax.ops.segment_sum(w, row_leaf, num_segments=L)
+    ok = ok & (hsum > 1e-3)
+    beta = jnp.where(ok[:, None], beta, 0.0) * feat_mask_ext(feat_mask)
+
+    row_out = (Xa * beta[row_leaf]).sum(axis=1)             # [N]
+    return beta, ok, row_out, row_nan
+
+
+def feat_mask_ext(feat_mask):
+    L = feat_mask.shape[0]
+    return jnp.concatenate([feat_mask, jnp.ones((L, 1))], axis=1)
+
+
+def fit_linear_leaves(tree, row_leaf, X_dev, grad, hess,
+                      linear_lambda: float) -> Tuple[np.ndarray, jnp.ndarray]:
+    """Fit all leaves of a freshly-grown tree; mutates `tree` with the
+    linear model and returns per-row outputs for the train-score update.
+
+    Returns (row_out [N] device array incl. constant fallback rows)."""
+    nl = tree.num_leaves
+    ni = nl - 1
+    parent = np.full(max(ni, 1), -1, np.int32)
+    for p in range(ni):
+        for c in (tree.left_child[p], tree.right_child[p]):
+            if c >= 0:
+                parent[c] = p
+    # branch features per leaf (reference GetPathToLeaf): unique split
+    # features on the root->leaf path, in first-use order
+    feats: List[List[int]] = [[] for _ in range(nl)]
+    for leaf in range(nl):
+        node = tree.leaf_parent[leaf]
+        path = []
+        while node >= 0:
+            f = int(tree.split_feature[node])
+            if f not in path:
+                path.append(f)
+            node = parent[node]
+        feats[leaf] = path
+    K = max(1, max(len(p) for p in feats))
+    leaf_feats = np.zeros((nl, K), np.int32)
+    fmask = np.zeros((nl, K), np.float32)
+    for leaf, p in enumerate(feats):
+        leaf_feats[leaf, :len(p)] = p
+        fmask[leaf, :len(p)] = 1.0
+
+    beta, ok, row_out, row_nan = _fit(
+        X_dev, row_leaf, jnp.asarray(leaf_feats), jnp.asarray(fmask),
+        grad, hess, jnp.float32(linear_lambda), nl)
+    beta = np.asarray(beta, np.float64)
+    ok = np.asarray(ok)
+
+    tree.is_linear = True
+    tree.leaf_const = np.zeros(tree.max_leaves)
+    tree.leaf_features = [[] for _ in range(tree.max_leaves)]
+    tree.leaf_coeff = [[] for _ in range(tree.max_leaves)]
+    for leaf in range(nl):
+        if ok[leaf]:
+            kf = len(feats[leaf])
+            tree.leaf_const[leaf] = beta[leaf, K]
+            tree.leaf_features[leaf] = list(feats[leaf])
+            tree.leaf_coeff[leaf] = [float(b) for b in beta[leaf, :kf]]
+        else:
+            # numerical-failure fallback: constant leaf
+            tree.leaf_const[leaf] = tree.leaf_value[leaf]
+
+    ok_dev = jnp.asarray(ok)
+    leaf_vals = jnp.asarray(tree.leaf_value[:nl], jnp.float32)
+    lv_row = leaf_vals[jnp.clip(row_leaf, 0, nl - 1)]
+    use_const = row_nan | ~ok_dev[jnp.clip(row_leaf, 0, nl - 1)]
+    return jnp.where(use_const, lv_row, row_out)
+
+

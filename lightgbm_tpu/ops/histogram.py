@@ -88,9 +88,11 @@ def _pick_impl(impl: str) -> str:
     return "pallas" if backend == "tpu" else "onehot"
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "impl", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "impl", "chunk", "hist_dtype"))
 def build_histogram(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
-                    impl: str = "auto", chunk: int = 4096) -> jnp.ndarray:
+                    impl: str = "auto", chunk: int = 4096,
+                    hist_dtype: str = "float32") -> jnp.ndarray:
     """Accumulate per-feature histograms.
 
     Args:
@@ -99,13 +101,19 @@ def build_histogram(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
         outside the target leaf / bag).
       num_bins: static B.
       impl: "segment" | "onehot" | "pallas" | "auto".
+      hist_dtype: MXU contraction input dtype ("float32" | "bfloat16");
+        accumulation is always f32 (reference GPU single-precision trade-off,
+        docs/GPU-Performance.rst:88; bf16 doubles the MXU rate).
     Returns:
       [F, B, C] float32 histogram.
     """
     impl = _pick_impl(impl)
     if impl == "pallas":
         from . import pallas_histogram
-        return pallas_histogram.build_histogram_pallas(bins, weights, num_bins)
+        return pallas_histogram.build_histogram_pallas(
+            bins, weights, num_bins, hist_dtype=hist_dtype)
     if impl == "onehot":
-        return _onehot_impl(bins, weights, num_bins, chunk=chunk)
+        acc = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
+        return _onehot_impl(bins, weights, num_bins, chunk=chunk,
+                            acc_dtype=acc)
     return _segment_impl(bins, weights, num_bins)

@@ -144,13 +144,18 @@ def predict_trees(stacked: StackedTrees, X: jnp.ndarray,
 def traverse_binned(split_feature, threshold_bin, default_left, left_child,
                     right_child, n_leaves, bins, num_bins_f, has_missing_f,
                     max_steps: int, is_cat_node=None,
-                    cat_left_mask=None) -> jnp.ndarray:
+                    cat_left_mask=None, bundle_of=None,
+                    offset_of=None) -> jnp.ndarray:
     """Leaf index per row for ONE freshly-grown tree, in bin space.
 
     Used for incremental validation-set score updates (reference
     ScoreUpdater::AddScore on valid sets, score_updater.hpp): the valid set is
     binned with the train mappers, so the bin-space decision is identical to
     the train-time partition (dense_bin.hpp Split semantics).
+
+    When EFB is active (bundle_of/offset_of given), ``bins`` holds bundle
+    columns and each node's member bin is decoded exactly like the
+    train-time partition (efb.py module docstring).
     """
     n = bins.shape[0]
     node = jnp.where(n_leaves > 1, 0, -1).astype(jnp.int32)
@@ -160,7 +165,14 @@ def traverse_binned(split_feature, threshold_bin, default_left, left_child,
         internal = node >= 0
         nd = jnp.maximum(node, 0)
         feat = split_feature[nd]
-        fbin = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if bundle_of is not None:
+            from ..efb import decode_member_bin
+            col = jnp.take_along_axis(
+                bins, bundle_of[feat][:, None], axis=1)[:, 0].astype(jnp.int32)
+            fbin = decode_member_bin(col, offset_of[feat], num_bins_f[feat])
+        else:
+            fbin = jnp.take_along_axis(
+                bins, feat[:, None], axis=1)[:, 0].astype(jnp.int32)
         missing_bin = num_bins_f[feat] - 1
         is_missing = has_missing_f[feat] & (fbin == missing_bin)
         go_left = jnp.where(is_missing, default_left[nd],

@@ -81,6 +81,7 @@ def find_best_split(
     cat_l2: float = 10.0, cat_smooth: float = 10.0,
     max_cat_threshold: int = 32, max_cat_to_onehot: int = 4,
     min_data_per_group: float = 100.0,
+    return_per_feature: bool = False,
 ) -> SplitResult:
     """Scan all candidate splits of one leaf, return the argmax candidate.
 
@@ -203,6 +204,12 @@ def find_best_split(
         valid &= (l_out <= output_hi) & (r_out <= output_hi)
 
     improvement = jnp.where(valid, improvement, _NEG_INF)
+
+    if return_per_feature:
+        # voting-parallel proposals: each feature's best local gain
+        # (reference VotingParallelTreeLearner local FindBestSplits,
+        # voting_parallel_tree_learner.cpp:344)
+        return improvement.max(axis=(0, 2))
 
     flat = improvement.reshape(-1)
     best = jnp.argmax(flat)

@@ -9,5 +9,8 @@ collectives (SURVEY §2.6 mapping).
 
 from .mesh import build_mesh
 from .data_parallel import DataParallelTreeLearner
+from .feature_parallel import FeatureParallelTreeLearner
+from .voting_parallel import VotingParallelTreeLearner
 
-__all__ = ["build_mesh", "DataParallelTreeLearner"]
+__all__ = ["build_mesh", "DataParallelTreeLearner",
+           "FeatureParallelTreeLearner", "VotingParallelTreeLearner"]

@@ -7,9 +7,9 @@ ReduceScatter+scan-owned-features+allreduce-best-split protocol
 ``shard_map`` with a ``psum`` on histograms (tree_learner.py hist_of) — every
 device then scans all features redundantly (cheap: O(F*B) vs O(N*F/B) for
 histograms) and deterministically agrees on the best split with zero extra
-communication.  Voting-parallel (PV-Tree) and feature-parallel modes reduce
-communication further and are layered on the same program (see
-voting/feature learners).
+communication.  Voting-parallel (PV-Tree, voting_parallel.py) and
+feature-parallel (feature_parallel.py) reduce communication further and are
+layered on the same grower program via GrowerConfig.parallel_mode.
 """
 
 from __future__ import annotations
@@ -34,26 +34,52 @@ __all__ = ["DataParallelTreeLearner"]
 class DataParallelTreeLearner(SerialTreeLearner):
     AXIS = "data"
 
+    def _mode(self) -> str:
+        return "data"
+
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
-        self.grower_cfg = self.grower_cfg._replace(axis_name=self.AXIS)
+        self.grower_cfg = self.grower_cfg._replace(
+            axis_name=self.AXIS, parallel_mode=self._mode(),
+            top_k=int(config.top_k))
 
         n = dataset.num_data
         self.pad = (-n) % self.n_dev
-        bins = dataset.bins
+        self.multiprocess = jax.process_count() > 1
+        bins = np.asarray(dataset.to_device_space(dataset.bins))
         if self.pad:
             bins = np.pad(bins, ((0, self.pad), (0, 0)))
         row_sharding = NamedSharding(self.mesh, P(self.AXIS, None))
-        self.sharded_bins = jax.device_put(jnp.asarray(bins), row_sharding)
         rep = NamedSharding(self.mesh, P())
-        self.num_bins_rep = jax.device_put(dataset.num_bins_per_feature, rep)
-        self.has_missing_rep = jax.device_put(dataset.has_missing_per_feature,
-                                              rep)
         self._row_sharding_1d = NamedSharding(self.mesh, P(self.AXIS))
         self._rep_sharding = rep
+        self.sharded_bins = self._put(jnp.asarray(bins), row_sharding)
+        self.num_bins_rep = self._put(dataset.num_bins_per_feature, rep)
+        self.has_missing_rep = self._put(dataset.has_missing_per_feature, rep)
         self._sharded_grow = self._build_sharded_grow()
+
+    def _put(self, arr, sharding):
+        """Place a host array under `sharding`.  Single-process: device_put.
+        Multi-process (every rank holds the full array, reference
+        pre_partition=false semantics): each rank contributes its local
+        shard (jax.make_array_from_process_local_data)."""
+        if not self.multiprocess:
+            return jax.device_put(arr, sharding)
+        arr = np.asarray(arr)
+        spec = sharding.spec
+        if len(spec) == 0 or spec[0] is None:     # replicated
+            return jax.make_array_from_process_local_data(
+                sharding, arr, global_shape=arr.shape)
+        # row-sharded: contiguous block per process (device order follows
+        # process order in build_mesh)
+        nproc = jax.process_count()
+        per = arr.shape[0] // nproc
+        lo = jax.process_index() * per
+        local = arr[lo:lo + per]
+        return jax.make_array_from_process_local_data(
+            sharding, local, global_shape=arr.shape)
 
     def _build_sharded_grow(self):
         cfg = self.grower_cfg
@@ -64,17 +90,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
             shard_map,
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
-                      P(), P(), P(), P(), P(), P()),     # feature meta + rng
+                      P(), P(), P(), P(), P(), P(), P()),  # feature meta + rng
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
             )._replace(row_leaf=P(ax)),
             check_vma=False)
-        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf):
+        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
+                    bmap):
             from ..tree_learner import grow_tree_compact
             grow = (grow_tree_compact
                     if self.config.grow_strategy == "compact" else grow_tree)
             return grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
-                        mono, key, icf)
+                        mono, key, icf, bmap)
 
         return sharded
 
@@ -96,7 +123,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             jax.device_put(self.feature_mask(), self._rep_sharding),
             jax.device_put(self.monotone, self._rep_sharding),
             jax.device_put(key, self._rep_sharding),
-            jax.device_put(self.is_cat_f, self._rep_sharding))
+            jax.device_put(self.is_cat_f, self._rep_sharding),
+            (None if self.bmap is None
+             else jax.device_put(self.bmap, self._rep_sharding)))
         if self.pad:
             state = state._replace(row_leaf=state.row_leaf[:self.dataset.num_data])
         return state

@@ -1,29 +1,115 @@
-"""Device mesh construction (replaces reference Network::Init topology setup,
-src/network/linkers_socket.cpp / linkers_mpi.cpp: instead of a TCP/MPI mesh of
-machines, a jax.sharding.Mesh over local + distributed devices)."""
+"""Device mesh construction + multi-host initialization.
+
+Replaces the reference Network::Init topology setup
+(src/network/linkers_socket.cpp:34-63 TCP mesh, linkers_mpi.cpp MPI): instead
+of a hand-rolled socket/MPI mesh of machines, ``jax.distributed`` joins the
+processes and a ``jax.sharding.Mesh`` over the global device list carries the
+collectives (ICI/DCN instead of ethernet).
+"""
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from ..log import log_info, log_warning
+
 __all__ = ["build_mesh", "maybe_init_distributed"]
 
+_initialized = False
 
-def maybe_init_distributed(config) -> None:
-    """Multi-host initialization (reference Network::Init; here
-    jax.distributed over the coordinator address from `machines`)."""
-    if config.machines and config.num_machines > 1:
-        first = config.machines.split(",")[0]
+
+def _local_ips() -> set:
+    import socket
+    ips = {"127.0.0.1", "localhost", "0.0.0.0"}
+    try:
+        hostname = socket.gethostname()
+        ips.add(hostname)
+        ips.update(socket.gethostbyname_ex(hostname)[2])
+    except OSError:
+        pass
+    return ips
+
+
+def _detect_rank(config) -> int:
+    """Rank resolution mirroring the reference's Linkers ctor: find this
+    process in the `machines` list by ip (+ port when several entries share
+    a local ip, e.g. localhost tests) — linkers_socket.cpp does the same
+    ip+port self-match; explicit env wins for launchers that export it."""
+    for var in ("LIGHTGBM_TPU_RANK", "JAX_PROCESS_ID", "RANK"):
+        if os.environ.get(var):
+            return int(os.environ[var])
+    entries = [m.strip() for m in config.machines.split(",") if m.strip()]
+    ips = _local_ips()
+    mine = []
+    for i, ent in enumerate(entries):
+        host, _, port = ent.rpartition(":")
+        if not host:
+            host, port = ent, "-1"
+        if host in ips:
+            mine.append((i, int(port)))
+    if len(mine) == 1:
+        return mine[0][0]
+    for i, port in mine:
+        if port == config.local_listen_port:
+            return i
+    raise ValueError(
+        "cannot determine distributed rank: set LIGHTGBM_TPU_RANK, or make "
+        "exactly one `machines` entry match this host (several matched: "
+        f"{mine}) — same-host processes need distinct local_listen_port "
+        "values (reference linkers_socket.cpp rank detection)")
+
+
+def maybe_init_distributed(config) -> bool:
+    """Join the multi-process cluster when configured (reference
+    Network::Init, application.cpp:170).  Idempotent; no-op for
+    single-process runs (incl. the virtual-CPU-mesh tests, which use
+    num_machines>1 with an empty `machines` list)."""
+    global _initialized
+    if _initialized or config.num_machines <= 1 or not config.machines:
+        return _initialized
+    # do NOT probe jax.process_count()/devices() here: that would initialize
+    # the local backend first and jax.distributed.initialize() then refuses
+    # to run ("must be called before any JAX computations")
+    try:
+        from jax._src import distributed as _jax_distributed
+        if getattr(_jax_distributed.global_state, "client", None) is not None:
+            _initialized = True          # another caller already joined
+            return True
+    except ImportError:
+        pass
+    coordinator = config.machines.split(",")[0].strip()
+    rank = _detect_rank(config)
+    log_info(f"initializing jax.distributed: coordinator={coordinator} "
+             f"rank={rank}/{config.num_machines}")
+    try:
         jax.distributed.initialize(
-            coordinator_address=first,
+            coordinator_address=coordinator,
             num_processes=config.num_machines,
-            process_id=None)  # auto-detect via env
+            process_id=rank,
+            initialization_timeout=config.time_out)
+    except RuntimeError as e:
+        if "before" in str(e):
+            log_warning(
+                "jax.distributed.initialize was called after the local "
+                "backend was already initialized; multi-host collectives "
+                "are unavailable in this process. Call train()/Application "
+                "before any other jax use, or pre-initialize "
+                "jax.distributed yourself.")
+            return False
+        raise
+    _initialized = True
+    return True
 
 
 def build_mesh(config, axis_name: str = "data") -> Mesh:
-    devices = jax.devices()
+    maybe_init_distributed(config)
+    devices = jax.devices()           # global across processes
     n = config.num_tpu_devices or len(devices)
     n = min(n, len(devices))
-    return Mesh(np.asarray(devices[:n]), (axis_name,))
+    if n < len(devices):
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (axis_name,))

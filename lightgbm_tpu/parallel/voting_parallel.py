@@ -1,0 +1,32 @@
+"""Voting-parallel (PV-Tree) learner: rows sharded, histogram communication
+reduced to the elected feature subset.
+
+TPU-native equivalent of the reference VotingParallelTreeLearner
+(src/treelearner/voting_parallel_tree_learner.cpp:151-344): per leaf, each
+shard proposes its local top-k features by split gain, the proposals are
+allgathered and tallied (GlobalVoting, :151-177), and only the 2k elected
+features' histograms are psum'd — sync cost O(2k*B) independent of the
+feature count, vs O(F*B) for data-parallel.  Everything else (row sharding,
+partition, histogram pool, subtraction trick) is shared with the
+data-parallel learner; the mode only changes the scan/communication step
+(tree_learner.py scan_voting).
+"""
+
+from __future__ import annotations
+
+from .data_parallel import DataParallelTreeLearner
+
+__all__ = ["VotingParallelTreeLearner"]
+
+
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    AXIS = "data"
+
+    def __init__(self, config, dataset):
+        if config.grow_strategy != "compact":
+            raise ValueError("tree_learner=voting requires "
+                             "grow_strategy=compact")
+        super().__init__(config, dataset)
+
+    def _mode(self) -> str:
+        return "voting"

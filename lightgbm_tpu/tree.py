@@ -58,6 +58,12 @@ class Tree:
         self.cat_threshold: List[int] = []   # uint32 bitset words
         self.shrinkage_ = 1.0
         self.is_linear = False
+        # linear leaves (reference linear_tree_learner; empty unless
+        # linear_tree=true): output = leaf_const + sum coeff*x, NaN rows
+        # fall back to leaf_value
+        self.leaf_const = np.zeros(m, dtype=np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(m)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(m)]
 
     # ------------------------------------------------------------------
     def split(self, leaf: int, feature: int, threshold_bin: int,
@@ -135,12 +141,19 @@ class Tree:
         n = self.num_leaves
         self.leaf_value[:n] *= rate
         self.internal_value[:max(n - 1, 0)] *= rate
+        if self.is_linear:
+            self.leaf_const[:n] *= rate
+            for leaf in range(n):
+                self.leaf_coeff[leaf] = [c * rate
+                                         for c in self.leaf_coeff[leaf]]
         self.shrinkage_ *= rate
 
     def add_bias(self, val: float) -> None:
         n = self.num_leaves
         self.leaf_value[:n] += val
         self.internal_value[:max(n - 1, 0)] += val
+        if self.is_linear:
+            self.leaf_const[:n] += val
         self.shrinkage_ = 1.0
 
     def scale_leaf(self, leaf_values: np.ndarray) -> None:
@@ -162,8 +175,27 @@ class Tree:
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         """Host-side vectorized prediction over raw feature values
-        (reference Tree::Predict -> NumericalDecision loop, tree.h:133,331)."""
-        return self.leaf_value[self.predict_leaf_index(X)]
+        (reference Tree::Predict -> NumericalDecision loop, tree.h:133,331;
+        linear leaves: tree.h AddPredictionToScore<is_linear=true>)."""
+        leaf = self.predict_leaf_index(X)
+        out = self.leaf_value[leaf]
+        if not self.is_linear:
+            return out
+        X = np.asarray(X, dtype=np.float64)
+        for lf in range(self.num_leaves):
+            coeffs = self.leaf_coeff[lf]
+            rows = leaf == lf
+            if not rows.any():
+                continue
+            if not coeffs:
+                out[rows] = self.leaf_const[lf]
+                continue
+            feats = np.asarray(self.leaf_features[lf], np.int32)
+            vals = X[np.ix_(rows, feats)]
+            nanrow = np.isnan(vals).any(axis=1)
+            lin = self.leaf_const[lf] + vals @ np.asarray(coeffs)
+            out[rows] = np.where(nanrow, self.leaf_value[lf], lin)
+        return out
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
@@ -241,6 +273,19 @@ class Tree:
             lines.append(f"cat_boundaries={arr(self.cat_boundaries, '{:d}')}")
             lines.append(f"cat_threshold={arr(self.cat_threshold, '{:d}')}")
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # reference linear-tree model keys (gbdt_model_text/tree.cpp):
+            # leaf_const + per-leaf feature lists/coefficients, flattened
+            # with per-leaf counts
+            counts = [len(self.leaf_features[lf]) for lf in range(n)]
+            flat_feats = [str(f) for lf in range(n)
+                          for f in self.leaf_features[lf]]
+            flat_coeff = ["{:.17g}".format(c) for lf in range(n)
+                          for c in self.leaf_coeff[lf]]
+            lines.append(f"leaf_const={arr(self.leaf_const[:n], '{:.17g}')}")
+            lines.append("num_features=" + " ".join(str(c) for c in counts))
+            lines.append("leaf_features=" + " ".join(flat_feats))
+            lines.append("leaf_coeff=" + " ".join(flat_coeff))
         lines.append(f"shrinkage={self.shrinkage_:g}")
         lines.append("")
         return "\n".join(lines)
@@ -287,6 +332,16 @@ class Tree:
                 t.threshold_in_bin[:ni])
         t.shrinkage_ = float(kv.get("shrinkage", 1.0))
         t.is_linear = bool(int(kv.get("is_linear", 0)))
+        if t.is_linear:
+            t.leaf_const[:n] = parse("leaf_const", np.float64, n)
+            counts = [int(x) for x in kv.get("num_features", "").split()]
+            feats = [int(x) for x in kv.get("leaf_features", "").split()]
+            coeff = [float(x) for x in kv.get("leaf_coeff", "").split()]
+            pos = 0
+            for lf, c in enumerate(counts[:n]):
+                t.leaf_features[lf] = feats[pos:pos + c]
+                t.leaf_coeff[lf] = coeff[pos:pos + c]
+                pos += c
         # rebuild leaf_parent and leaf_depth by walking from the root
         # (depth feeds stack_trees' traversal bound, ops/predict.py)
         if ni > 0:

@@ -1,0 +1,110 @@
+"""Staged microbenchmark of the GBDT hot path on the real chip.
+
+Remote-compile environments make every separate jit expensive, so stages are
+minimal and print timestamps incrementally (run with `python -u`).
+
+Usage: python -u profile_tpu.py [stage...]   (default: 1 2 3 4)
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+N = 1_000_000
+F = 28
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def timeit(f, *args, reps=3):
+    import jax
+    t0 = time.perf_counter()
+    r = f(*args)
+    jax.block_until_ready(r)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / reps, compile_s
+
+
+def main():
+    stages = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4]
+    log("importing jax...")
+    import jax
+    import jax.numpy as jnp
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    bins = jnp.asarray(rng.randint(0, 64, size=(N, F)), jnp.uint8)
+    grad = jnp.asarray(rng.randn(N), jnp.float32)
+    hess = jnp.abs(grad) + 0.1
+    mask = jnp.ones((N,), jnp.float32)
+    w3 = jnp.stack([grad, hess, mask], axis=1)
+    jax.block_until_ready(w3)
+    log(f"stage1 transfer {N}x{F} uint8 + 3xN f32: "
+        f"{time.perf_counter()-t0:.2f}s")
+
+    if 2 in stages:
+        from lightgbm_tpu.ops.pallas_histogram import build_histogram_pallas_tr
+        rows = 131_072
+        bt = jnp.asarray(np.ascontiguousarray(
+            np.asarray(bins[:rows]).T))
+        for b, dt in [(64, "float32"), (64, "bfloat16"), (256, "float32")]:
+            t, c = timeit(functools.partial(
+                build_histogram_pallas_tr, num_bins=b, hist_dtype=dt),
+                bt, w3[:rows])
+            gops = rows * F / 1e9
+            log(f"stage2 pallas hist rows={rows} B={b} {dt}: {t*1e3:.3f} ms "
+                f"({gops/t:.2f} G row-feat/s; compile {c:.1f}s)")
+
+    if 3 in stages:
+        idx = jnp.asarray(rng.randint(0, N, size=131_072), jnp.int32)
+        t, c = timeit(jax.jit(lambda b, i: jnp.take(b, i, axis=0)), bins, idx)
+        log(f"stage3 row-gather 131k x {F}B: {t*1e3:.3f} ms (compile {c:.1f}s)")
+        t, c = timeit(jax.jit(lambda g, i: g[i]), grad, idx)
+        log(f"stage3 1d-gather 131k: {t*1e3:.3f} ms (compile {c:.1f}s)")
+        perm = jnp.asarray(rng.permutation(N), jnp.int32)
+        vals = jnp.arange(N, dtype=jnp.int32)
+        t, c = timeit(jax.jit(lambda p, v: jnp.zeros((N,), jnp.int32)
+                              .at[p].set(v, unique_indices=True,
+                                         mode="promise_in_bounds")), perm, vals)
+        log(f"stage3 scatter {N}: {t*1e3:.3f} ms (compile {c:.1f}s)")
+        x = jnp.asarray((rng.rand(N) > 0.5))
+        t, c = timeit(jax.jit(
+            lambda m: jnp.searchsorted(jnp.cumsum(m.astype(jnp.int32)),
+                                       jnp.arange(N, dtype=jnp.int32) + 1)),
+            x)
+        log(f"stage3 cumsum+searchsorted {N}: {t*1e3:.3f} ms (compile {c:.1f}s)")
+
+    if 4 in stages:
+        from lightgbm_tpu.tree_learner import (GrowerConfig,
+                                               grow_tree_compact_jit)
+        B = int(np.asarray(bins).max()) + 1 if False else 64
+        cfg = GrowerConfig(num_leaves=255, num_bins=B,
+                           min_data_in_leaf=100.0, hist_dtype="float32")
+        nb = jnp.full((F,), B, jnp.int32)
+        hm = jnp.zeros((F,), bool)
+        fm = jnp.ones((F,), bool)
+        mono = jnp.zeros((F,), jnp.int8)
+        key = jax.random.PRNGKey(0)
+
+        def run():
+            st = grow_tree_compact_jit(cfg, bins, grad, hess, mask, nb, hm,
+                                       fm, mono, key)
+            return st.n_leaves
+        t, c = timeit(run)
+        log(f"stage4 grow_compact N={N} B={B} L=255: {t*1e3:.1f} ms/tree "
+            f"({t/254*1e3:.3f} ms/split; compile {c:.1f}s)")
+
+    log("PROFILE_COMPLETE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""SHAP pred_contrib vs a brute-force Shapley oracle.
+
+Mirrors the reference's contrib tests (tests/python_package_test/
+test_engine.py:1031-1158: shape, sum-to-raw-prediction, multiclass layout).
+The oracle enumerates all feature subsets and computes path-dependent
+conditional expectations exactly — independent of the polynomial
+implementation in lightgbm_tpu/contrib.py.
+"""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cond_expectation(tree, x, S):
+    """E[f(x) | features in S fixed], path-dependent weighting (the same
+    distribution TreeSHAP conditions on)."""
+
+    def rec(code):
+        if code < 0:
+            return tree.leaf_value[~code]
+        feat = tree.split_feature[code]
+        l, r = tree.left_child[code], tree.right_child[code]
+
+        def w(c):
+            if c >= 0:
+                v = tree.internal_weight[c]
+                return v if v > 0 else float(tree.internal_count[c])
+            v = tree.leaf_weight[~c]
+            return v if v > 0 else float(tree.leaf_count[~c])
+
+        if feat in S:
+            go_left = x[feat] <= tree.threshold[code]
+            return rec(l) if go_left else rec(r)
+        wl, wr = w(l), w(r)
+        tot = max(wl + wr, 1e-12)
+        return (wl * rec(l) + wr * rec(r)) / tot
+
+    return rec(0)
+
+
+def _oracle_shap(tree, x, num_features):
+    phi = np.zeros(num_features + 1)
+    feats = list(range(num_features))
+    for i in feats:
+        others = [f for f in feats if f != i]
+        for k in range(len(others) + 1):
+            for S in itertools.combinations(others, k):
+                S = set(S)
+                wgt = (math.factorial(len(S)) *
+                       math.factorial(num_features - len(S) - 1) /
+                       math.factorial(num_features))
+                phi[i] += wgt * (_cond_expectation(tree, x, S | {i}) -
+                                 _cond_expectation(tree, x, S))
+    phi[num_features] = _cond_expectation(tree, x, set())
+    return phi
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    rng = np.random.RandomState(7)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] * (X[:, 2] > 0) +
+         0.1 * rng.randn(800)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+              "min_data_in_leaf": 20, "learning_rate": 0.5}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3)
+    return bst, X
+
+
+def test_contrib_matches_bruteforce_oracle(small_model):
+    bst, X = small_model
+    contrib = bst.predict(X[:16], pred_contrib=True)
+    trees = bst._gbdt.models
+    expected = np.zeros((16, 5))
+    for tree in trees:
+        for r in range(16):
+            expected[r] += _oracle_shap(tree, X[r], 4)
+    np.testing.assert_allclose(contrib, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_sums_to_raw_prediction(small_model):
+    bst, X = small_model
+    contrib = bst.predict(X[:64], pred_contrib=True)
+    raw = bst.predict(X[:64], raw_score=True)
+    assert contrib.shape == (64, 5)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_contrib_multiclass_shape_and_sum():
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1, "min_data_in_leaf": 10}
+    bst = lgb.train(params, lgb.Dataset(X, y.astype(np.float32)),
+                    num_boost_round=4)
+    contrib = bst.predict(X[:32], pred_contrib=True)
+    # reference layout: [N, (F+1) * K]
+    assert contrib.shape == (32, 6 * 3)
+    raw = bst.predict(X[:32], raw_score=True)
+    for cls in range(3):
+        np.testing.assert_allclose(
+            contrib[:, cls * 6:(cls + 1) * 6].sum(axis=1), raw[:, cls],
+            rtol=1e-3, atol=1e-3)
+
+
+def test_contrib_with_missing_values(small_model):
+    bst, X = small_model
+    Xm = X[:8].copy()
+    Xm[2, 1] = np.nan
+    contrib = bst.predict(Xm, pred_contrib=True)
+    raw = bst.predict(Xm, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4,
+                               atol=1e-4)
