@@ -1,0 +1,400 @@
+// C ABI for the lightgbm_tpu framework.
+//
+// TPU-native equivalent of the reference's stable C API
+// (src/c_api.cpp / include/LightGBM/c_api.h): the same LGBM_* entry points
+// and calling conventions, implemented by embedding the CPython runtime that
+// hosts the JAX/XLA compute core.  The reference wraps a C++ Booster behind
+// the ABI; here the ABI wraps the Python Booster/Dataset objects — handles
+// are opaque PyObject* — with the identical thread-safety contract (the
+// Python layer's reader-writer lock stands in for the reference's yamc
+// shared-mutex, c_api.cpp:831).
+//
+// Error convention mirrors c_api.h: functions return 0 on success, -1 on
+// failure, and LGBM_GetLastError() returns a thread-local message.
+//
+// Build: make -C c_api   (links libpython; see c_api/Makefile)
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+static thread_local std::string g_last_error = "everything is fine";
+static std::once_flag g_init_once;
+
+static void set_error(const std::string& msg) { g_last_error = msg; }
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+namespace {
+
+// Capture the active Python exception into the thread-local error slot.
+void capture_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      set_error(PyUnicode_AsUTF8(s));
+      Py_DECREF(s);
+    }
+  } else {
+    set_error("unknown python error");
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);  // no signal handlers: we are a guest runtime
+#if PY_VERSION_HEX < 0x030900f0
+      PyEval_InitThreads();
+#endif
+      // the embedded interpreter starts with the GIL held by this thread;
+      // release it so every entry point can use PyGILState_Ensure
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL guard for every ABI entry point.
+class Gil {
+ public:
+  Gil() {
+    ensure_python();
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* api_module() {
+  static PyObject* mod = nullptr;  // borrowed forever once imported
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  }
+  return mod;
+}
+
+// Call lightgbm_tpu.capi_impl.<fn>(args...); returns new reference or null.
+PyObject* call_api(const char* fn, PyObject* args) {
+  PyObject* mod = api_module();
+  if (mod == nullptr) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+// 1-D/2-D float64 numpy-compatible memoryview over caller memory (copied
+// python-side before any lazy use, mirroring the reference's copy-on-push).
+PyObject* make_matrix(const void* data, int data_type, int32_t nrow,
+                      int32_t ncol) {
+  // build a bytes object + shape/dtype; capi_impl reconstructs np.ndarray
+  const char* dtype;
+  size_t esize;
+  switch (data_type) {
+    case 0: dtype = "float32"; esize = 4; break;  // C_API_DTYPE_FLOAT32
+    case 1: dtype = "float64"; esize = 8; break;  // C_API_DTYPE_FLOAT64
+    case 2: dtype = "int32";   esize = 4; break;  // C_API_DTYPE_INT32
+    case 3: dtype = "int64";   esize = 8; break;  // C_API_DTYPE_INT64
+    default: dtype = "float64"; esize = 8; break;
+  }
+  size_t nbytes = esize * static_cast<size_t>(nrow) *
+                  static_cast<size_t>(ncol < 1 ? 1 : ncol);
+  PyObject* payload = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  if (payload == nullptr) return nullptr;
+  PyObject* out = Py_BuildValue("(Nsii)", payload, dtype, nrow, ncol);
+  return out;
+}
+
+int run_simple(const char* fn, PyObject* args, PyObject** result) {
+  PyObject* out = call_api(fn, args);
+  Py_XDECREF(args);
+  if (out == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  if (result != nullptr) {
+    *result = out;
+  } else {
+    Py_DECREF(out);
+  }
+  return 0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Dataset (reference c_api.h:92-296)
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          const DatasetHandle reference,
+                                          DatasetHandle* out) {
+  Gil gil;
+  PyObject* mat = make_matrix(data, data_type, nrow, ncol);
+  if (mat == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue(
+      "(NisO)", mat, is_row_major, parameters ? parameters : "",
+      reference ? static_cast<PyObject*>(reference) : Py_None);
+  PyObject* handle = nullptr;
+  if (run_simple("dataset_create_from_mat", args, &handle) != 0) return -1;
+  *out = handle;  // ownership transferred to the caller
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(ssO)", filename, parameters ? parameters : "",
+      reference ? static_cast<PyObject*>(reference) : Py_None);
+  PyObject* handle = nullptr;
+  if (run_simple("dataset_create_from_file", args, &handle) != 0) return -1;
+  *out = handle;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                     const char* field_name, const void* data,
+                                     int num_element, int type) {
+  Gil gil;
+  PyObject* vec = make_matrix(data, type, num_element, 1);
+  if (vec == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args =
+      Py_BuildValue("(OsN)", static_cast<PyObject*>(handle), field_name, vec);
+  return run_simple("dataset_set_field", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("dataset_num_data", args, &res) != 0) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("dataset_num_feature", args, &res) != 0) return -1;
+  *out = static_cast<int32_t>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Booster (reference c_api.h:406-1041)
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                   const char* parameters,
+                                   BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(train_data),
+                                 parameters ? parameters : "");
+  PyObject* handle = nullptr;
+  if (run_simple("booster_create", args, &handle) != 0) return -1;
+  *out = handle;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", filename);
+  PyObject* res = nullptr;
+  if (run_simple("booster_create_from_modelfile", args, &res) != 0) return -1;
+  PyObject* handle = PyTuple_GetItem(res, 0);
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_INCREF(handle);
+  *out = handle;
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                         const DatasetHandle valid_data) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 static_cast<PyObject*>(valid_data));
+  return run_simple("booster_add_valid", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                          int* is_finished) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_update_one_iter", args, &res) != 0) return -1;
+  *is_finished = PyObject_IsTrue(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  return run_simple("booster_rollback_one_iter", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_num_classes", args, &res) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                                int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_current_iteration", args, &res) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                    int* out_len, double* out_results) {
+  Gil gil;
+  PyObject* args =
+      Py_BuildValue("(Oi)", static_cast<PyObject*>(handle), data_idx);
+  PyObject* res = nullptr;
+  if (run_simple("booster_get_eval", args, &res) != 0) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(res, i));
+  }
+  *out_len = static_cast<int>(n);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                                          const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major, int predict_type,
+                                          int start_iteration,
+                                          int num_iteration,
+                                          const char* parameter,
+                                          int64_t* out_len,
+                                          double* out_result) {
+  Gil gil;
+  PyObject* mat = make_matrix(data, data_type, nrow, ncol);
+  if (mat == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue(
+      "(ONiiis)", static_cast<PyObject*>(handle), mat, is_row_major,
+      predict_type, num_iteration, parameter ? parameter : "");
+  PyObject* res = nullptr;
+  if (run_simple("booster_predict_for_mat", args, &res) != 0) return -1;
+  // res is a bytes object of float64
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0) {
+    Py_DECREF(res);
+    capture_py_error();
+    return -1;
+  }
+  std::memcpy(out_result, buf, static_cast<size_t>(nbytes));
+  *out_len = static_cast<int64_t>(nbytes / 8);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                      int start_iteration, int num_iteration,
+                                      int feature_importance_type,
+                                      const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiis)", static_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration, filename);
+  return run_simple("booster_save_model", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(
+    BoosterHandle handle, int start_iteration, int num_iteration,
+    int feature_importance_type, int64_t buffer_len, int64_t* out_len,
+    char* out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)", static_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration);
+  PyObject* res = nullptr;
+  if (run_simple("booster_save_model_to_string", args, &res) != 0) return -1;
+  Py_ssize_t size;
+  const char* s = PyUnicode_AsUTF8AndSize(res, &size);
+  *out_len = static_cast<int64_t>(size) + 1;
+  if (buffer_len >= size + 1) {
+    std::memcpy(out_str, s, static_cast<size_t>(size) + 1);
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", model_str);
+  PyObject* res = nullptr;
+  if (run_simple("booster_load_model_from_string", args, &res) != 0)
+    return -1;
+  PyObject* handle = PyTuple_GetItem(res, 0);
+  *out_num_iterations =
+      static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_INCREF(handle);
+  *out = handle;
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(BoosterHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}

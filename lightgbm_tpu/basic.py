@@ -109,6 +109,31 @@ class Dataset:
         elif type(data).__name__ == "DataFrame":
             self._feature_names = [str(c) for c in data.columns]
             arr, self._pandas_cats = _pandas_categorical(data)
+        elif (self.reference is None and self._used_indices is None
+              and (isinstance(data, Sequence)
+                   or (isinstance(data, list) and data
+                       and isinstance(data[0], Sequence)))):
+            # out-of-core path: two-round streaming construction, the raw
+            # matrix is never materialized (reference Sequence +
+            # two_round semantics, basic.py:608, utils/pipeline_reader.h)
+            seqs = [data] if isinstance(data, Sequence) else list(data)
+            n = int(sum(len(s) for s in seqs))
+            label = self.label if self.label is not None else np.zeros(
+                n, np.float32)
+            meta = Metadata(np.asarray(label),
+                            None if self.weight is None
+                            else np.asarray(self.weight),
+                            np.asarray(self.group)
+                            if self.group is not None else None,
+                            None if self.init_score is None
+                            else np.asarray(self.init_score))
+            cfg = Config(self.params)
+            cats = self._resolve_categoricals(0)
+            self._handle = TrainDataset.from_sequences(
+                seqs, meta, cfg, categorical_features=cats)
+            if self.free_raw_data:
+                self.data = None
+            return self
         else:
             arr = _to_2d_numpy(data)
 
@@ -233,6 +258,65 @@ class Dataset:
         return ds
 
 
+class _RWLock:
+    """Reader-writer lock guarding Booster mutation vs concurrent predict
+    (reference: yamc shared-mutex around Booster train/predict,
+    src/c_api.cpp:106,831).  Writer-exclusive, multiple readers."""
+
+    def __init__(self):
+        import threading
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextmanager
+    def write(self):
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
+
+    def __getstate__(self):
+        return {}          # locks don't pickle; a fresh one is equivalent
+
+    def __setstate__(self, state):
+        self.__init__()
+
+
 class Booster:
     """Training/prediction handle (reference lightgbm.Booster, basic.py:2465)."""
 
@@ -242,6 +326,7 @@ class Booster:
                  model_str: Optional[str] = None):
         self.params = dict(params or {})
         self._gbdt = None
+        self._lock = _RWLock()
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._train_set = train_set
@@ -278,12 +363,14 @@ class Booster:
 
     def update(self, train_set=None, fobj=None) -> bool:
         """One boosting iteration; returns True if no further splits possible
-        (reference LGBM_BoosterUpdateOneIter / ...Custom, c_api.cpp:1677,1698)."""
-        if fobj is not None:
-            score = self._raw_train_score()
-            grad, hess = fobj(score, self._train_set)
-            return self._gbdt.train_one_iter(grad, hess)
-        return self._gbdt.train_one_iter()
+        (reference LGBM_BoosterUpdateOneIter / ...Custom, c_api.cpp:1677,1698;
+        write-locked like the reference Booster's shared-mutex)."""
+        with self._lock.write():
+            if fobj is not None:
+                score = self._raw_train_score()
+                grad, hess = fobj(score, self._train_set)
+                return self._gbdt.train_one_iter(grad, hess)
+            return self._gbdt.train_one_iter()
 
     def _raw_train_score(self):
         score = np.asarray(self._gbdt.train_score)
@@ -292,7 +379,8 @@ class Booster:
         return score.T  # sklearn convention [N, K]
 
     def rollback_one_iter(self) -> "Booster":
-        self._gbdt.rollback_one_iter()
+        with self._lock.write():
+            self._gbdt.rollback_one_iter()
         return self
 
     def current_iteration(self) -> int:
@@ -349,19 +437,20 @@ class Booster:
             num_iteration = -1
         if num_iteration < 0 and self.best_iteration > 0:
             num_iteration = self.best_iteration
-        if self._gbdt is not None:
-            if pred_leaf:
-                return self._gbdt.predict_leaf_index(data, start_iteration,
-                                                     num_iteration)
-            if pred_contrib:
-                from .contrib import predict_contrib
-                return predict_contrib(self._trees_for_range(
-                    start_iteration, num_iteration), data,
-                    self.num_model_per_iteration())
-            return self._gbdt.predict(data, raw_score, start_iteration,
-                                      num_iteration)
-        return self._predict_loaded(data, start_iteration, num_iteration,
-                                    raw_score, pred_leaf, pred_contrib)
+        with self._lock.read():
+            if self._gbdt is not None:
+                if pred_leaf:
+                    return self._gbdt.predict_leaf_index(
+                        data, start_iteration, num_iteration)
+                if pred_contrib:
+                    from .contrib import predict_contrib
+                    return predict_contrib(self._trees_for_range(
+                        start_iteration, num_iteration), data,
+                        self.num_model_per_iteration())
+                return self._gbdt.predict(data, raw_score, start_iteration,
+                                          num_iteration)
+            return self._predict_loaded(data, start_iteration, num_iteration,
+                                        raw_score, pred_leaf, pred_contrib)
 
     def _trees_for_range(self, start_iteration, num_iteration):
         k = self.num_model_per_iteration()

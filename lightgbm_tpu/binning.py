@@ -107,7 +107,8 @@ class BinMapper:
     def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
                  min_data_in_bin: int = 3, min_split_data: int = 0,
                  pre_filter: bool = True, bin_type: str = BinType.NUMERICAL,
-                 use_missing: bool = True, zero_as_missing: bool = False) -> "BinMapper":
+                 use_missing: bool = True, zero_as_missing: bool = False,
+                 forced_bounds=None) -> "BinMapper":
         """Compute the mapping from sampled values (reference BinMapper::FindBin,
         bin.h:160 / src/io/bin.cpp).  ``values`` are the sampled non-missing raw
         values; rows not present in ``values`` out of ``total_sample_cnt`` are
@@ -136,7 +137,7 @@ class BinMapper:
                                        min_data_in_bin)
         else:
             self._find_bin_numerical(values, total_sample_cnt, zero_cnt, na_cnt,
-                                     max_bin, min_data_in_bin)
+                                     max_bin, min_data_in_bin, forced_bounds)
 
         counts = self._bin_counts(values, total_sample_cnt)
         if counts.sum() > 0:
@@ -152,7 +153,7 @@ class BinMapper:
         return self
 
     def _find_bin_numerical(self, values, total, zero_cnt, na_cnt, max_bin,
-                            min_data_in_bin):
+                            min_data_in_bin, forced_bounds=None):
         non_zero = values[(values <= _K_ZERO_LOW) | (values >= _K_ZERO_HIGH)]
         self.min_val = float(non_zero.min()) if len(non_zero) else 0.0
         self.max_val = float(non_zero.max()) if len(non_zero) else 0.0
@@ -167,8 +168,24 @@ class BinMapper:
         if len(distinct) == 0:
             upper = [np.inf]
         else:
-            upper = _greedy_find_bin(distinct, counts,
-                                     usable_bins, int(counts.sum()), min_data_in_bin)
+            if forced_bounds:
+                # reference forced bins (dataset_loader.cpp forced_bin_bounds):
+                # the user bounds are kept verbatim, the remaining budget is
+                # found greedily; the merge never exceeds usable_bins
+                fb = sorted(float(b) for b in forced_bounds)[:usable_bins - 1]
+                rest = _greedy_find_bin(distinct, counts,
+                                        max(usable_bins - len(fb), 2),
+                                        int(counts.sum()), min_data_in_bin)
+                extra = [float(u) for u in rest if float(u) not in set(fb)]
+                keep = max(usable_bins - len(fb), 1)
+                upper = sorted(set(fb) | set(extra[:keep]))
+                if np.inf not in upper:
+                    upper[-1] = np.inf  # last bound must cover the tail
+                upper = sorted(set(upper))[:usable_bins]
+                upper[-1] = np.inf
+            else:
+                upper = _greedy_find_bin(distinct, counts, usable_bins,
+                                         int(counts.sum()), min_data_in_bin)
         self.bin_upper_bound = np.asarray(upper, dtype=np.float64)
         self.num_bin = len(upper)
         if self.missing_type in (MissingType.NAN, MissingType.ZERO):
@@ -293,12 +310,22 @@ def find_bin_mappers(sample: np.ndarray, max_bin: int = 255,
                      use_missing: bool = True, zero_as_missing: bool = False,
                      min_split_data: int = 0,
                      max_bin_by_feature: Optional[Sequence[int]] = None,
-                     feature_pre_filter: bool = True) -> List[BinMapper]:
+                     feature_pre_filter: bool = True,
+                     forced_bins_path: str = "") -> List[BinMapper]:
     """Find one BinMapper per column of a sampled row-block
-    (reference DatasetLoader::ConstructBinMappersFromTextData path)."""
+    (reference DatasetLoader::ConstructBinMappersFromTextData path).
+
+    forced_bins_path: JSON file of [{"feature": i, "bin_upper_bound":
+    [...]}, ...] (reference forcedbins_filename, dataset_loader.cpp)."""
     sample = np.asarray(sample, dtype=np.float64)
     n, num_features = sample.shape
     cats = set(categorical_features or ())
+    forced = {}
+    if forced_bins_path:
+        import json
+        with open(forced_bins_path) as fh:
+            for ent in json.load(fh):
+                forced[int(ent["feature"])] = list(ent["bin_upper_bound"])
     mappers = []
     for f in range(num_features):
         mb = max_bin if max_bin_by_feature is None else int(max_bin_by_feature[f])
@@ -306,6 +333,7 @@ def find_bin_mappers(sample: np.ndarray, max_bin: int = 255,
             sample[:, f], n, mb, min_data_in_bin, min_split_data,
             pre_filter=feature_pre_filter,
             bin_type=BinType.CATEGORICAL if f in cats else BinType.NUMERICAL,
-            use_missing=use_missing, zero_as_missing=zero_as_missing)
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            forced_bounds=forced.get(f))
         mappers.append(m)
     return mappers

@@ -22,6 +22,7 @@ from ..tree_learner import SerialTreeLearner, state_to_tree
 from ..ops.predict import traverse_binned
 from ..metrics import create_metrics
 from ..log import log_info, log_warning
+from ..timer import timed
 
 __all__ = ["GBDT"]
 
@@ -180,6 +181,7 @@ class GBDT:
                 and not self.objective.need_renew_tree_output
                 and not self.valid_sets
                 and not self.config.linear_tree
+                and not getattr(self.tree_learner, "use_cegb", False)
                 and type(self.tree_learner) is SerialTreeLearner)
 
     def _build_fused_step(self):
@@ -212,10 +214,11 @@ class GBDT:
             self._fused_step = self._build_fused_step()
         learner = self.tree_learner
         mask = self._bagging_mask(self.iter_)
-        new_score, slim = self._fused_step(
-            self.train_score[0], mask, learner.feature_mask(),
-            learner.iter_key(self.iter_),
-            jnp.float32(self.shrinkage_rate))
+        with timed("fused_train_iter"):
+            new_score, slim = self._fused_step(
+                self.train_score[0], mask, learner.feature_mask(),
+                learner.iter_key(self.iter_),
+                jnp.float32(self.shrinkage_rate))
         self.train_score = new_score[None, :]
         self._pending.append((slim, float(init), self.shrinkage_rate))
         self.iter_ += 1
@@ -232,7 +235,8 @@ class GBDT:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
-        states = jax.device_get([p[0] for p in pending])
+        with timed("flush_states_to_host"):
+            states = jax.device_get([p[0] for p in pending])
         for state, (_, init, lr) in zip(states, pending):
             tree = state_to_tree(state, self.train_data.feature_mappers,
                                  self.train_data.real_feature_index)
@@ -280,14 +284,53 @@ class GBDT:
         constant init score, reference rf.hpp:132-135)."""
         return np.asarray(self.train_score[cls])
 
+    def _cegb_penalty(self):
+        """Per-feature CEGB gain penalty for this iteration (reference
+        CostEfficientGradientBoosting::DetlaGain: tradeoff * (split penalty
+        + coupled feature penalty for features not yet used anywhere in the
+        model); the lazy per-datapoint penalty is not implemented)."""
+        if not getattr(self.tree_learner, "use_cegb", False):
+            return None
+        cfg = self.config
+        ds = self.train_data
+        if not hasattr(self, "_cegb_used"):
+            self._cegb_used = np.zeros(ds.num_features, bool)
+        pen = np.full(ds.num_features,
+                      cfg.cegb_tradeoff * cfg.cegb_penalty_split, np.float32)
+        if cfg.cegb_penalty_feature_coupled:
+            coupled = list(cfg.cegb_penalty_feature_coupled)
+            for inner, real in enumerate(ds.real_feature_index):
+                if real < len(coupled) and not self._cegb_used[inner]:
+                    pen[inner] += cfg.cegb_tradeoff * float(coupled[real])
+        return jnp.asarray(pen)
+
+    def _cegb_mark_used(self, tree: Tree):
+        if not getattr(self.tree_learner, "use_cegb", False):
+            return
+        inv = {real: inner for inner, real in
+               enumerate(self.train_data.real_feature_index)}
+        for node in range(tree.num_leaves - 1):
+            inner = inv.get(int(tree.split_feature[node]))
+            if inner is not None:
+                self._cegb_used[inner] = True
+
     def _grow_and_apply(self, grad, hess, mask, init_scores) -> bool:
         obj = self.objective
         any_split = False
         for cls in range(self.num_class):
-            state = self.tree_learner.train(grad[cls], hess[cls], mask,
-                                            self.iter_)
-            tree = state_to_tree(state, self.train_data.feature_mappers,
-                                 self.train_data.real_feature_index)
+            # recomputed per class: a feature used by class k's tree is
+            # free for class k+1 in the same iteration (reference DeltaGain
+            # checks the live feature_used state)
+            cegb_pen = self._cegb_penalty()
+            with timed("tree_learner_train"):
+                state = self.tree_learner.train(grad[cls], hess[cls], mask,
+                                                self.iter_,
+                                                gain_penalty=cegb_pen)
+            with timed("state_to_tree"):
+                tree = state_to_tree(state,
+                                     self.train_data.feature_mappers,
+                                     self.train_data.real_feature_index)
+            self._cegb_mark_used(tree)
             row_out = None
             if (self.config.linear_tree and tree.num_leaves > 1
                     and self.train_data.raw_device is not None):
@@ -516,8 +559,25 @@ class GBDT:
         bins = jnp.asarray(self.train_data.to_device_space(
             self.train_data.bin_external(X)))
         score = jnp.zeros((k, n), jnp.float32)
-        for i, tree in enumerate(trees):
-            score = self._add_tree_to_score(score, i % k, tree, bins)
+        cfg = self.config
+        early = bool(getattr(cfg, "pred_early_stop", False))
+        freq = max(int(getattr(cfg, "pred_early_stop_freq", 10)), 1)
+        margin = float(getattr(cfg, "pred_early_stop_margin", 10.0))
+        frozen = jnp.zeros((n,), bool) if early else None
+        for it in range(len(trees) // k):
+            for cls in range(k):
+                tree = trees[it * k + cls]
+                new_score = self._add_tree_to_score(score, cls, tree, bins)
+                score = (new_score if frozen is None else
+                         jnp.where(frozen[None, :], score, new_score))
+            if early and (it + 1) % freq == 0:
+                # reference PredictionEarlyStopInstance (prediction_early_
+                # stop.cpp): binary = |margin|, multiclass = top1-top2 gap
+                if k == 1:
+                    frozen = frozen | (jnp.abs(score[0]) * 2.0 > margin)
+                else:
+                    top2 = jax.lax.top_k(score.T, 2)[0]
+                    frozen = frozen | ((top2[:, 0] - top2[:, 1]) > margin)
         out = np.asarray(score, np.float64)
         return out[0] if k == 1 else out.T
 

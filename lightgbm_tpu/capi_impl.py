@@ -1,0 +1,176 @@
+"""Python backend of the C ABI (c_api/lightgbm_tpu_c_api.cpp).
+
+Each function here implements one LGBM_* entry point's semantics over the
+package's Dataset/Booster objects (reference src/c_api.cpp bodies).  The C
+layer passes matrices as (bytes, dtype, nrow, ncol) tuples and holds
+PyObject* handles to the objects returned here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import resolve_aliases
+
+__all__ = [
+    "dataset_create_from_mat", "dataset_create_from_file",
+    "dataset_set_field", "dataset_num_data", "dataset_num_feature",
+    "booster_create", "booster_create_from_modelfile", "booster_add_valid",
+    "booster_update_one_iter", "booster_rollback_one_iter",
+    "booster_num_classes", "booster_current_iteration", "booster_get_eval",
+    "booster_predict_for_mat", "booster_save_model",
+    "booster_save_model_to_string", "booster_load_model_from_string",
+]
+
+# reference c_api.h predict type constants
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+
+def _parse_params(parameters: str) -> dict:
+    """'key=value key2=value2' -> dict (reference Config::KV2Map)."""
+    out = {}
+    for tok in parameters.replace("\n", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return resolve_aliases(out)
+
+
+def _matrix(mat: Tuple[bytes, str, int, int], row_major: int) -> np.ndarray:
+    payload, dtype, nrow, ncol = mat
+    arr = np.frombuffer(payload, dtype=dtype)
+    if ncol > 1:
+        arr = (arr.reshape(nrow, ncol) if row_major
+               else arr.reshape(ncol, nrow).T)
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def dataset_create_from_mat(mat, is_row_major: int, parameters: str,
+                            reference) -> Dataset:
+    data = _matrix(mat, is_row_major)
+    params = _parse_params(parameters)
+    ds = Dataset(data, params=params,
+                 reference=reference if isinstance(reference, Dataset)
+                 else None, free_raw_data=False)
+    return ds
+
+
+def dataset_create_from_file(filename: str, parameters: str,
+                             reference) -> Dataset:
+    from .io.parser import load_svmlight_or_csv
+    X, y = load_svmlight_or_csv(filename)
+    params = _parse_params(parameters)
+    ds = Dataset(X, label=y, params=params,
+                 reference=reference if isinstance(reference, Dataset)
+                 else None, free_raw_data=False)
+    return ds
+
+
+def dataset_set_field(ds: Dataset, field_name: str, vec) -> None:
+    arr = np.frombuffer(vec[0], dtype=vec[1])
+    if field_name == "label":
+        ds.set_label(arr)
+    elif field_name == "weight":
+        ds.set_weight(arr)
+    elif field_name == "group":
+        ds.set_group(arr)
+    elif field_name == "init_score":
+        ds.set_init_score(arr)
+    else:
+        raise ValueError(f"unknown field {field_name!r} "
+                         "(reference LGBM_DatasetSetField)")
+
+
+def dataset_num_data(ds: Dataset) -> int:
+    ds.construct()
+    return int(ds.num_data())
+
+
+def dataset_num_feature(ds: Dataset) -> int:
+    ds.construct()
+    return int(ds._handle.num_features)
+
+
+def booster_create(train_ds: Dataset, parameters: str) -> Booster:
+    params = _parse_params(parameters)
+    return Booster(params=params, train_set=train_ds)
+
+
+def booster_create_from_modelfile(filename: str):
+    bst = Booster(model_file=filename)
+    return bst, bst.num_trees() // max(bst.num_model_per_iteration(), 1)
+
+
+def booster_add_valid(bst: Booster, valid: Dataset) -> None:
+    bst.add_valid(valid, f"valid_{len(bst._valid_names)}")
+
+
+def booster_update_one_iter(bst: Booster) -> bool:
+    return bool(bst.update())
+
+
+def booster_rollback_one_iter(bst: Booster) -> None:
+    bst.rollback_one_iter()
+
+
+def booster_num_classes(bst: Booster) -> int:
+    return int(bst.num_model_per_iteration())
+
+
+def booster_current_iteration(bst: Booster) -> int:
+    return int(bst.current_iteration())
+
+
+def booster_get_eval(bst: Booster, data_idx: int):
+    """data_idx 0 = training, 1.. = valid sets (reference
+    LGBM_BoosterGetEval)."""
+    results = bst._gbdt.eval()
+    if data_idx == 0:
+        key = "training"
+        if key not in results:
+            gb = bst._gbdt
+            results[key] = gb._eval_one(gb.train_score,
+                                        gb.train_data.metadata,
+                                        gb.train_metrics)
+    else:
+        names = bst._valid_names
+        key = names[data_idx - 1]
+    return [float(v) for (_, v, _) in results.get(key, [])]
+
+
+def booster_predict_for_mat(bst: Booster, mat, is_row_major: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str) -> bytes:
+    data = _matrix(mat, is_row_major)
+    kwargs = {}
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        kwargs["raw_score"] = True
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        kwargs["pred_leaf"] = True
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        kwargs["pred_contrib"] = True
+    out = bst.predict(data, num_iteration=num_iteration, **kwargs)
+    return np.ascontiguousarray(out, dtype=np.float64).tobytes()
+
+
+def booster_save_model(bst: Booster, start_iteration: int,
+                       num_iteration: int, filename: str) -> None:
+    bst.save_model(filename, num_iteration=num_iteration,
+                   start_iteration=start_iteration)
+
+
+def booster_save_model_to_string(bst: Booster, start_iteration: int,
+                                 num_iteration: int) -> str:
+    return bst.model_to_string(num_iteration=num_iteration,
+                               start_iteration=start_iteration)
+
+
+def booster_load_model_from_string(model_str: str):
+    bst = Booster(model_str=model_str)
+    return bst, bst.num_trees() // max(bst.num_model_per_iteration(), 1)

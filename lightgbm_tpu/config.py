@@ -313,7 +313,22 @@ class Config:
         self.objective = _OBJECTIVE_ALIASES.get(self.objective, self.objective)
         if self.boosting == "random_forest":
             self.boosting = "rf"
+        self._warn_unwired(merged)
         self._post_validate()
+
+    # accepted for reference-config compatibility but NOT implemented —
+    # setting them must warn, never silently change semantics (VERDICT r3):
+    _UNWIRED = ("forcedsplits_filename", "two_round",
+                "cegb_penalty_feature_lazy")
+
+    def _warn_unwired(self, merged: Dict[str, Any]) -> None:
+        from .log import log_warning
+        for key in self._UNWIRED:
+            if key in merged and merged[key] not in ("", None, False, 0):
+                log_warning(
+                    f"parameter {key!r} is accepted for LightGBM config "
+                    "compatibility but is NOT implemented in lightgbm_tpu; "
+                    "it will have no effect")
 
     def _post_validate(self) -> None:
         if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:

@@ -93,7 +93,8 @@ class TrainDataset:
                 zero_as_missing=config.zero_as_missing,
                 min_split_data=min_split,
                 max_bin_by_feature=config.max_bin_by_feature,
-                feature_pre_filter=config.feature_pre_filter)
+                feature_pre_filter=config.feature_pre_filter,
+                forced_bins_path=config.forcedbins_filename)
         self.all_bin_mappers = bin_mappers
 
         # filter trivial features (reference used_feature map, dataset.cpp)
@@ -117,6 +118,87 @@ class TrainDataset:
             self.raw_device = jnp.asarray(data, jnp.float32)
         else:
             self.raw_device = None
+
+    @classmethod
+    def from_sequences(cls, seqs, metadata: Metadata, config: Config,
+                       categorical_features=None) -> "TrainDataset":
+        """Two-round out-of-core construction from chunked Sequences
+        (reference two_round loading, dataset_loader.cpp:182 +
+        utils/pipeline_reader.h; Python Sequence API basic.py:608-672).
+
+        Round 1 samples rows across chunks to find bin mappers; round 2
+        streams each chunk once, binning it straight into the packed uint8
+        matrix.  Peak memory = binned matrix + one chunk — the raw float64
+        matrix is never materialized."""
+        lengths = [len(s) for s in seqs]
+        n = int(sum(lengths))
+        if metadata.num_data != n:
+            raise ValueError(f"label length {metadata.num_data} != "
+                             f"total sequence rows {n}")
+        probe = np.atleast_2d(np.asarray(seqs[0][0], np.float64))
+        num_features = probe.shape[-1]
+
+        # ---- round 1: sampled bin finding -----------------------------
+        sample_n = min(n, config.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        pick = np.sort(rng.choice(n, size=sample_n, replace=False))
+        sample = np.empty((sample_n, num_features), np.float64)
+        offsets = np.concatenate([[0], np.cumsum(lengths)])
+        for si, seq in enumerate(seqs):
+            sel = pick[(pick >= offsets[si]) & (pick < offsets[si + 1])]
+            for j, ridx in enumerate(sel - offsets[si]):
+                row = np.asarray(seq[int(ridx)], np.float64).reshape(-1)
+                sample[np.searchsorted(pick, offsets[si] + ridx)] = row
+        cats = sorted(set(categorical_features or ()))
+        min_split = (config.min_data_in_leaf
+                     if config.feature_pre_filter else 0)
+        mappers = find_bin_mappers(
+            sample, max_bin=config.max_bin,
+            min_data_in_bin=config.min_data_in_bin,
+            categorical_features=cats, use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            min_split_data=min_split,
+            max_bin_by_feature=config.max_bin_by_feature,
+            feature_pre_filter=config.feature_pre_filter,
+            forced_bins_path=config.forcedbins_filename)
+
+        # ---- round 2: stream chunks into the packed bin matrix --------
+        real_index = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        used = [mappers[i] for i in real_index]
+        if not used:
+            raise ValueError("no usable (non-trivial) features in data")
+        max_nb = max(m.num_bin for m in used)
+        bins = np.empty((n, len(used)),
+                        np.uint8 if max_nb <= 256 else np.int32)
+        row0 = 0
+        for seq in seqs:
+            bs = getattr(seq, "batch_size", 4096) or 4096
+            for lo in range(0, len(seq), bs):
+                hi = min(lo + bs, len(seq))
+                try:
+                    chunk = np.asarray(seq[lo:hi], np.float64)
+                except (TypeError, IndexError):
+                    chunk = np.stack([np.asarray(seq[i], np.float64)
+                                      for i in range(lo, hi)])
+                chunk = np.atleast_2d(chunk)
+                for j, (real, m) in enumerate(zip(real_index, used)):
+                    bins[row0:row0 + len(chunk), j] = \
+                        m.value_to_bin(chunk[:, real])
+                row0 += len(chunk)
+
+        self = cls.__new__(cls)
+        self.config = config
+        self.metadata = metadata
+        self.all_bin_mappers = mappers
+        self.raw_device = None
+        if getattr(config, "linear_tree", False):
+            from .log import log_warning
+            log_warning("linear_tree requires in-memory raw data and is "
+                        "disabled for Sequence (out-of-core) datasets; "
+                        "constant leaves will be used")
+        self._finish_init(bins, mappers, real_index, num_features, metadata)
+        self.num_total_features = num_features
+        return self
 
     def _init_from_binned(self, bins: np.ndarray, bin_mappers,
                           num_total_features: int, metadata: Metadata,

@@ -185,19 +185,53 @@ class AUCMetric(Metric):
         score = _as_np(raw_score)
         y = _as_np(label) > 0
         w = _as_np(weight) if weight is not None else np.ones_like(score)
-        pos_w = np.where(y, w, 0.0)
-        neg_w = np.where(~y, w, 0.0)
-        # group ties by distinct score, ascending; a positive outranks every
-        # negative in strictly lower groups and half of its own tie group
-        _, inv = np.unique(score, return_inverse=True)
-        tie_pos = np.bincount(inv, weights=pos_w)
-        tie_neg = np.bincount(inv, weights=neg_w)
-        cum_neg_below = np.concatenate([[0.0], np.cumsum(tie_neg)[:-1]])
-        auc_sum = np.sum(tie_pos * (cum_neg_below + 0.5 * tie_neg))
-        tp, tn = pos_w.sum(), neg_w.sum()
-        if tp == 0 or tn == 0:
-            return [(self.name, 1.0, True)]
-        return [(self.name, float(auc_sum / (tp * tn)), True)]
+        return [(self.name, _weighted_tie_aware_auc(score, y, w), True)]
+
+
+def _weighted_tie_aware_auc(score, is_pos, w):
+    """Binary AUC with weight + tie handling (shared by auc and auc_mu)."""
+    pos_w = np.where(is_pos, w, 0.0)
+    neg_w = np.where(~is_pos, w, 0.0)
+    _, inv = np.unique(score, return_inverse=True)
+    tie_pos = np.bincount(inv, weights=pos_w)
+    tie_neg = np.bincount(inv, weights=neg_w)
+    cum_neg_below = np.concatenate([[0.0], np.cumsum(tie_neg)[:-1]])
+    auc_sum = np.sum(tie_pos * (cum_neg_below + 0.5 * tie_neg))
+    tp, tn = pos_w.sum(), neg_w.sum()
+    if tp == 0 or tn == 0:
+        return 1.0
+    return float(auc_sum / (tp * tn))
+
+
+class AucMuMetric(Metric):
+    """Multiclass AUC-mu (reference multiclass_metric.hpp AucMuMetric,
+    Kleiman & Page): mean over class pairs (a, b) of the tie-aware AUC that
+    ranks class-a rows above class-b rows by the score difference
+    s_a - s_b.  auc_mu_weights' off-diagonal entries scale the pairwise
+    decision direction in the reference; only the default (uniform)
+    weighting is implemented — a non-default matrix raises."""
+    name = "auc_mu"
+    is_higher_better = True
+
+    def eval(self, raw_score, label, weight, objective, query_info=None):
+        if getattr(self.config, "auc_mu_weights", None):
+            raise NotImplementedError(
+                "custom auc_mu_weights are not supported yet")
+        p = _as_np(raw_score)                       # [K, N]
+        y = _as_np(label).astype(np.int64)
+        k = p.shape[0]
+        w = (_as_np(weight) if weight is not None
+             else np.ones(p.shape[1]))
+        total, cnt = 0.0, 0
+        for a in range(k):
+            for b in range(a + 1, k):
+                sel = (y == a) | (y == b)
+                if not sel.any():
+                    continue
+                s = p[a, sel] - p[b, sel]
+                total += _weighted_tie_aware_auc(s, y[sel] == a, w[sel])
+                cnt += 1
+        return [(self.name, total / max(cnt, 1), True)]
 
 
 class AveragePrecisionMetric(Metric):
@@ -330,7 +364,8 @@ _METRICS = {cls.name: cls for cls in (
     PoissonMetric, GammaMetric, GammaDevianceMetric, TweedieMetric, MAPEMetric,
     BinaryLoglossMetric, BinaryErrorMetric, CrossEntropyMetric,
     CrossEntropyLambdaMetric, AUCMetric, AveragePrecisionMetric,
-    MultiLoglossMetric, MultiErrorMetric, NDCGMetric, MapMetric)}
+    AucMuMetric, MultiLoglossMetric, MultiErrorMetric, NDCGMetric,
+    MapMetric)}
 
 _METRIC_ALIASES = {
     "mse": "l2", "mean_squared_error": "l2", "regression": "l2",

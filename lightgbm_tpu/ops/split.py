@@ -77,6 +77,11 @@ def find_best_split(
     max_delta_step,
     monotone: Optional[jnp.ndarray] = None,   # [F] int8 in {-1,0,1}
     output_lo: jnp.ndarray = None, output_hi: jnp.ndarray = None,
+    monotone_penalty_factor=None,             # scalar in (0,1], or None
+    path_smooth: float = 0.0,                 # reference path_smooth
+    gain_scale_f: Optional[jnp.ndarray] = None,    # [F] feature_contri
+    gain_penalty_f: Optional[jnp.ndarray] = None,  # [F] CEGB gain penalty
+    rand_bin_f: Optional[jnp.ndarray] = None,      # [F] extra_trees bin
     is_cat_f: Optional[jnp.ndarray] = None,   # [F] bool, None = no cats (static)
     cat_l2: float = 10.0, cat_smooth: float = 10.0,
     max_cat_threshold: int = 32, max_cat_to_onehot: int = 4,
@@ -161,11 +166,44 @@ def find_best_split(
 
     l_out = leaf_output(lg, lh, l1, l2_per_dir, max_delta_step)
     r_out = leaf_output(rg, rh, l1, l2_per_dir, max_delta_step)
-    gain = (leaf_gain(lg, lh, l1, l2_per_dir, max_delta_step) +
-            leaf_gain(rg, rh, l1, l2_per_dir, max_delta_step))
+    if path_smooth > 0.0:
+        # reference path smoothing (feature_histogram.hpp
+        # CalculateSplittedLeafOutput<..., USE_SMOOTHING>): child outputs
+        # are blended toward the parent's output by data count
+        parent_out = leaf_output(sum_g, sum_h, l1, l2, max_delta_step)
+        l_out = (lc / (lc + path_smooth)) * l_out + \
+                (path_smooth / (lc + path_smooth)) * parent_out
+        r_out = (rc / (rc + path_smooth)) * r_out + \
+                (path_smooth / (rc + path_smooth)) * parent_out
+    if output_lo is not None or output_hi is not None or path_smooth > 0.0:
+        # monotone leaf bounds (reference BasicLeafConstraints /
+        # IntermediateLeafConstraints): candidate outputs are CLAMPED into
+        # the leaf's [lo, hi] corridor and the gain recomputed for the
+        # clamped output (GetLeafGainGivenOutput, feature_histogram.hpp:767)
+        lo = -jnp.inf if output_lo is None else output_lo
+        hi = jnp.inf if output_hi is None else output_hi
+        l_out = jnp.clip(l_out, lo, hi)
+        r_out = jnp.clip(r_out, lo, hi)
+        # reference GetLeafGainGivenOutput applies ThresholdL1 to the
+        # gradient sums (feature_histogram.hpp:767)
+        lg_t = _threshold_l1(lg, l1)
+        rg_t = _threshold_l1(rg, l1)
+        gain = (-(2.0 * lg_t * l_out + (lh + l2_per_dir) * l_out * l_out)
+                - (2.0 * rg_t * r_out + (rh + l2_per_dir) * r_out * r_out))
+    else:
+        gain = (leaf_gain(lg, lh, l1, l2_per_dir, max_delta_step) +
+                leaf_gain(rg, rh, l1, l2_per_dir, max_delta_step))
 
     parent_gain = leaf_gain(sum_g, sum_h, l1, l2, max_delta_step)
     improvement = gain - parent_gain - min_gain_to_split
+    if gain_scale_f is not None:
+        # per-feature gain multiplier (reference feature_contri,
+        # config.h Learning Control)
+        improvement = improvement * gain_scale_f[None, :, None]
+    if gain_penalty_f is not None:
+        # CEGB gain haircut (reference CostEfficientGradientBoosting::
+        # DetlaGain, cost_effective_gradient_boosting.hpp:22)
+        improvement = improvement - gain_penalty_f[None, :, None]
 
     # validity masks (reference FindBestThresholdSequentially constraints)
     valid = (lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)
@@ -194,14 +232,26 @@ def find_best_split(
 
     valid &= feature_mask[None, :, None]
 
+    if rand_bin_f is not None:
+        # extra_trees: numerical candidates restricted to ONE random
+        # threshold per feature (reference ExtremelyRandomizedTrees path in
+        # FindBestThresholdSequentially); categorical scans are unrestricted
+        # (documented deviation)
+        dir_idx2 = jnp.arange(n_dirs).reshape(-1, 1, 1)
+        at_rand = bins[None, None, :] == rand_bin_f[None, :, None]
+        valid &= jnp.where(dir_idx2 < 2, at_rand, True)
+
     if monotone is not None:
         mono = monotone[None, :, None].astype(hist.dtype)
         valid &= ~((mono > 0) & (l_out > r_out))
         valid &= ~((mono < 0) & (l_out < r_out))
-    if output_lo is not None:
-        valid &= (l_out >= output_lo) & (r_out >= output_lo)
-    if output_hi is not None:
-        valid &= (l_out <= output_hi) & (r_out <= output_hi)
+        if monotone_penalty_factor is not None:
+            # gain haircut for monotone-feature splits near the root
+            # (reference ComputeMonotoneSplitGainPenalty,
+            # monotone_constraints.hpp)
+            improvement = jnp.where(
+                mono != 0, improvement * monotone_penalty_factor,
+                improvement)
 
     improvement = jnp.where(valid, improvement, _NEG_INF)
 

@@ -84,28 +84,38 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _build_sharded_grow(self):
         cfg = self.grower_cfg
         ax = self.AXIS
+        mp = self.multiprocess
 
         @functools.partial(jax.jit, static_argnames=())
         @functools.partial(
             shard_map,
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
-                      P(), P(), P(), P(), P(), P(), P()),  # feature meta + rng
+                      P(), P(), P(), P(), P(), P(), P(), P(), P(), P()),
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
-            )._replace(row_leaf=P(ax)),
+            )._replace(row_leaf=P() if mp else P(ax)),
             check_vma=False)
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
-                    bmap):
+                    bmap, igroups, gscale, gpen):
             from ..tree_learner import grow_tree_compact
             grow = (grow_tree_compact
                     if self.config.grow_strategy == "compact" else grow_tree)
-            return grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
-                        mono, key, icf, bmap)
+            state = grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
+                         mono, key, icf, bmap, igroups, gscale, gpen)
+            if mp:
+                # multi-host: replicate row_leaf so every process can read
+                # its full copy for the score update (one [N] allgather per
+                # tree, the reference's distributed score update cost)
+                state = state._replace(
+                    row_leaf=jax.lax.all_gather(state.row_leaf, ax,
+                                                tiled=True))
+            return state
 
         return sharded
 
-    def train(self, grad, hess, sample_mask, iteration: int):
+    def train(self, grad, hess, sample_mask, iteration: int,
+              gain_penalty=None):
         if self.pad:
             z = jnp.zeros((self.pad,), grad.dtype)
             grad = jnp.concatenate([grad, z])
@@ -125,7 +135,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
             jax.device_put(key, self._rep_sharding),
             jax.device_put(self.is_cat_f, self._rep_sharding),
             (None if self.bmap is None
-             else jax.device_put(self.bmap, self._rep_sharding)))
+             else jax.device_put(self.bmap, self._rep_sharding)),
+            (None if self.igroups is None
+             else jax.device_put(self.igroups, self._rep_sharding)),
+            (None if self.gain_scale is None
+             else jax.device_put(self.gain_scale, self._rep_sharding)),
+            (None if gain_penalty is None
+             else jax.device_put(gain_penalty, self._rep_sharding)))
+        if self.multiprocess:
+            # pull everything process-local so the booster can mix state
+            # with its (non-mesh) score arrays
+            state = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(jax.device_get(x)), state)
         if self.pad:
             state = state._replace(row_leaf=state.row_leaf[:self.dataset.num_data])
         return state

@@ -41,6 +41,20 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         if config.grow_strategy != "compact":
             raise ValueError("tree_learner=feature requires "
                              "grow_strategy=compact")
+        if config.interaction_constraints:
+            raise ValueError("interaction_constraints are not supported "
+                             "with tree_learner=feature (feature-sharded "
+                             "scan); use data or voting parallel")
+        if config.monotone_constraints and any(config.monotone_constraints):
+            raise ValueError("monotone_constraints are not supported with "
+                             "tree_learner=feature (bound bookkeeping "
+                             "needs the global constraint vector); use "
+                             "data or voting parallel")
+        if config.feature_contri or config.cegb_penalty_feature_coupled \
+                or config.cegb_penalty_split > 0:
+            raise ValueError("feature_contri / CEGB are not supported with "
+                             "tree_learner=feature; use data or voting "
+                             "parallel")
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
         # feature-parallel scans per-feature histograms directly; EFB's
@@ -102,7 +116,11 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
         return sharded
 
-    def train(self, grad, hess, sample_mask, iteration: int):
+    def train(self, grad, hess, sample_mask, iteration: int,
+              gain_penalty=None):
+        if gain_penalty is not None:
+            raise ValueError("CEGB is not supported with "
+                             "tree_learner=feature")
         key = self.iter_key(iteration)
         return self._sharded_grow(
             self.sharded_bins,

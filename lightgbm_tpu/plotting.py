@@ -16,12 +16,35 @@ from .basic import Booster
 from .log import LightGBMError
 
 __all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
-           "plot_tree", "create_tree_digraph"]
+           "plot_tree", "create_tree_digraph", "split_value_counts"]
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+def _axes_from(ax, figsize, dpi):
+    """Return a matplotlib Axes, creating a fresh figure when none given."""
+    import matplotlib.pyplot as plt
+    if ax is not None:
+        return ax
+    if figsize is not None and (not hasattr(figsize, "__len__")
+                                or len(figsize) != 2):
+        raise TypeError("figsize must be a (width, height) pair")
+    fig = plt.figure(figsize=figsize, dpi=dpi)
+    return fig.add_subplot(111)
+
+
+def _decorate(ax, title, xlabel, ylabel, xlim, ylim, grid):
+    for lim, setter in ((xlim, ax.set_xlim), (ylim, ax.set_ylim)):
+        if lim is not None:
+            if not hasattr(lim, "__len__") or len(lim) != 2:
+                raise TypeError("axis limits must be (lo, hi) pairs")
+            setter(lim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
 
 
 def _to_booster(booster) -> Booster:
@@ -41,100 +64,84 @@ def plot_importance(booster, ax=None, height: float = 0.2,
                     max_num_features: Optional[int] = None,
                     ignore_zero: bool = True, figsize=None, dpi=None,
                     grid: bool = True, precision: int = 3, **kwargs):
-    """Bar chart of feature importances (reference plotting.py plot_importance)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib to plot importance.")
-    bst = _to_booster(booster)
-    if importance_type == "auto":
-        importance_type = "split"
-    importance = bst.feature_importance(importance_type=importance_type)
-    feature_name = bst.feature_name()
-    if not len(importance):
-        raise ValueError("Booster's feature_importance is empty.")
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
-    if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
-    if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples) if tuples else ((), ())
+    """Horizontal bar chart of per-feature importances.
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                f"{x:.{precision}f}" if importance_type == "gain" else str(int(x)),
-                va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
-    if title:
-        ax.set_title(title)
-    if xlabel:
-        ax.set_xlabel(xlabel)
-    if ylabel:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    API-compatible with the reference's plot_importance; the rendering is
+    our own: importances are rank-selected with numpy, drawn most-important
+    at the top, and annotated at the bar tips.
+    """
+    bst = _to_booster(booster)
+    kind = "split" if importance_type == "auto" else importance_type
+    imp = np.asarray(bst.feature_importance(importance_type=kind),
+                     dtype=np.float64)
+    if imp.size == 0:
+        raise ValueError("the model has no feature importances to plot")
+    names = np.asarray(bst.feature_name())
+
+    keep = imp > 0 if ignore_zero else np.ones_like(imp, bool)
+    imp, names = imp[keep], names[keep]
+    order = np.argsort(-imp, kind="stable")       # most important first
+    if max_num_features is not None and max_num_features > 0:
+        order = order[:max_num_features]
+    imp, names = imp[order], names[order]
+
+    ax = _axes_from(ax, figsize, dpi)
+    # row 0 at the top: invert by plotting against descending positions
+    pos = np.arange(len(imp))[::-1]
+    bars = ax.barh(pos, imp, height=height, align="center", **kwargs)
+    span = imp.max() if len(imp) else 1.0
+    for bar, v in zip(bars, imp):
+        text = f"{v:.{precision}f}" if kind == "gain" else f"{int(v)}"
+        ax.annotate(text,
+                    xy=(bar.get_width() + 0.01 * span,
+                        bar.get_y() + bar.get_height() / 2),
+                    va="center", ha="left")
+    ax.set_yticks(pos)
+    ax.set_yticklabels(names)
+    return _decorate(ax, title, xlabel, ylabel, xlim, ylim, grid)
+
+
+def split_value_counts(booster, feature) -> np.ndarray:
+    """All numerical thresholds the model uses for one feature, across every
+    tree (the raw data behind plot_split_value_histogram)."""
+    bst = _to_booster(booster)
+    names = bst.feature_name()
+    fidx = names.index(feature) if isinstance(feature, str) else int(feature)
+    models = bst._gbdt.models if bst._gbdt else bst._loaded_trees
+    vals = []
+    for t in models:
+        for node in range(t.num_leaves - 1):
+            is_cat = bool(t.decision_type[node] & 1)
+            if t.split_feature[node] == fidx and not is_cat:
+                vals.append(float(t.threshold[node]))
+    return np.asarray(vals)
 
 
 def plot_split_value_histogram(booster, feature, bins=None, ax=None,
                                width_coef: float = 0.8, xlim=None, ylim=None,
-                               title="Split value histogram for feature with @index/name@ @feature@",
+                               title: Optional[str] = None,
                                xlabel="Feature split value", ylabel="Count",
                                figsize=None, dpi=None, grid: bool = True,
                                **kwargs):
-    """Histogram of a feature's split thresholds across the model
-    (reference plotting.py plot_split_value_histogram)."""
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError(
-            "You must install matplotlib to plot split value histogram.")
-    bst = _to_booster(booster)
-    feature_names = bst.feature_name()
-    if isinstance(feature, str):
-        fidx = feature_names.index(feature)
-    else:
-        fidx = int(feature)
-    models = bst._gbdt.models if bst._gbdt else bst._loaded_trees
-    values = []
-    for t in models:
-        ni = t.num_leaves - 1
-        for node in range(ni):
-            if t.split_feature[node] == fidx and \
-                    not (t.decision_type[node] & 1):
-                values.append(t.threshold[node])
-    if not values:
-        raise ValueError(
-            "Cannot plot split value histogram, "
-            f"because feature {feature} was not used in splitting")
-    hist, bin_edges = np.histogram(values, bins=bins or "auto")
-    centres = (bin_edges[:-1] + bin_edges[1:]) / 2
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ax.bar(centres, hist, align="center",
-           width=width_coef * (bin_edges[1] - bin_edges[0]), **kwargs)
-    if title:
-        title = title.replace("@feature@", str(feature)).replace(
-            "@index/name@", "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    if xlabel:
-        ax.set_xlabel(xlabel)
-    if ylabel:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    """Histogram of where the model splits one feature.
+
+    API-compatible with the reference's plot_split_value_histogram; built on
+    the separately-usable split_value_counts helper.
+    """
+    vals = split_value_counts(booster, feature)
+    if vals.size == 0:
+        raise ValueError(f"feature {feature!r} is never used for a "
+                         "numerical split in this model")
+    counts, edges = np.histogram(vals, bins=bins if bins is not None
+                                 else "auto")
+    ax = _axes_from(ax, figsize, dpi)
+    widths = np.diff(edges) * width_coef
+    ax.bar(edges[:-1] + np.diff(edges) / 2, counts, width=widths, **kwargs)
+    if title is None:
+        ref = (f"feature {feature!r}" if isinstance(feature, str)
+               else f"feature #{int(feature)}")
+        title = f"Split values used for {ref}"
+    return _decorate(ax, title, xlabel, ylabel, xlim, ylim, grid)
 
 
 def plot_metric(booster, metric: Optional[str] = None,

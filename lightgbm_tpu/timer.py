@@ -1,0 +1,96 @@
+"""Labeled phase timers + optional device profiler traces.
+
+TPU-native equivalent of the reference's compile-time-gated label timer
+(Common::Timer / FunctionTimer, utils/common.h:953-1017; singleton
+global_timer printed at exit, src/boosting/gbdt.cpp:20).  Differences by
+design: enabled at runtime via ``LIGHTGBM_TPU_TIMETAG=1`` (the reference
+needs a -DTIMETAG rebuild), and ``device_trace`` wraps ``jax.profiler`` so a
+phase can capture an XLA/TPU trace for xprof (the reference has no device
+tracing story at all).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+__all__ = ["global_timer", "timed", "device_trace", "timers_enabled"]
+
+_ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+
+def timers_enabled() -> bool:
+    return _ENABLED
+
+
+class PhaseTimer:
+    """name -> accumulated seconds, printed at exit (reference
+    Common::Timer::Print semantics)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acc: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.acc[name] = self.acc.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU phase timers:"]
+        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
+            lines.append(f"  {name}: {self.acc[name]:.3f}s "
+                         f"({self.counts[name]} calls)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.acc.clear()
+            self.counts.clear()
+
+
+global_timer = PhaseTimer()
+
+
+@contextmanager
+def timed(name: str, sync=None):
+    """Accumulate wall-clock under `name` when timers are enabled.
+
+    sync: optional array/pytree to block_until_ready before stopping the
+    clock, so async-dispatched device work is attributed to the phase that
+    launched it instead of whoever syncs next."""
+    if not _ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if sync is not None:
+            import jax
+            jax.block_until_ready(sync)
+        global_timer.add(name, time.perf_counter() - t0)
+
+
+@contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler trace around the block (works on TPU and the
+    CPU test mesh; view with xprof/tensorboard)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@atexit.register
+def _print_at_exit():
+    if _ENABLED and global_timer.acc:
+        from .log import log_info
+        log_info(global_timer.report())

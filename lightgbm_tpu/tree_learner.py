@@ -73,6 +73,22 @@ class GrowerConfig(NamedTuple):
     # EFB: device bins are bundle columns; histograms are expanded to
     # original-feature space before each scan (efb.py)
     use_efb: bool = False
+    # monotone constraints (reference monotone_constraints.hpp): "basic"
+    # propagates mid-point leaf bounds (BasicLeafConstraints :463),
+    # "intermediate" the looser sibling-output bounds (:514, without the
+    # stale-leaf recompute - documented deviation)
+    use_monotone: bool = False
+    monotone_method: str = "basic"
+    monotone_penalty: float = 0.0
+    # interaction constraints (reference col_sampler.hpp GetByNode)
+    use_interaction: bool = False
+    # path smoothing / extremely-randomized splits / per-feature gain
+    # adjustments (reference path_smooth, extra_trees, feature_contri +
+    # CEGB in cost_effective_gradient_boosting.hpp)
+    path_smooth: float = 0.0
+    extra_trees: bool = False
+    use_gain_scale: bool = False
+    use_gain_penalty: bool = False
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
@@ -100,6 +116,9 @@ class TreeState(NamedTuple):
     leaf_sum: jnp.ndarray        # [L, 3]
     leaf_depth: jnp.ndarray      # [L] int32
     leaf_parent: jnp.ndarray     # [L] int32 (internal node id, -1 for root)
+    leaf_lo: jnp.ndarray         # [L] monotone output lower bounds
+    leaf_hi: jnp.ndarray         # [L] monotone output upper bounds
+    leaf_used: jnp.ndarray       # [L, F] bool: features used on the path
     # tree arrays (mirror tree.py / reference tree.h flat layout)
     split_feature: jnp.ndarray   # [L-1] int32
     threshold_bin: jnp.ndarray   # [L-1] int32
@@ -122,18 +141,44 @@ def _child_weights(grad_m, hess_m, mask, left_m, right_m):
     ], axis=1)
 
 
+def _monotone_penalty_factor(cfg: GrowerConfig, depth):
+    """reference ComputeMonotoneSplitGainPenalty
+    (monotone_constraints.hpp:1174 area)."""
+    pen = cfg.monotone_penalty
+    if pen <= 0.0:
+        return None
+    d = depth.astype(jnp.float32)
+    if pen <= 1.0:
+        factor = 1.0 - pen / (2.0 ** d) + K_EPSILON
+    else:
+        factor = 1.0 - 2.0 ** (pen - 1.0 - d) + K_EPSILON
+    return jnp.where(pen >= d + 1.0, K_EPSILON, factor)
+
+
 def _scan_leaf(hist, sums, depth, cfg: GrowerConfig, num_bins_f, has_missing_f,
                feature_mask, monotone, is_cat_f=None,
-               bmap: Optional[BundleMap] = None) -> SplitResult:
+               bmap: Optional[BundleMap] = None,
+               bounds=None, gain_scale_f=None, gain_penalty_f=None,
+               rand_bin_f=None) -> SplitResult:
     if cfg.use_efb:
         # bundle-space histogram -> per-member-feature histograms; the
         # leaf's own (g,h,c) totals reconstruct each member's zero bin
         hist = expand_bundle_hist(hist, sums, bmap, num_bins_f, cfg.num_bins)
+    lo = hi = pen = None
+    if cfg.use_monotone:
+        if bounds is not None:
+            lo, hi = bounds
+        pen = _monotone_penalty_factor(cfg, depth)
     res = find_best_split(
         hist, sums[0], sums[1], sums[2], num_bins_f, has_missing_f,
         feature_mask, cfg.lambda_l1, cfg.lambda_l2, cfg.min_data_in_leaf,
         cfg.min_sum_hessian_in_leaf, cfg.min_gain_to_split,
         cfg.max_delta_step, monotone,
+        output_lo=lo, output_hi=hi, monotone_penalty_factor=pen,
+        path_smooth=cfg.path_smooth,
+        gain_scale_f=gain_scale_f if cfg.use_gain_scale else None,
+        gain_penalty_f=gain_penalty_f if cfg.use_gain_penalty else None,
+        rand_bin_f=rand_bin_f if cfg.extra_trees else None,
         is_cat_f=is_cat_f if cfg.use_categorical else None,
         cat_l2=cfg.cat_l2, cat_smooth=cfg.cat_smooth,
         max_cat_threshold=cfg.max_cat_threshold,
@@ -162,7 +207,7 @@ def _per_feature_gains(hist, sums, cfg: GrowerConfig, num_bins_f,
 
 
 def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
-                     root_sums) -> TreeState:
+                     root_sums, num_features: int) -> TreeState:
     """Fresh single-leaf TreeState (shared by both growers)."""
     L, B = cfg.num_leaves, cfg.num_bins
     return TreeState(
@@ -182,6 +227,10 @@ def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
         leaf_sum=jnp.zeros((L, 3), fdt).at[0].set(root_sums),
         leaf_depth=jnp.zeros((L,), jnp.int32),
         leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_lo=jnp.full((L,), -jnp.inf, fdt),
+        leaf_hi=jnp.full((L,), jnp.inf, fdt),
+        leaf_used=jnp.zeros((L, num_features if cfg.use_interaction else 1),
+                            bool),
         split_feature=jnp.zeros((L - 1,), jnp.int32),
         threshold_bin=jnp.zeros((L - 1,), jnp.int32),
         default_left=jnp.zeros((L - 1,), bool),
@@ -197,7 +246,9 @@ def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
 
 
 def _apply_split_bookkeeping(state: TreeState, best_leaf, gain, feat, thr,
-                             dleft, split_cat, cat_mask) -> TreeState:
+                             dleft, split_cat, cat_mask,
+                             cfg: GrowerConfig = None,
+                             monotone=None) -> TreeState:
     """Record split `node` in the flat tree arrays and update per-leaf stats
     (reference Tree::Split, tree.h:62; shared by both growers).  Does NOT
     touch row_leaf / partition structures — those are grower-specific."""
@@ -216,8 +267,43 @@ def _apply_split_bookkeeping(state: TreeState, best_leaf, gain, feat, thr,
 
     psum_w = state.leaf_sum[best_leaf]
     depth = state.leaf_depth[best_leaf] + 1
+    new_leaf_idx = state.n_leaves
+
+    # monotone bound propagation (reference SetChildrenConstraints):
+    # basic uses the mid-point, intermediate the sibling outputs
+    leaf_lo, leaf_hi = state.leaf_lo, state.leaf_hi
+    if cfg is not None and cfg.use_monotone:
+        l_out = state.best_left_out[best_leaf]
+        r_out = state.best_right_out[best_leaf]
+        mono = monotone[feat].astype(l_out.dtype)
+        lo, hi = leaf_lo[best_leaf], leaf_hi[best_leaf]
+        if cfg.monotone_method == "intermediate":
+            up_for_low, down_for_high = r_out, l_out
+        else:
+            mid = (l_out + r_out) * 0.5
+            up_for_low, down_for_high = mid, mid
+        # mono > 0: left (low side) capped above, right floored below
+        l_hi = jnp.where(mono > 0, jnp.minimum(hi, up_for_low), hi)
+        r_lo = jnp.where(mono > 0, jnp.maximum(lo, down_for_high), lo)
+        # mono < 0: mirrored
+        l_lo = jnp.where(mono < 0, jnp.maximum(lo, down_for_high), lo)
+        r_hi = jnp.where(mono < 0, jnp.minimum(hi, up_for_low), hi)
+        leaf_lo = leaf_lo.at[best_leaf].set(l_lo).at[new_leaf_idx].set(r_lo)
+        leaf_hi = leaf_hi.at[best_leaf].set(l_hi).at[new_leaf_idx].set(r_hi)
+    else:
+        leaf_lo = leaf_lo.at[new_leaf_idx].set(leaf_lo[best_leaf])
+        leaf_hi = leaf_hi.at[new_leaf_idx].set(leaf_hi[best_leaf])
+
+    leaf_used = state.leaf_used
+    if cfg is not None and cfg.use_interaction:
+        used = leaf_used[best_leaf].at[feat].set(True)
+        leaf_used = leaf_used.at[best_leaf].set(used) \
+                             .at[new_leaf_idx].set(used)
 
     return state._replace(
+        leaf_lo=leaf_lo,
+        leaf_hi=leaf_hi,
+        leaf_used=leaf_used,
         n_leaves=state.n_leaves + 1,
         left_child=left_child,
         right_child=right_child,
@@ -275,6 +361,9 @@ def grow_tree(cfg: GrowerConfig,
               rng_key: jnp.ndarray,       # for per-node feature sampling
               is_cat_f: Optional[jnp.ndarray] = None,  # [F] bool
               bmap: Optional[BundleMap] = None,  # EFB decode (use_efb only)
+              igroups: Optional[jnp.ndarray] = None,  # [G, F] interaction sets
+              gain_scale_f: Optional[jnp.ndarray] = None,   # feature_contri
+              gain_penalty_f: Optional[jnp.ndarray] = None,  # CEGB
               ) -> TreeState:
     """Grow one tree; returns the final TreeState (all device arrays)."""
     n = bins.shape[0]
@@ -304,6 +393,23 @@ def grow_tree(cfg: GrowerConfig,
         any_on = m.any()
         return jnp.where(any_on, m, feature_mask)
 
+    def interaction_mask(used, fmask):
+        if not cfg.use_interaction:
+            return fmask
+        # a feature is allowed iff some constraint group contains it AND
+        # every feature already used on the path (reference
+        # ColSampler::GetByNode, col_sampler.hpp)
+        ok = ~jnp.any(used[None, :] & ~igroups, axis=1)        # [G]
+        allowed = jnp.any(igroups & ok[:, None], axis=0)       # [F]
+        return fmask & allowed
+
+    def extra_bins(step):
+        if not cfg.extra_trees:
+            return None
+        k = jax.random.fold_in(rng_key, 1_000_003 + step)
+        u = jax.random.uniform(k, (f,))
+        return (u * (num_bins_f - 1).astype(u.dtype)).astype(jnp.int32)
+
     # ---- root ----------------------------------------------------------
     root_hist = hist_of(jnp.stack([grad_m, hess_m, sample_mask], axis=1))
     root_sums = root_hist[0].sum(axis=0)  # feature 0's bins cover every row once
@@ -311,12 +417,16 @@ def grow_tree(cfg: GrowerConfig,
                            cfg.lambda_l2, cfg.max_delta_step)
     if is_cat_f is None:
         is_cat_f = jnp.zeros((f,), bool)
-    root_res = _scan_leaf(root_hist, root_sums, jnp.int32(0), cfg, num_bins_f,
-                          has_missing_f, node_feature_mask(0), monotone,
-                          is_cat_f, bmap)
-
     fdt = grad.dtype
-    state = _init_tree_state(cfg, n, fdt, root_out, root_sums)
+    state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f)
+    root_res = _scan_leaf(root_hist, root_sums, jnp.int32(0), cfg, num_bins_f,
+                          has_missing_f,
+                          interaction_mask(state.leaf_used[0],
+                                           node_feature_mask(0)),
+                          monotone, is_cat_f, bmap,
+                          gain_scale_f=gain_scale_f,
+                          gain_penalty_f=gain_penalty_f,
+                          rand_bin_f=extra_bins(0))
     state = _store_best(state, 0, root_res)
 
     def body(step, state: TreeState) -> TreeState:
@@ -353,7 +463,7 @@ def grow_tree(cfg: GrowerConfig,
             depth = state.leaf_depth[best_leaf] + 1
             new_state = _apply_split_bookkeeping(
                 state, best_leaf, gain, feat, thr, dleft, split_cat,
-                cat_mask)._replace(row_leaf=row_leaf)
+                cat_mask, cfg, monotone)._replace(row_leaf=row_leaf)
 
             # -- both children's histograms in ONE pass (subsumes the
             #    subtraction trick, see module docstring)
@@ -364,13 +474,23 @@ def grow_tree(cfg: GrowerConfig,
             hist_l = h6[..., 0:3]
             hist_r = h6[..., 3:6]
 
-            fmask = node_feature_mask(step + 1)
+            fmask = interaction_mask(new_state.leaf_used[best_leaf],
+                                     node_feature_mask(step + 1))
+            rb = extra_bins(step + 1)
             res_l = _scan_leaf(hist_l, new_state.leaf_sum[best_leaf], depth,
                                cfg, num_bins_f, has_missing_f, fmask, monotone,
-                               is_cat_f, bmap)
+                               is_cat_f, bmap,
+                               bounds=(new_state.leaf_lo[best_leaf],
+                                       new_state.leaf_hi[best_leaf]),
+                               gain_scale_f=gain_scale_f,
+                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb)
             res_r = _scan_leaf(hist_r, new_state.leaf_sum[new_leaf], depth,
                                cfg, num_bins_f, has_missing_f, fmask, monotone,
-                               is_cat_f, bmap)
+                               is_cat_f, bmap,
+                               bounds=(new_state.leaf_lo[new_leaf],
+                                       new_state.leaf_hi[new_leaf]),
+                               gain_scale_f=gain_scale_f,
+                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
             return new_state
@@ -455,6 +575,9 @@ def grow_tree_compact(cfg: GrowerConfig,
                       rng_key: jnp.ndarray,
                       is_cat_f: Optional[jnp.ndarray] = None,
                       bmap: Optional[BundleMap] = None,
+                      igroups: Optional[jnp.ndarray] = None,
+                      gain_scale_f: Optional[jnp.ndarray] = None,
+                      gain_penalty_f: Optional[jnp.ndarray] = None,
                       ) -> TreeState:
     """Grow one tree with the partition-order strategy; same TreeState out."""
     n, g = bins.shape            # g = storage columns (bundles under EFB)
@@ -491,22 +614,40 @@ def grow_tree_compact(cfg: GrowerConfig,
         m = feature_mask & (r < cfg.feature_fraction_bynode)
         return jnp.where(m.any(), m, feature_mask)
 
-    def scan_plain(hist, sums, depth, fmask):
-        return _scan_leaf(hist, sums, depth, cfg, num_bins_f, has_missing_f,
-                          fmask, monotone, is_cat_f, bmap)
+    def interaction_mask(used, fmask):
+        if not cfg.use_interaction:
+            return fmask
+        # reference ColSampler::GetByNode (col_sampler.hpp)
+        ok = ~jnp.any(used[None, :] & ~igroups, axis=1)        # [G]
+        allowed = jnp.any(igroups & ok[:, None], axis=0)       # [F]
+        return fmask & allowed
 
-    def scan_feature_parallel(hist_local, sums, depth, fmask):
+    def extra_bins(step):
+        if not cfg.extra_trees:
+            return None
+        k = jax.random.fold_in(rng_key, 1_000_003 + step)
+        u = jax.random.uniform(k, (f,))
+        return (u * (num_bins_f - 1).astype(u.dtype)).astype(jnp.int32)
+
+    def scan_plain(hist, sums, depth, fmask, bounds=None, rand_bin=None):
+        return _scan_leaf(hist, sums, depth, cfg, num_bins_f, has_missing_f,
+                          fmask, monotone, is_cat_f, bmap, bounds,
+                          gain_scale_f, gain_penalty_f, rand_bin)
+
+    def scan_feature_parallel(hist_local, sums, depth, fmask, bounds=None,
+                              rand_bin=None):
         # reference FeatureParallelTreeLearner: each shard scans its own
         # feature slice, then a gain-argmax allreduce of SplitInfo
         # (SyncUpGlobalBestSplit, parallel_tree_learner.h:191)
-        res = scan_plain(hist_local, sums, depth, fmask)
+        res = scan_plain(hist_local, sums, depth, fmask, bounds, rand_bin)
         res = res._replace(
             feature=res.feature + jax.lax.axis_index(ax) * jnp.int32(f))
         allr = jax.lax.all_gather(res, ax)
         best = jnp.argmax(allr.gain)
         return jax.tree_util.tree_map(lambda x: x[best], allr)
 
-    def scan_voting(hist_local, sums_global, depth, fmask):
+    def scan_voting(hist_local, sums_global, depth, fmask, bounds=None,
+                    rand_bin=None):
         # PV-Tree (reference VotingParallelTreeLearner): local proposals ->
         # allgather -> global vote -> reduce ONLY the elected features'
         # histograms -> global scan (voting_parallel_tree_learner.cpp:151-344)
@@ -536,7 +677,12 @@ def grow_tree_compact(cfg: GrowerConfig,
                          inner_cfg._replace(use_efb=False),
                          num_bins_f[elected], has_missing_f[elected],
                          fmask[elected], monotone[elected],
-                         is_cat_f[elected], None)
+                         is_cat_f[elected], None, bounds,
+                         None if gain_scale_f is None
+                         else gain_scale_f[elected],
+                         None if gain_penalty_f is None
+                         else gain_penalty_f[elected],
+                         None if rand_bin is None else rand_bin[elected])
         return res._replace(feature=elected[res.feature])
 
     scan_dispatch = {"none": scan_plain, "data": scan_plain,
@@ -552,10 +698,11 @@ def grow_tree_compact(cfg: GrowerConfig,
         root_sums = jax.lax.psum(root_sums, ax)
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
+    state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f)
     root_res = scan_dispatch(root_hist, root_sums, jnp.int32(0),
-                             node_feature_mask(0))
-
-    state = _init_tree_state(cfg, n, fdt, root_out, root_sums)
+                             interaction_mask(state.leaf_used[0],
+                                              node_feature_mask(0)),
+                             None, extra_bins(0))
     state = _store_best(state, 0, root_res)
 
     # histogram pool (reference HistogramPool, feature_histogram.hpp:1095;
@@ -662,13 +809,20 @@ def grow_tree_compact(cfg: GrowerConfig,
 
             depth = state.leaf_depth[best_leaf] + 1
             new_state = _apply_split_bookkeeping(
-                state, best_leaf, gain, feat, thr, dleft, split_cat, cat_mask)
+                state, best_leaf, gain, feat, thr, dleft, split_cat,
+                cat_mask, cfg, monotone)
 
-            fmask = node_feature_mask(step + 1)
+            fmask = interaction_mask(new_state.leaf_used[best_leaf],
+                                     node_feature_mask(step + 1))
+            rb = extra_bins(step + 1)
             res_l = scan_dispatch(hist_l, new_state.leaf_sum[best_leaf],
-                                  depth, fmask)
+                                  depth, fmask,
+                                  (new_state.leaf_lo[best_leaf],
+                                   new_state.leaf_hi[best_leaf]), rb)
             res_r = scan_dispatch(hist_r, new_state.leaf_sum[new_leaf],
-                                  depth, fmask)
+                                  depth, fmask,
+                                  (new_state.leaf_lo[new_leaf],
+                                   new_state.leaf_hi[new_leaf]), rb)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
             return (new_state, order, leaf_start, leaf_count, pool)
@@ -813,6 +967,62 @@ class SerialTreeLearner:
                 if real < len(mc):
                     mono[inner] = int(mc[real])
         self.monotone = jnp.asarray(mono)
+        self.grower_cfg = self.grower_cfg._replace(
+            use_monotone=bool(np.any(mono != 0)),
+            monotone_method=str(config.monotone_constraints_method),
+            monotone_penalty=float(config.monotone_penalty))
+        self.igroups = self._build_interaction_groups(config, dataset)
+        if self.igroups is not None:
+            self.grower_cfg = self.grower_cfg._replace(use_interaction=True)
+        self.grower_cfg = self.grower_cfg._replace(
+            path_smooth=float(config.path_smooth),
+            extra_trees=bool(config.extra_trees))
+        self.gain_scale = None
+        if config.feature_contri:
+            fc = np.ones(dataset.num_features, np.float32)
+            contri = list(config.feature_contri)
+            for inner, real in enumerate(dataset.real_feature_index):
+                if real < len(contri):
+                    fc[inner] = float(contri[real])
+            self.gain_scale = jnp.asarray(fc)
+            self.grower_cfg = self.grower_cfg._replace(use_gain_scale=True)
+        # CEGB (reference cost_effective_gradient_boosting.hpp): the
+        # per-iteration penalty vector comes from the booster (it tracks
+        # globally-used features for the coupled penalty)
+        self.use_cegb = (config.cegb_penalty_split > 0
+                         or config.cegb_penalty_feature_coupled is not None)
+        if self.use_cegb:
+            self.grower_cfg = self.grower_cfg._replace(use_gain_penalty=True)
+
+    @staticmethod
+    def _build_interaction_groups(config, dataset):
+        """Parse interaction_constraints (reference format:
+        "[0,1,2],[2,3]" over ORIGINAL column indices) into a [G, F] bool
+        matrix over inner features."""
+        raw = config.interaction_constraints
+        if not raw:
+            return None
+        inv = {real: inner for inner, real in
+               enumerate(dataset.real_feature_index)}
+        if isinstance(raw, (list, tuple)):
+            # python-API form: [[0,1],[2,3]]
+            grp_lists = [[int(x) for x in grp] for grp in raw]
+        else:
+            # config-file form: "[0,1,2],[2,3]"
+            import re as _re
+            grp_lists = [[int(x) for x in grp.replace(" ", "").split(",")
+                          if x]
+                         for grp in _re.findall(r"\[([^\]]*)\]", str(raw))]
+        groups = []
+        for idxs in grp_lists:
+            row = np.zeros(dataset.num_features, bool)
+            for real in idxs:
+                if real in inv:
+                    row[inv[real]] = True
+            groups.append(row)
+        if not groups:
+            return None
+        return jnp.asarray(np.stack(groups))
 
     @staticmethod
     def _effective_leaves(config):
@@ -850,9 +1060,11 @@ class SerialTreeLearner:
         return grow(self.grower_cfg, ds.device_bins, grad, hess,
                     sample_mask, ds.num_bins_per_feature,
                     ds.has_missing_per_feature, feature_mask,
-                    self.monotone, key, self.is_cat_f, self.bmap)
+                    self.monotone, key, self.is_cat_f, self.bmap,
+                    self.igroups, self.gain_scale, None)
 
-    def train(self, grad, hess, sample_mask, iteration: int):
+    def train(self, grad, hess, sample_mask, iteration: int,
+              gain_penalty=None):
         ds = self.dataset
         key = self.iter_key(iteration)
         grow = (grow_tree_compact_jit
@@ -860,5 +1072,6 @@ class SerialTreeLearner:
         state = grow(self.grower_cfg, ds.device_bins, grad, hess,
                      sample_mask, ds.num_bins_per_feature,
                      ds.has_missing_per_feature, self.feature_mask(),
-                     self.monotone, key, self.is_cat_f, self.bmap)
+                     self.monotone, key, self.is_cat_f, self.bmap,
+                     self.igroups, self.gain_scale, gain_penalty)
         return state

@@ -111,3 +111,7 @@ def rank_data():
     half = n_q // 2
     tr = sizes[:half].sum()
     return (X[:tr], y[:tr], sizes[:half], X[tr:], y[tr:], sizes[half:])
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running multi-process test")

@@ -1,0 +1,103 @@
+"""Drive the C ABI end-to-end via ctypes (reference tests/c_api_test/
+test_.py:189-204 test_dataset/test_booster).
+
+The shared library embeds CPython; loaded from inside a Python process it
+attaches to the running interpreter, which is exactly how the reference's
+python package drives lib_lightgbm.so in-process.
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+SO = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                  "c_api", "lib_lightgbm_tpu.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(SO):
+        subprocess.run(["make", "-C", os.path.dirname(SO)], check=True)
+    lib = ctypes.CDLL(SO)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBM_GetLastError().decode()
+
+
+def test_c_api_train_predict_roundtrip(lib, tmp_path):
+    rng = np.random.RandomState(0)
+    n, f = 2000, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    ds = ctypes.c_void_p()
+    Xc = np.ascontiguousarray(X, dtype=np.float64)
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),  # float64
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        b"max_bin=63", None, ctypes.byref(ds)))
+
+    yc = np.ascontiguousarray(y, dtype=np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))  # float32
+
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(10):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 10
+
+    out = np.zeros(n, dtype=np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_len), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, out) > 0.9
+
+    model_path = str(tmp_path / "c_model.txt").encode()
+    _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, -1, 0, model_path))
+
+    bst2 = ctypes.c_void_p()
+    n_iter = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model_path, ctypes.byref(n_iter), ctypes.byref(bst2)))
+    assert n_iter.value == 10
+    out2 = np.zeros(n, dtype=np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_len), out2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(out, out2, atol=1e-6)
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_BoosterFree(bst2))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_error_reporting(lib):
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromFile(b"/nonexistent/file.csv", b"", None,
+                                        ctypes.byref(ds))
+    assert rc == -1
+    assert b"" != lib.LGBM_GetLastError()

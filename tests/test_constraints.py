@@ -1,0 +1,103 @@
+"""Monotone / interaction / forced-bin constraints (reference
+monotone_constraints.hpp, col_sampler.hpp, forced bins in
+dataset_loader.cpp; tests mirror tests/python_package_test/
+test_engine.py:1276-1436, 2280, 2535)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _monotone_data(seed=5, n=3000):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3)
+    y = (3.0 * X[:, 0] - 2.0 * X[:, 1] + 0.3 * np.sin(8 * X[:, 2])
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _is_monotone(bst, feature, sign, base):
+    grid = np.linspace(0.02, 0.98, 25)
+    rows = np.tile(base, (25, 1))
+    rows[:, feature] = grid
+    pred = bst.predict(rows)
+    diffs = np.diff(pred)
+    return np.all(sign * diffs >= -1e-10)
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+def test_monotone_constraints_hold(method):
+    X, y = _monotone_data()
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 20,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": method}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=20)
+    rng = np.random.RandomState(0)
+    for _ in range(10):
+        base = rng.rand(3)
+        assert _is_monotone(bst, 0, +1, base), f"+1 violated ({method})"
+        assert _is_monotone(bst, 1, -1, base), f"-1 violated ({method})"
+
+
+def test_monotone_penalty_pushes_feature_down_the_tree():
+    X, y = _monotone_data()
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20,
+              "monotone_constraints": [1, 0, 0]}
+    no_pen = lgb.train(params, lgb.Dataset(X, y), 2)
+    big_pen = lgb.train({**params, "monotone_penalty": 2.0},
+                        lgb.Dataset(X, y), 2)
+    # with a penalty >= depth+1 the monotone feature cannot split the first
+    # levels (reference ComputeMonotoneSplitGainPenalty returns eps)
+    for tree in big_pen._gbdt.models:
+        assert tree.split_feature[0] != 0, "root split on penalized feature"
+    # sanity: without the penalty feature 0 is the natural root split
+    assert any(t.split_feature[0] == 0 for t in no_pen._gbdt.models)
+
+
+def test_interaction_constraints_respected():
+    rng = np.random.RandomState(2)
+    X = rng.randn(3000, 4)
+    y = (X[:, 0] * X[:, 1] + X[:, 2] + 0.5 * X[:, 3]
+         + 0.05 * rng.randn(3000)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20,
+              "interaction_constraints": "[0,1],[2,3]"}
+    bst = lgb.train(params, lgb.Dataset(X, y), 10)
+    groups = [{0, 1}, {2, 3}]
+    for tree in bst._gbdt.models:
+        # every root->leaf path must stay inside ONE group
+        ni = tree.num_leaves - 1
+        parent = {}
+        for node in range(ni):
+            for c in (tree.left_child[node], tree.right_child[node]):
+                parent[int(c)] = node
+        for leaf in range(tree.num_leaves):
+            feats = set()
+            code = ~leaf
+            while code in parent:
+                code = parent[code]
+                feats.add(int(tree.split_feature[code]))
+            assert any(feats <= g for g in groups), \
+                f"path features {feats} cross groups"
+
+
+def test_forced_bins(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.rand(2000, 2) * 10
+    y = (X[:, 0] > 3.7).astype(np.float32)
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump([{"feature": 0, "bin_upper_bound": [3.7, 7.1]}], fh)
+    ds = lgb.Dataset(X, y)
+    ds._params = {"forcedbins_filename": path}
+    bst = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 4,
+                     "forcedbins_filename": path},
+                    lgb.Dataset(X, y), 2)
+    mapper = bst._gbdt.train_data.feature_mappers[0]
+    assert 3.7 in list(mapper.bin_upper_bound), mapper.bin_upper_bound[:10]
+    assert 7.1 in list(mapper.bin_upper_bound)

@@ -1,0 +1,113 @@
+"""extra_trees / path_smooth / CEGB / feature_contri / prediction
+early-stop / auc_mu / unwired-param warnings (reference test_engine.py +
+test_basic.py:368-429 CEGB coverage)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+
+
+def _data(seed=0, n=3000):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.8 * X[:, 1] - 0.5 * X[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "min_data_in_leaf": 20}
+
+
+def test_extra_trees_trains_and_differs():
+    X, y = _data()
+    a = lgb.train(BASE, lgb.Dataset(X, y), 10)
+    b = lgb.train({**BASE, "extra_trees": True}, lgb.Dataset(X, y), 10)
+    from sklearn.metrics import roc_auc_score
+    auc_a = roc_auc_score(y, a.predict(X))
+    auc_b = roc_auc_score(y, b.predict(X))
+    assert auc_b > 0.8                      # still learns
+    # randomized thresholds must change the trees
+    ta = a._gbdt.models[0].threshold[:a._gbdt.models[0].num_leaves - 1]
+    tb = b._gbdt.models[0].threshold[:b._gbdt.models[0].num_leaves - 1]
+    assert not np.array_equal(ta, tb)
+    assert auc_a >= auc_b - 0.05            # sanity, not a tight bound
+
+
+def test_path_smooth_shrinks_leaf_spread():
+    X, y = _data()
+    plain = lgb.train(BASE, lgb.Dataset(X, y), 5)
+    smooth = lgb.train({**BASE, "path_smooth": 100.0}, lgb.Dataset(X, y), 5)
+    sd_plain = np.std(plain.predict(X, raw_score=True))
+    sd_smooth = np.std(smooth.predict(X, raw_score=True))
+    assert sd_smooth < sd_plain             # outputs pulled toward parents
+
+
+def test_cegb_coupled_penalty_reduces_feature_set():
+    X, y = _data()
+    free = lgb.train(BASE, lgb.Dataset(X, y), 10)
+    pen = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_coupled": [0.0, 5.0, 5.0, 5.0, 5.0]},
+                    lgb.Dataset(X, y), 10)
+
+    def used(bst):
+        feats = set()
+        for t in bst._gbdt.models:
+            feats.update(t.split_feature[:t.num_leaves - 1].tolist())
+        return feats
+
+    # heavy coupled penalties on features 1-4 push splits onto feature 0
+    assert len(used(pen)) <= len(used(free))
+    imp_pen = pen.feature_importance()
+    assert imp_pen[0] == max(imp_pen)
+
+
+def test_feature_contri_downweights_feature():
+    X, y = _data()
+    bst = lgb.train({**BASE, "feature_contri": [0.0001, 1, 1, 1, 1]},
+                    lgb.Dataset(X, y), 10)
+    imp = bst.feature_importance()
+    # feature 0 is the strongest signal but its gain is scaled to ~0
+    assert imp[0] < max(imp)
+
+
+def test_pred_early_stop_close_to_exact():
+    X, y = _data()
+    bst = lgb.train(BASE, lgb.Dataset(X, y), 60)
+    exact = bst.predict(X[:200])
+    bst._gbdt.config = bst._gbdt.config.copy(
+        pred_early_stop=True, pred_early_stop_freq=5,
+        pred_early_stop_margin=8.0)
+    approx = bst.predict(X[:200])
+    # frozen rows already have |margin| > 8 -> class decisions identical
+    assert np.mean((exact > 0.5) == (approx > 0.5)) == 1.0
+
+
+def test_auc_mu_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 4)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "verbosity": -1,
+              "metric": "auc_mu", "num_leaves": 7}
+    res = {}
+    lgb.train(params, lgb.Dataset(X, y.astype(np.float32)), 10,
+              valid_sets=[lgb.Dataset(X, y.astype(np.float32))],
+              evals_result=res)
+    vals = res["valid_0"]["auc_mu"]
+    assert 0.5 < vals[0] <= 1.0
+    assert vals[-1] > vals[0]               # improves while training
+
+
+def test_unwired_params_warn():
+    from lightgbm_tpu import log as lgb_log
+    messages = []
+    lgb_log.register_log_callback(messages.append)
+    lgb_log.set_verbosity(1)   # earlier tests may have silenced logging
+    try:
+        Config({"objective": "binary", "two_round": True})
+    finally:
+        lgb_log.register_log_callback(None)
+    assert any("two_round" in m and "NOT implemented" in m
+               for m in messages), messages
