@@ -1,8 +1,24 @@
 """``python -m lightgbm_tpu`` — the CLI entry point (reference src/main.cpp)."""
 
+import os
 import sys
 
-from .application import main
+
+def _pin_platform() -> None:
+    """Honor LIGHTGBM_TPU_PLATFORM through the jax config API.
+
+    A site-wide ``sitecustomize`` may pre-import jax and point it at an
+    accelerator plugin before this process's environment is consulted; on a
+    shared machine that can block the CLI on an exclusive-device claim.
+    Re-pinning via jax.config wins over the pre-import (same pattern as
+    tests/conftest.py)."""
+    want = os.environ.get("LIGHTGBM_TPU_PLATFORM")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+
 
 if __name__ == "__main__":
+    _pin_platform()
+    from .application import main
     sys.exit(main())

@@ -18,6 +18,7 @@ from .gbdt import GBDT, _negated
 
 
 class DART(GBDT):
+    _fusable = False  # per-iteration host logic (drop-set selection/normalize)
     def __init__(self, config, train_data, objective):
         super().__init__(config, train_data, objective)
         self._drop_rng = np.random.RandomState(config.drop_seed)

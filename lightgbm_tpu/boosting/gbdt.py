@@ -174,9 +174,14 @@ class GBDT:
     # counterpart of keeping the reference's TrainOneIter entirely inside
     # the OpenMP region — no python between device ops, so the XLA stream
     # never drains between trees.
+    # subclasses with host-side per-iteration logic opt out (DART/RF);
+    # GOSS keeps True — its sampling is a device op (goss.py goss_adjust)
+    _fusable = True
+
     def _can_fuse(self) -> bool:
         from ..tree_learner import SerialTreeLearner
-        return (type(self) is GBDT
+        return (self._fusable
+                and type(self)._grow_and_apply is GBDT._grow_and_apply
                 and self.num_class == 1
                 and not self.objective.need_renew_tree_output
                 and not self.valid_sets
@@ -184,16 +189,33 @@ class GBDT:
                 and not getattr(self.tree_learner, "use_cegb", False)
                 and type(self.tree_learner) is SerialTreeLearner)
 
-    def _build_fused_step(self):
+    def _fused_variant(self) -> int:
+        """Cache token for fused-step program variants (GOSS toggles its
+        sampling on after the warmup iterations)."""
+        return 0
+
+    def _fused_gradient_adjust(self, grad, hess, mask, key, variant: int):
+        """Traceable gradient-adjustment hook (GOSS overrides)."""
+        return grad, hess, mask
+
+    def _fused_adjust_key(self):
+        """Key for _fused_gradient_adjust; GOSS derives it from bagging_seed
+        so fused and unfused runs draw the SAME sample sequence."""
+        return jax.random.PRNGKey(0)
+
+    def _build_fused_step(self, variant: int):
         obj = self.objective
         learner = self.tree_learner
         ds = self.train_data
         label, weight = ds.label, ds.weight
+        booster = self
 
         @jax.jit
-        def step(score_row, mask, fmask, key, lr):
+        def step(score_row, mask, fmask, key, adjust_key, lr):
             g, h = obj.get_gradients(score_row, label, weight)
-            state = learner.grow_traced(g, h, mask, fmask, key)
+            g2, h2, mask2 = booster._fused_gradient_adjust(
+                g[None, :], h[None, :], mask, adjust_key, variant)
+            state = learner.grow_traced(g2[0], h2[0], mask2, fmask, key)
             delta = jnp.where(state.n_leaves > 1,
                               (state.leaf_value * lr)[state.row_leaf],
                               jnp.zeros_like(score_row))
@@ -211,13 +233,16 @@ class GBDT:
             return True
         init = self._boost_from_average(0)
         if self._fused_step is None:
-            self._fused_step = self._build_fused_step()
+            self._fused_step = {}
+        variant = self._fused_variant()
+        if variant not in self._fused_step:
+            self._fused_step[variant] = self._build_fused_step(variant)
         learner = self.tree_learner
         mask = self._bagging_mask(self.iter_)
         with timed("fused_train_iter"):
-            new_score, slim = self._fused_step(
+            new_score, slim = self._fused_step[variant](
                 self.train_score[0], mask, learner.feature_mask(),
-                learner.iter_key(self.iter_),
+                learner.iter_key(self.iter_), self._fused_adjust_key(),
                 jnp.float32(self.shrinkage_rate))
         self.train_score = new_score[None, :]
         self._pending.append((slim, float(init), self.shrinkage_rate))
@@ -612,6 +637,16 @@ class GBDT:
         k = self.num_class
         end = self.iter_ if num_iteration < 0 else min(
             start_iteration + num_iteration, self.iter_)
+        # feature_infos in the reference loader's format
+        # (gbdt_model_text.cpp:44-61): [min:max] for numerical, the
+        # category list for categorical, none for unused columns
+        infos = ["none"] * ds.num_total_features
+        for inner, real in enumerate(ds.real_feature_index):
+            m = ds.feature_mappers[inner]
+            if getattr(m, "bin_2_categorical", None):
+                infos[real] = ":".join(str(c) for c in m.bin_2_categorical)
+            else:
+                infos[real] = f"[{m.min_val:g}:{m.max_val:g}]"
         lines = ["tree", "version=v3",
                  f"num_class={k}",
                  f"num_tree_per_iteration={k}",
@@ -619,7 +654,7 @@ class GBDT:
                  f"max_feature_idx={ds.num_total_features - 1}",
                  f"objective={self.objective.to_string()}",
                  "feature_names=" + " ".join(ds.feature_names),
-                 "feature_infos=" + " ".join(["none"] * ds.num_total_features)]
+                 "feature_infos=" + " ".join(infos)]
         if self.average_output:
             lines.append("average_output")
         lines.append("")

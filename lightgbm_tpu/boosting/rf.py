@@ -16,6 +16,7 @@ from .gbdt import GBDT
 
 
 class RF(GBDT):
+    _fusable = False  # per-iteration host logic (bagged leaf refit)
     def __init__(self, config, train_data, objective):
         if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
             raise ValueError(

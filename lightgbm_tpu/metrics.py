@@ -286,9 +286,35 @@ class MultiErrorMetric(Metric):
                  _wavg(err, w), False)]
 
 
-def _dcg_at(k, gains_sorted, discounts):
-    top = gains_sorted[:k]
-    return float(np.sum(top * discounts[:len(top)]))
+def query_sorted_positions(sort_key: np.ndarray, boundaries: np.ndarray):
+    """Vectorized within-query descending sort: returns (order, pos) where
+    ``order`` lists row indices grouped by query in sort_key-descending
+    (stable) order and ``pos`` is each sorted row's rank within its query.
+
+    Replaces per-query python loops (the reference parallelizes the same
+    loops with OpenMP, rank_metric.hpp / dcg_calculator.cpp; here one
+    lexsort + segment ops serve every query at once)."""
+    b = np.asarray(boundaries, np.int64)
+    lengths = np.diff(b)
+    n = int(b[-1])
+    qid = np.repeat(np.arange(len(lengths)), lengths)
+    order = np.lexsort((np.arange(n), -sort_key, qid))
+    pos = np.arange(n) - np.repeat(b[:-1], lengths)
+    return order, pos
+
+
+def grouped_dcg(score, gains, boundaries, ks, discounts):
+    """[len(ks), num_queries] DCG@k for every query at once."""
+    b = np.asarray(boundaries, np.int64)
+    order, pos = query_sorted_positions(score, b)
+    g = gains[order]
+    maxk = len(discounts)
+    base = g * np.where(pos < maxk, discounts[np.minimum(pos, maxk - 1)],
+                        0.0)
+    out = np.empty((len(ks), len(b) - 1))
+    for i, k in enumerate(ks):
+        out[i] = np.add.reduceat(np.where(pos < k, base, 0.0), b[:-1])
+    return out
 
 
 class NDCGMetric(Metric):
@@ -306,26 +332,20 @@ class NDCGMetric(Metric):
         eval_at = [int(k) for k in self.config.eval_at]
         maxk = max(eval_at)
         discounts = 1.0 / np.log2(np.arange(2, maxk + 2))
-        boundaries = query_info
-        sums = np.zeros(len(eval_at))
-        nq = len(boundaries) - 1
-        wsum = 0.0
-        for q in range(nq):
-            lo, hi = boundaries[q], boundaries[q + 1]
-            g = gains[lo:hi]
-            s = score[lo:hi]
-            qw = 1.0
-            wsum += qw
-            if np.all(g == g[0]):
-                sums += qw  # reference: all-same-label query counts as 1
-                continue
-            order = np.argsort(-s, kind="stable")
-            ideal = np.sort(g)[::-1]
-            for i, k in enumerate(eval_at):
-                dcg = _dcg_at(k, g[order], discounts)
-                idcg = _dcg_at(k, ideal, discounts)
-                sums[i] += qw * (dcg / idcg if idcg > 0 else 1.0)
-        return [(f"ndcg@{k}", float(sums[i] / wsum), True)
+        b = np.asarray(query_info, np.int64)
+        nq = len(b) - 1
+        if (np.diff(b) == 0).any():
+            raise ValueError("empty query group in ndcg evaluation")
+        dcgs = grouped_dcg(score, gains, b, eval_at, discounts)
+        idcgs = grouped_dcg(gains, gains, b, eval_at, discounts)
+        # reference: an all-same-label query counts as a perfect 1
+        same = (np.maximum.reduceat(gains, b[:-1]) ==
+                np.minimum.reduceat(gains, b[:-1]))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ndcg = np.where(same[None, :], 1.0,
+                            np.where(idcgs > 0, dcgs / idcgs, 1.0))
+        sums = ndcg.sum(axis=1)
+        return [(f"ndcg@{k}", float(sums[i] / nq), True)
                 for i, k in enumerate(eval_at)]
 
 
@@ -340,21 +360,27 @@ class MapMetric(Metric):
         score = _as_np(raw_score)
         y = _as_np(label) > 0
         eval_at = [int(k) for k in self.config.eval_at]
-        boundaries = query_info
-        nq = len(boundaries) - 1
+        b = np.asarray(query_info, np.int64)
+        nq = len(b) - 1
+        if (np.diff(b) == 0).any():
+            # np.add.reduceat would misattribute the next query's first row
+            raise ValueError("empty query group in map evaluation")
+        order, pos = query_sorted_positions(score, b)
+        rel = y[order].astype(np.float64)
+        # within-query cumulative hits: global cumsum minus each query's
+        # running offset
+        cum = np.cumsum(rel)
+        start_cum = np.concatenate([[0.0], cum])[b[:-1]]
+        hits = cum - np.repeat(start_cum, np.diff(b))
+        prec = hits / (pos + 1)
         sums = np.zeros(len(eval_at))
-        for q in range(nq):
-            lo, hi = boundaries[q], boundaries[q + 1]
-            rel = y[lo:hi]
-            order = np.argsort(-score[lo:hi], kind="stable")
-            rel_sorted = rel[order]
-            hits = np.cumsum(rel_sorted)
-            ranks = np.arange(1, len(rel_sorted) + 1)
-            prec = hits / ranks
-            for i, k in enumerate(eval_at):
-                topk = rel_sorted[:k]
-                nhit = topk.sum()
-                sums[i] += (np.sum(prec[:k] * topk) / nhit) if nhit > 0 else 0.0
+        for i, k in enumerate(eval_at):
+            in_k = (pos < k) & (rel > 0)
+            num = np.add.reduceat(np.where(in_k, prec, 0.0), b[:-1])
+            nhit = np.add.reduceat(np.where(in_k, rel, 0.0), b[:-1])
+            with np.errstate(invalid="ignore", divide="ignore"):
+                ap = np.where(nhit > 0, num / nhit, 0.0)
+            sums[i] = ap.sum()
         return [(f"map@{k}", float(sums[i] / nq), True)
                 for i, k in enumerate(eval_at)]
 

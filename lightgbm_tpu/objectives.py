@@ -347,8 +347,10 @@ class BinaryLogloss(ObjectiveFunction):
         bad = ~np.isin(label, (0, 1))
         if bad.any():
             raise ValueError("binary objective requires 0/1 labels")
-        # pos/neg counts; under multi-host these would be psum'd
-        # (reference distributed count sync, binary_objective.hpp:75-77)
+        # pos/neg counts are GLOBAL: every process holds the full label
+        # vector in this framework's multi-host design (rows are sharded
+        # only on device, parallel/data_parallel.py), so host-side counts
+        # equal the reference's synced counts (binary_objective.hpp:75-77)
         cnt_pos = float((label == 1).sum())
         cnt_neg = float((label == 0).sum())
         cfg = self.config

@@ -50,16 +50,6 @@ def make_query_layout(query_boundaries: np.ndarray):
     return np.where(valid, idx, 0).astype(np.int32), valid
 
 
-def _max_dcg_at_k(labels: np.ndarray, k: int, label_gain: np.ndarray) -> float:
-    """Ideal DCG over the top-k labels (reference
-    DCGCalculator::CalMaxDCGAtK, dcg_calculator.cpp:55)."""
-    top = np.sort(labels)[::-1][:k]
-    if len(top) == 0:
-        return 0.0
-    disc = 1.0 / np.log2(2.0 + np.arange(len(top)))
-    return float((label_gain[top.astype(np.int64)] * disc).sum())
-
-
 class _RankingBase(ObjectiveFunction):
     """Shared query layout plumbing (reference RankingObjective,
     rank_objective.hpp:25)."""
@@ -186,11 +176,16 @@ class LambdarankNDCG(_RankingBase):
                 f"label {int(self._label_np.max())} exceeds label_gain size "
                 f"{len(self.label_gain)} (reference DCGCalculator::CheckLabel)")
         qb = np.asarray(metadata.query_boundaries)
-        inv = np.zeros(self.num_queries)
-        for q in range(self.num_queries):
-            md = _max_dcg_at_k(self._label_np[qb[q]:qb[q + 1]].astype(np.int64),
-                               self.trunc, self.label_gain)
-            inv[q] = 1.0 / md if md > 0 else 0.0
+        # all queries at once (reference CalMaxDCGAtK per query,
+        # dcg_calculator.cpp:55; vectorized via metrics.grouped_dcg so
+        # Criteo-scale query counts don't pay a python loop)
+        from .metrics import grouped_dcg
+        gains_all = self.label_gain[self._label_np.astype(np.int64)]
+        discounts = 1.0 / np.log2(np.arange(2, self.trunc + 2))
+        md = grouped_dcg(gains_all.astype(np.float64), gains_all, qb,
+                         [self.trunc], discounts)[0]
+        with np.errstate(divide="ignore"):
+            inv = np.where(md > 0, 1.0 / md, 0.0)
         self.inv_max_dcg = jnp.asarray(inv.astype(np.float32))
         gains_np = self.label_gain[
             np.asarray(self.labels_pad).astype(np.int64)]

@@ -98,7 +98,8 @@ def test_save_binary_task(binary_dir, monkeypatch):
 def test_python_m_entrypoint(binary_dir):
     """`python -m lightgbm_tpu` end to end in a subprocess."""
     model = str(binary_dir / "m.txt")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LIGHTGBM_TPU_PLATFORM="cpu")
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, "-m", "lightgbm_tpu",
