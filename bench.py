@@ -118,8 +118,12 @@ def main():
     for attempt in range(RETRIES + 1):
         env = dict(env_base)
         if attempt == RETRIES:
-            # final fallback: CPU, tiny workload, honest "backend": "cpu"
+            # final fallback: CPU, tiny workload, honest "backend": "cpu".
+            # Clearing the TPU-pool pointer stops sitecustomize from dialing
+            # the tunnel at interpreter start (a leftover claim from a killed
+            # earlier attempt would block `import jax` there).
             env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
             env["BENCH_ROWS"] = "200000"
             env["BENCH_ITERS"] = "10"
         env["BENCH_CHILD"] = "1"

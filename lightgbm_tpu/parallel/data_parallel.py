@@ -39,6 +39,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
+        if self.forced is not None:
+            from ..log import log_warning as warning
+            warning("forcedsplits_filename is not supported by parallel "
+                    "tree learners; ignoring forced splits")
+            self.forced = None
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
         self.grower_cfg = self.grower_cfg._replace(

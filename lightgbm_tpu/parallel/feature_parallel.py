@@ -38,6 +38,11 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
+        if self.forced is not None:
+            from ..log import log_warning as warning
+            warning("forcedsplits_filename is not supported by parallel "
+                    "tree learners; ignoring forced splits")
+            self.forced = None
         if config.grow_strategy != "compact":
             raise ValueError("tree_learner=feature requires "
                              "grow_strategy=compact")

@@ -133,6 +133,132 @@ class TreeState(NamedTuple):
     node_cat_mask: jnp.ndarray   # [L-1, B] bool
 
 
+class ForcedSplits(NamedTuple):
+    """Device-side BFS schedule of forced splits (reference ForceSplits,
+    serial_tree_learner.cpp:450-562, forcedsplits_filename).
+
+    Entry s is applied at grower step s: split leaf ``leaf[s]`` on inner
+    feature ``feat[s]`` at threshold bin ``thr[s]`` (bins <= thr go left).
+    Leaf ids follow the grower's convention (left child keeps the parent's
+    leaf id, right child becomes leaf ``s + 1``), which is exactly the
+    reference's Split() numbering, so the host-side BFS in
+    ``parse_forced_splits`` can precompute them.
+    """
+    leaf: jnp.ndarray   # [S] int32
+    feat: jnp.ndarray   # [S] int32 (inner feature index)
+    thr: jnp.ndarray    # [S] int32 (threshold bin)
+
+
+def parse_forced_splits(spec, dataset, max_splits: int):
+    """Host-side translation of the forced-splits JSON tree into a BFS
+    schedule (reference SerialTreeLearner::ForceSplits walks the same queue
+    at the start of every tree; here the walk happens once, up front).
+
+    ``spec`` is a path to the JSON file (config forcedsplits_filename) or an
+    already-parsed dict.  Numerical features only — the reference also
+    forces categorical splits; unsupported nodes end the schedule early with
+    a warning, mirroring the reference's abort-on-bad-node behavior.
+    """
+    import json as _json
+    from collections import deque
+    from .binning import BinType
+    from .log import log_warning as warning
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        with open(spec) as fh:
+            root = _json.load(fh)
+    else:
+        root = spec
+    if not isinstance(root, dict) or "feature" not in root:
+        return None
+    inv = {real: inner for inner, real in
+           enumerate(dataset.real_feature_index)}
+    leaves, feats, thrs = [], [], []
+    q = deque([(root, 0)])
+    s = 0
+    while q and s < max_splits:
+        node, leaf = q.popleft()
+        real = int(node["feature"])
+        if real not in inv:
+            warning(f"forced split on trivial/unknown feature {real}; "
+                    "stopping forced splits here")
+            break
+        inner = inv[real]
+        mapper = dataset.feature_mappers[inner]
+        if mapper.bin_type == BinType.CATEGORICAL:
+            warning("categorical forced splits are not supported; "
+                    "stopping forced splits here")
+            break
+        tbin = int(np.asarray(mapper.value_to_bin(
+            np.asarray([float(node["threshold"])])))[0])
+        leaves.append(leaf)
+        feats.append(inner)
+        thrs.append(tbin)
+        left_leaf, right_leaf = leaf, s + 1
+        for key, child_leaf in (("left", left_leaf), ("right", right_leaf)):
+            ch = node.get(key)
+            if isinstance(ch, dict) and "feature" in ch and "threshold" in ch:
+                q.append((ch, child_leaf))
+        s += 1
+    if not leaves:
+        return None
+    return ForcedSplits(leaf=jnp.asarray(leaves, jnp.int32),
+                        feat=jnp.asarray(feats, jnp.int32),
+                        thr=jnp.asarray(thrs, jnp.int32))
+
+
+def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
+                         num_bins_f, has_missing_f,
+                         bmap: Optional[BundleMap]) -> SplitResult:
+    """Gather split sums at a forced (feature, threshold-bin) from the leaf's
+    pooled histogram — reference GatherInfoForThresholdNumerical
+    (feature_histogram.hpp:518-546).  The missing direction is chosen by
+    gain, like the normal double scan."""
+    if cfg.use_efb:
+        hist = expand_bundle_hist(pool_hist, sums, bmap, num_bins_f,
+                                  cfg.num_bins)
+    else:
+        hist = pool_hist
+    h = hist[f_feat].astype(sums.dtype)          # [B, 3]
+    B = h.shape[0]
+    binv = jnp.arange(B, dtype=jnp.int32)
+    nb = num_bins_f[f_feat]
+    has_na = has_missing_f[f_feat]
+    is_missing_bin = has_na & (binv == nb - 1)
+    base_left = (binv <= f_thr) & (binv < nb) & ~is_missing_bin
+    left_nm = (h * base_left[:, None].astype(h.dtype)).sum(axis=0)
+    miss = (h * is_missing_bin[:, None].astype(h.dtype)).sum(axis=0)
+    l1, l2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
+    parent_gain = leaf_gain(sums[0], sums[1], l1, l2, mds)
+
+    def side_gain(left):
+        right = sums - left
+        g = (leaf_gain(left[0], left[1], l1, l2, mds)
+             + leaf_gain(right[0], right[1], l1, l2, mds)
+             - parent_gain - cfg.min_gain_to_split)
+        ok = ((left[2] > 0) & (right[2] > 0)
+              & (left[1] > cfg.min_sum_hessian_in_leaf)
+              & (right[1] > cfg.min_sum_hessian_in_leaf))
+        return jnp.where(ok, g, _NEG_INF), right
+
+    gain_l, right_l = side_gain(left_nm + miss)
+    gain_r, right_r = side_gain(left_nm)
+    dleft = has_na & (gain_l >= gain_r)
+    gain = jnp.where(dleft, gain_l, gain_r)
+    left = jnp.where(dleft, left_nm + miss, left_nm)
+    right = jnp.where(dleft, right_l, right_r)
+    return SplitResult(
+        gain=gain.astype(sums.dtype),
+        feature=f_feat, threshold_bin=f_thr, default_left=dleft,
+        left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
+        right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
+        left_output=leaf_output(left[0], left[1], l1, l2, mds),
+        right_output=leaf_output(right[0], right[1], l1, l2, mds),
+        is_cat=jnp.asarray(False),
+        cat_mask=jnp.zeros((B,), bool))
+
+
 def _child_weights(grad_m, hess_m, mask, left_m, right_m):
     """6-channel weights: both children's (g, h, count) in one histogram pass."""
     return jnp.stack([
@@ -578,6 +704,7 @@ def grow_tree_compact(cfg: GrowerConfig,
                       igroups: Optional[jnp.ndarray] = None,
                       gain_scale_f: Optional[jnp.ndarray] = None,
                       gain_penalty_f: Optional[jnp.ndarray] = None,
+                      forced: Optional[ForcedSplits] = None,
                       ) -> TreeState:
     """Grow one tree with the partition-order strategy; same TreeState out."""
     n, g = bins.shape            # g = storage columns (bundles under EFB)
@@ -717,9 +844,33 @@ def grow_tree_compact(cfg: GrowerConfig,
 
     def body(step, carry):
         state, order, leaf_start, leaf_count, pool = carry
-        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
-        gain = state.best_gain[best_leaf]
-        found = gain > K_EPSILON
+        if forced is not None:
+            # forced-splits prefix (reference ForceSplits): steps < S split
+            # the scheduled leaf at the scheduled (feature, bin) instead of
+            # the best-gain candidate; an infeasible forced split (negative
+            # gain / empty child) falls back to the normal argmax step,
+            # mirroring the reference's abort_last_forced_split.
+            S = forced.leaf.shape[0]
+            si = jnp.minimum(step, S - 1)
+            f_leaf = forced.leaf[si]
+            res_f = _forced_split_result(cfg, pool[f_leaf],
+                                         state.leaf_sum[f_leaf],
+                                         forced.feat[si], forced.thr[si],
+                                         num_bins_f, has_missing_f, bmap)
+            f_valid = (step < S) & (res_f.gain >= 0.0) \
+                & (f_leaf < state.n_leaves)
+            state = jax.lax.cond(
+                f_valid, lambda s: _store_best(s, f_leaf, res_f),
+                lambda s: s, state)
+            best_leaf = jnp.where(
+                f_valid, f_leaf,
+                jnp.argmax(state.best_gain).astype(jnp.int32))
+            gain = state.best_gain[best_leaf]
+            found = f_valid | (gain > K_EPSILON)
+        else:
+            best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
+            gain = state.best_gain[best_leaf]
+            found = gain > K_EPSILON
 
         def do_split(carry):
             state, order, leaf_start, leaf_count, pool = carry
@@ -993,6 +1144,19 @@ class SerialTreeLearner:
                          or config.cegb_penalty_feature_coupled is not None)
         if self.use_cegb:
             self.grower_cfg = self.grower_cfg._replace(use_gain_penalty=True)
+        # forced splits (reference forcedsplits_filename): compact grower
+        # only — the dense grower keeps no per-leaf histogram pool to gather
+        # threshold sums from
+        self.forced = None
+        if getattr(config, "forcedsplits_filename", ""):
+            if config.grow_strategy != "compact":
+                from .log import log_warning as warning
+                warning("forcedsplits_filename requires "
+                        "grow_strategy=compact; ignoring forced splits")
+            else:
+                self.forced = parse_forced_splits(
+                    config.forcedsplits_filename, dataset,
+                    self.grower_cfg.num_leaves - 1)
 
     @staticmethod
     def _build_interaction_groups(config, dataset):
@@ -1057,11 +1221,14 @@ class SerialTreeLearner:
         ds = self.dataset
         grow = (grow_tree_compact
                 if self.config.grow_strategy == "compact" else grow_tree)
+        kw = {}
+        if self.config.grow_strategy == "compact":
+            kw["forced"] = self.forced
         return grow(self.grower_cfg, ds.device_bins, grad, hess,
                     sample_mask, ds.num_bins_per_feature,
                     ds.has_missing_per_feature, feature_mask,
                     self.monotone, key, self.is_cat_f, self.bmap,
-                    self.igroups, self.gain_scale, None)
+                    self.igroups, self.gain_scale, None, **kw)
 
     def train(self, grad, hess, sample_mask, iteration: int,
               gain_penalty=None):
@@ -1069,9 +1236,12 @@ class SerialTreeLearner:
         key = self.iter_key(iteration)
         grow = (grow_tree_compact_jit
                 if self.config.grow_strategy == "compact" else grow_tree)
+        kw = {}
+        if self.config.grow_strategy == "compact":
+            kw["forced"] = self.forced
         state = grow(self.grower_cfg, ds.device_bins, grad, hess,
                      sample_mask, ds.num_bins_per_feature,
                      ds.has_missing_per_feature, self.feature_mask(),
                      self.monotone, key, self.is_cat_f, self.bmap,
-                     self.igroups, self.gain_scale, gain_penalty)
+                     self.igroups, self.gain_scale, gain_penalty, **kw)
         return state

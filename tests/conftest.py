@@ -6,6 +6,13 @@ import os
 import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests never touch the real chip.  Clearing the TPU-pool pointer here keeps
+# every test SUBPROCESS (CLI tests, multi-process distributed tests) from
+# dialing the exclusive TPU tunnel at interpreter start, whose claim-wait
+# blocks `import jax` whenever another process (e.g. a bench run) holds the
+# chip.  (For this process sitecustomize already ran; JAX_PLATFORMS=cpu above
+# plus the config update below keep it off the chip.)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # Rewrite (not just append) any existing device-count flag so a stale value
 # can't win; must run before any jax import, so it cannot be shared with the
 # identical bootstrap in __graft_entry__.py (importing lightgbm_tpu imports

@@ -101,3 +101,49 @@ def test_forced_bins(tmp_path):
     mapper = bst._gbdt.train_data.feature_mappers[0]
     assert 3.7 in list(mapper.bin_upper_bound), mapper.bin_upper_bound[:10]
     assert 7.1 in list(mapper.bin_upper_bound)
+
+
+def test_forced_splits_honored(tmp_path):
+    """Root + nested-left forced splits appear at the top of every tree
+    (reference forcedsplits_filename, serial_tree_learner.cpp:450-562;
+    test mirrors test_engine.py test_forced_split)."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(4000, 4).astype(np.float32)
+    y = (X[:, 0] + 2.0 * X[:, 1] + 0.1 * rng.randn(4000)).astype(np.float32)
+    fs = {"feature": 2, "threshold": 0.5,
+          "left": {"feature": 3, "threshold": 0.25}}
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump(fs, fh)
+    params = {"objective": "regression", "num_leaves": 16, "verbosity": -1,
+              "min_data_in_leaf": 5, "forcedsplits_filename": path}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3)
+    model = bst.dump_model()
+    for tree in model["tree_info"]:
+        root = tree["tree_structure"]
+        # root forced onto feature 2 near 0.5
+        assert root["split_feature"] == 2
+        assert abs(root["threshold"] - 0.5) < 0.1
+        # left child forced onto feature 3 near 0.25
+        lc = root["left_child"]
+        assert lc["split_feature"] == 3
+        assert abs(lc["threshold"] - 0.25) < 0.1
+    # forced model still learns: unforced comparison trains fine and the
+    # forced one is not degenerate
+    pred = bst.predict(X[:50])
+    assert np.std(pred) > 0
+
+
+def test_forced_splits_bad_feature_ignored(tmp_path):
+    """A forced split on a nonexistent feature degrades to normal growth
+    with a warning instead of crashing."""
+    rng = np.random.RandomState(8)
+    X = rng.rand(500, 3).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    path = str(tmp_path / "forced_bad.json")
+    with open(path, "w") as fh:
+        json.dump({"feature": 99, "threshold": 0.5}, fh)
+    params = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+              "min_data_in_leaf": 5, "forcedsplits_filename": path}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
+    assert bst.num_trees() == 2
