@@ -219,6 +219,79 @@ LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
   return 0;
 }
 
+namespace {
+
+// CSR/CSC payload: (indptr, indices, data) each as a (bytes,dtype,n,1) tuple.
+// Returns a 3-tuple of matrices or null.
+PyObject* make_sparse_parts(const void* indptr, int indptr_type,
+                            const int32_t* indices, const void* data,
+                            int data_type, int64_t nindptr, int64_t nelem) {
+  PyObject* p_indptr = make_matrix(
+      indptr, indptr_type, static_cast<int32_t>(nindptr), 1);
+  PyObject* p_indices = make_matrix(
+      indices, 2 /* int32 */, static_cast<int32_t>(nelem), 1);
+  PyObject* p_data = make_matrix(
+      data, data_type, static_cast<int32_t>(nelem), 1);
+  if (p_indptr == nullptr || p_indices == nullptr || p_data == nullptr) {
+    Py_XDECREF(p_indptr);
+    Py_XDECREF(p_indices);
+    Py_XDECREF(p_data);
+    return nullptr;
+  }
+  return Py_BuildValue("(NNN)", p_indptr, p_indices, p_data);
+}
+
+int create_from_sparse(const char* impl_fn, const void* indptr,
+                       int indptr_type, const int32_t* indices,
+                       const void* data, int data_type, int64_t nindptr,
+                       int64_t nelem, int64_t num_col_or_row,
+                       const char* parameters, const DatasetHandle reference,
+                       DatasetHandle* out) {
+  PyObject* parts = make_sparse_parts(indptr, indptr_type, indices, data,
+                                      data_type, nindptr, nelem);
+  if (parts == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* p_indptr = PyTuple_GetItem(parts, 0);
+  PyObject* p_indices = PyTuple_GetItem(parts, 1);
+  PyObject* p_data = PyTuple_GetItem(parts, 2);
+  PyObject* args = Py_BuildValue(
+      "(OOOLLLsO)", p_indptr, p_indices, p_data,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col_or_row), parameters ? parameters : "",
+      reference ? static_cast<PyObject*>(reference) : Py_None);
+  Py_DECREF(parts);
+  PyObject* handle = nullptr;
+  if (run_simple(impl_fn, args, &handle) != 0) return -1;
+  *out = handle;
+  return 0;
+}
+
+}  // namespace
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  Gil gil;
+  return create_from_sparse("dataset_create_from_csr", indptr, indptr_type,
+                            indices, data, data_type, nindptr, nelem,
+                            num_col, parameters, reference, out);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  Gil gil;
+  return create_from_sparse("dataset_create_from_csc", col_ptr, col_ptr_type,
+                            indices, data, data_type, ncol_ptr, nelem,
+                            num_row, parameters, reference, out);
+}
+
 // ---------------------------------------------------------------------------
 // Booster (reference c_api.h:406-1041)
 // ---------------------------------------------------------------------------
@@ -265,6 +338,41 @@ LGBM_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
   PyObject* res = nullptr;
   if (run_simple("booster_update_one_iter", args, &res) != 0) return -1;
+  *is_finished = PyObject_IsTrue(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                                const float* grad,
+                                                const float* hess,
+                                                int* is_finished) {
+  Gil gil;
+  // length = num_data * num_class, queried from the python side
+  PyObject* nargs = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* nres = nullptr;
+  if (run_simple("booster_num_classes", nargs, &nres) != 0) return -1;
+  long k = PyLong_AsLong(nres);
+  Py_DECREF(nres);
+  PyObject* dargs = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* dres = nullptr;
+  if (run_simple("booster_train_num_data", dargs, &dres) != 0) return -1;
+  long n = PyLong_AsLong(dres);
+  Py_DECREF(dres);
+  int32_t len = static_cast<int32_t>(n * k);
+  PyObject* g = make_matrix(grad, 0 /* float32 */, len, 1);
+  PyObject* h = make_matrix(hess, 0 /* float32 */, len, 1);
+  if (g == nullptr || h == nullptr) {
+    Py_XDECREF(g);
+    Py_XDECREF(h);
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue("(ONN)", static_cast<PyObject*>(handle),
+                                 g, h);
+  PyObject* res = nullptr;
+  if (run_simple("booster_update_one_iter_custom", args, &res) != 0)
+    return -1;
   *is_finished = PyObject_IsTrue(res);
   Py_DECREF(res);
   return 0;
@@ -345,6 +453,179 @@ LGBM_EXPORT int LGBM_BoosterPredictForMat(BoosterHandle handle,
   *out_len = static_cast<int64_t>(nbytes / 8);
   Py_DECREF(res);
   return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                                 int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_num_model_per_iteration", args, &res) != 0)
+    return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                               int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_number_of_total_model", args, &res) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_get_num_feature", args, &res) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                           const char* parameters) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 parameters ? parameters : "");
+  return run_simple("booster_reset_parameter", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  Gil gil;
+  PyObject* parts = make_sparse_parts(indptr, indptr_type, indices, data,
+                                      data_type, nindptr, nelem);
+  if (parts == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue(
+      "(OOOOLLLiiis)", static_cast<PyObject*>(handle),
+      PyTuple_GetItem(parts, 0), PyTuple_GetItem(parts, 1),
+      PyTuple_GetItem(parts, 2), static_cast<long long>(nindptr),
+      static_cast<long long>(nelem), static_cast<long long>(num_col),
+      predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "");
+  Py_DECREF(parts);
+  PyObject* res = nullptr;
+  if (run_simple("booster_predict_for_csr", args, &res) != 0) return -1;
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0) {
+    Py_DECREF(res);
+    capture_py_error();
+    return -1;
+  }
+  std::memcpy(out_result, buf, static_cast<size_t>(nbytes));
+  *out_len = static_cast<int64_t>(nbytes / 8);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   start_iteration, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
+// FastConfig: a python-side object pre-binding (booster, predict args) so
+// the per-row call carries only the row (reference FastConfigHandle,
+// c_api.h:904-962 / c_api.cpp:398).
+typedef void* FastConfigHandle;
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int32_t ncol,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oiiiiis)", static_cast<PyObject*>(handle), predict_type,
+      start_iteration, num_iteration, data_type, ncol,
+      parameter ? parameter : "");
+  PyObject* cfg = nullptr;
+  if (run_simple("booster_fast_config_init", args, &cfg) != 0) return -1;
+  *out_fastConfig = cfg;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* data, int64_t* out_len,
+    double* out_result) {
+  Gil gil;
+  PyObject* cfg = static_cast<PyObject*>(fastConfig_handle);
+  // ncol + data_type were fixed at FastInit time and live python-side
+  PyObject* ncol_obj = PyObject_GetAttrString(cfg, "ncol");
+  PyObject* dt_obj = PyObject_GetAttrString(cfg, "data_type");
+  if (ncol_obj == nullptr || dt_obj == nullptr) {
+    Py_XDECREF(ncol_obj);
+    Py_XDECREF(dt_obj);
+    capture_py_error();
+    return -1;
+  }
+  int32_t ncol = static_cast<int32_t>(PyLong_AsLong(ncol_obj));
+  int data_type = static_cast<int>(PyLong_AsLong(dt_obj));
+  Py_DECREF(ncol_obj);
+  Py_DECREF(dt_obj);
+  PyObject* row = make_matrix(data, data_type, ncol, 1);
+  if (row == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue("(ON)", cfg, row);
+  PyObject* res = nullptr;
+  if (run_simple("booster_predict_single_row_fast", args, &res) != 0)
+    return -1;
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0) {
+    Py_DECREF(res);
+    capture_py_error();
+    return -1;
+  }
+  std::memcpy(out_result, buf, static_cast<size_t>(nbytes));
+  *out_len = static_cast<int64_t>(nbytes / 8);
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_FastConfigFree(FastConfigHandle fastConfig) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(fastConfig));
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Network (reference c_api.h:1290-1319 / Network::Init)
+// ---------------------------------------------------------------------------
+
+LGBM_EXPORT int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                                 int listen_time_out, int num_machines) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(siii)", machines ? machines : "",
+                                 local_listen_port, listen_time_out,
+                                 num_machines);
+  return run_simple("network_init", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_NetworkFree() {
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  return run_simple("network_free", args, nullptr);
 }
 
 LGBM_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,

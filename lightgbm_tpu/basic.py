@@ -101,6 +101,47 @@ class Dataset:
         data = self.data
         if data is None:
             raise LightGBMError("cannot construct Dataset: raw data was freed")
+        cfg0 = Config(self.params)
+        rank_sharded = (self.reference is None and self._used_indices is None
+                        and cfg0.num_machines > 1
+                        and cfg0.tree_learner in ("data", "voting")
+                        and (isinstance(data, str) or cfg0.pre_partition))
+        if rank_sharded:
+            # distributed loading: each rank materializes only its row shard
+            # (reference dataset_loader.cpp:182 rank-aware load + :1044-1127
+            # distributed bin-finding).  pre_partition=true means `data` is
+            # already this rank's share (its own file / its own arrays);
+            # otherwise ranks round-robin the shared file's rows.
+            if self.group is not None:
+                raise LightGBMError(
+                    "query/group data requires pre-partitioned loading by "
+                    "query; not supported with rank-sharded ingestion")
+            from .parallel.mesh import maybe_init_distributed
+            maybe_init_distributed(cfg0)
+            import jax
+            if isinstance(data, str):
+                if cfg0.pre_partition:
+                    from .io.parser import load_svmlight_or_csv
+                    X_local, y_local = load_svmlight_or_csv(data)
+                else:
+                    from .io.parser import load_rank_shard
+                    X_local, y_local = load_rank_shard(
+                        data, jax.process_index(), jax.process_count())
+                if self.label is not None:
+                    raise LightGBMError(
+                        "rank-sharded file loading takes labels from the "
+                        "file's label column")
+            else:
+                X_local = _to_2d_numpy(data)
+                y_local = np.asarray(self.label, np.float32)
+            cats = self._resolve_categoricals(X_local.shape[1])
+            self._handle = TrainDataset.from_rank_shard(
+                X_local, y_local, cfg0, categorical_features=cats,
+                weight_local=self.weight,
+                init_score_local=self.init_score)
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(data, str):
             from .io.parser import load_svmlight_or_csv
             arr, label = load_svmlight_or_csv(data)
@@ -131,6 +172,31 @@ class Dataset:
             cats = self._resolve_categoricals(0)
             self._handle = TrainDataset.from_sequences(
                 seqs, meta, cfg, categorical_features=cats)
+            if self.free_raw_data:
+                self.data = None
+            return self
+        elif (hasattr(data, "tocsc") and not isinstance(data, np.ndarray)
+              and self._used_indices is None):
+            # scipy sparse: bin columns from the nonzeros; the dense float64
+            # matrix is never materialized (reference CSR/CSC ingestion,
+            # c_api.cpp LGBM_DatasetCreateFromCSR)
+            n = data.shape[0]
+            label = self.label if self.label is not None else np.zeros(
+                n, np.float32)
+            meta = Metadata(np.asarray(label),
+                            None if self.weight is None
+                            else np.asarray(self.weight),
+                            np.asarray(self.group)
+                            if self.group is not None else None,
+                            None if self.init_score is None
+                            else np.asarray(self.init_score))
+            cfg = Config(self.params)
+            cats = self._resolve_categoricals(data.shape[1])
+            if self.reference is not None:
+                self._handle = self.reference._handle.create_valid(data, meta)
+            else:
+                self._handle = TrainDataset.from_sparse(
+                    data, meta, cfg, categorical_features=cats)
             if self.free_raw_data:
                 self.data = None
             return self
@@ -383,6 +449,19 @@ class Booster:
             self._gbdt.rollback_one_iter()
         return self
 
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Re-resolve tunable parameters mid-training (reference
+        Booster.reset_parameter -> LGBM_BoosterResetParameter,
+        c_api.cpp:1660 GBDT::ResetConfig).  Structural dataset params
+        (max_bin etc.) are frozen at construct time, like the reference."""
+        with self._lock.write():
+            self.params.update(params)
+            cfg = Config(self.params)
+            self._config = cfg
+            if self._gbdt is not None:
+                self._gbdt.reset_config(cfg)
+        return self
+
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration()
 
@@ -431,6 +510,21 @@ class Booster:
             data, _ = load_svmlight_or_csv(data)
         elif type(data).__name__ == "DataFrame":
             data, _ = _pandas_categorical(data)
+        elif hasattr(data, "tocsr") and not isinstance(data, np.ndarray):
+            # scipy sparse: tree traversal needs raw values, so densify in
+            # bounded chunks instead of all at once (reference
+            # LGBM_BoosterPredictForCSR reconstructs rows the same way)
+            csr = data.tocsr()
+            if csr.shape[0] == 0:
+                return self.predict(np.zeros(csr.shape), start_iteration,
+                                    num_iteration, raw_score, pred_leaf,
+                                    pred_contrib, **kwargs)
+            step = 1 << 16
+            outs = [self.predict(csr[lo:lo + step].toarray(),
+                                 start_iteration, num_iteration, raw_score,
+                                 pred_leaf, pred_contrib, **kwargs)
+                    for lo in range(0, csr.shape[0], step)]
+            return np.concatenate(outs, axis=0)
         else:
             data = _to_2d_numpy(data)
         if num_iteration is None:

@@ -311,12 +311,16 @@ def find_bin_mappers(sample: np.ndarray, max_bin: int = 255,
                      min_split_data: int = 0,
                      max_bin_by_feature: Optional[Sequence[int]] = None,
                      feature_pre_filter: bool = True,
-                     forced_bins_path: str = "") -> List[BinMapper]:
+                     forced_bins_path: str = "",
+                     col_offset: int = 0) -> List[BinMapper]:
     """Find one BinMapper per column of a sampled row-block
     (reference DatasetLoader::ConstructBinMappersFromTextData path).
 
     forced_bins_path: JSON file of [{"feature": i, "bin_upper_bound":
-    [...]}, ...] (reference forcedbins_filename, dataset_loader.cpp)."""
+    [...]}, ...] (reference forcedbins_filename, dataset_loader.cpp).
+    col_offset: global index of the sample's first column — lets callers
+    bin a column block at a time (sparse/wide inputs) while categorical /
+    forced-bin / per-feature-max indices stay global."""
     sample = np.asarray(sample, dtype=np.float64)
     n, num_features = sample.shape
     cats = set(categorical_features or ())
@@ -328,12 +332,13 @@ def find_bin_mappers(sample: np.ndarray, max_bin: int = 255,
                 forced[int(ent["feature"])] = list(ent["bin_upper_bound"])
     mappers = []
     for f in range(num_features):
-        mb = max_bin if max_bin_by_feature is None else int(max_bin_by_feature[f])
+        g = f + col_offset
+        mb = max_bin if max_bin_by_feature is None else int(max_bin_by_feature[g])
         m = BinMapper().find_bin(
             sample[:, f], n, mb, min_data_in_bin, min_split_data,
             pre_filter=feature_pre_filter,
-            bin_type=BinType.CATEGORICAL if f in cats else BinType.NUMERICAL,
+            bin_type=BinType.CATEGORICAL if g in cats else BinType.NUMERICAL,
             use_missing=use_missing, zero_as_missing=zero_as_missing,
-            forced_bounds=forced.get(f))
+            forced_bounds=forced.get(g))
         mappers.append(m)
     return mappers

@@ -69,6 +69,19 @@ class GBDT:
         self.eval_results: Dict[str, Dict[str, List[float]]] = {}
         self._L = self.tree_learner.grower_cfg.num_leaves
 
+    def reset_config(self, config) -> None:
+        """Re-resolve tunable training params mid-run (reference
+        GBDT::ResetConfig, gbdt.cpp:676): rebuild the tree learner with the
+        new grower config and refresh derived knobs.  Dataset-structural
+        params (max_bin, binning) stay frozen, like the reference."""
+        self._flush_pending()          # pending states used the old cfg
+        self.config = config
+        self.shrinkage_rate = config.learning_rate
+        self.tree_learner = self._create_tree_learner(config, self.train_data)
+        self.train_metrics = create_metrics(config, self.objective)
+        self._fused_step = None        # recompile against the new config
+        self._L = self.tree_learner.grower_cfg.num_leaves
+
     @property
     def models(self) -> List[Tree]:
         """Host-side tree list; converts any pending device states first."""
@@ -527,6 +540,11 @@ class GBDT:
         """reference GBDT::RollbackOneIter (gbdt.cpp:454)."""
         if self.iter_ <= 0:
             return
+        if getattr(self.train_data, "rank_local", False):
+            raise RuntimeError(
+                "rollback_one_iter is not supported with rank-sharded "
+                "datasets (no process holds the full bin matrix to "
+                "re-traverse); retrain from a snapshot instead")
         for cls in reversed(range(self.num_class)):
             tree = self.models.pop()
             # subtract the tree's contribution (incl. any folded-in init

@@ -17,12 +17,20 @@ from .config import resolve_aliases
 
 __all__ = [
     "dataset_create_from_mat", "dataset_create_from_file",
+    "dataset_create_from_csr", "dataset_create_from_csc",
     "dataset_set_field", "dataset_num_data", "dataset_num_feature",
     "booster_create", "booster_create_from_modelfile", "booster_add_valid",
-    "booster_update_one_iter", "booster_rollback_one_iter",
+    "booster_update_one_iter", "booster_update_one_iter_custom",
+    "booster_rollback_one_iter",
     "booster_num_classes", "booster_current_iteration", "booster_get_eval",
-    "booster_predict_for_mat", "booster_save_model",
+    "booster_num_model_per_iteration", "booster_number_of_total_model",
+    "booster_train_num_data",
+    "booster_get_num_feature", "booster_reset_parameter",
+    "booster_predict_for_mat", "booster_predict_for_csr",
+    "booster_fast_config_init", "booster_predict_single_row_fast",
+    "booster_save_model",
     "booster_save_model_to_string", "booster_load_model_from_string",
+    "network_init", "network_free",
 ]
 
 # reference c_api.h predict type constants
@@ -72,6 +80,44 @@ def dataset_create_from_file(filename: str, parameters: str,
     return ds
 
 
+def _sparse_parts(indptr_mat, indices_mat, data_mat, nindptr: int,
+                  nelem: int):
+    """Decode the three (bytes, dtype, n, 1) buffers of a CSR/CSC payload."""
+    indptr = np.frombuffer(indptr_mat[0], dtype=indptr_mat[1])[:nindptr]
+    indices = np.frombuffer(indices_mat[0], dtype=indices_mat[1])[:nelem]
+    values = np.frombuffer(data_mat[0], dtype=data_mat[1])[:nelem]
+    return indptr, indices, values.astype(np.float64)
+
+
+def dataset_create_from_csr(indptr_mat, indices_mat, data_mat, nindptr: int,
+                            nelem: int, num_col: int, parameters: str,
+                            reference) -> Dataset:
+    """reference LGBM_DatasetCreateFromCSR (c_api.cpp:1249); rows stay
+    sparse until the column-wise binning pass (dataset.from_sparse)."""
+    import scipy.sparse as sps
+    indptr, indices, values = _sparse_parts(indptr_mat, indices_mat,
+                                            data_mat, nindptr, nelem)
+    csr = sps.csr_matrix((values, indices, indptr),
+                         shape=(nindptr - 1, num_col))
+    return Dataset(csr, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset)
+                   else None, free_raw_data=False)
+
+
+def dataset_create_from_csc(indptr_mat, indices_mat, data_mat, nindptr: int,
+                            nelem: int, num_row: int, parameters: str,
+                            reference) -> Dataset:
+    """reference LGBM_DatasetCreateFromCSC (c_api.cpp:1326)."""
+    import scipy.sparse as sps
+    indptr, indices, values = _sparse_parts(indptr_mat, indices_mat,
+                                            data_mat, nindptr, nelem)
+    csc = sps.csc_matrix((values, indices, indptr),
+                         shape=(num_row, nindptr - 1))
+    return Dataset(csc, params=_parse_params(parameters),
+                   reference=reference if isinstance(reference, Dataset)
+                   else None, free_raw_data=False)
+
+
 def dataset_set_field(ds: Dataset, field_name: str, vec) -> None:
     arr = np.frombuffer(vec[0], dtype=vec[1])
     if field_name == "label":
@@ -113,6 +159,39 @@ def booster_add_valid(bst: Booster, valid: Dataset) -> None:
 
 def booster_update_one_iter(bst: Booster) -> bool:
     return bool(bst.update())
+
+
+def booster_update_one_iter_custom(bst: Booster, grad_vec, hess_vec) -> bool:
+    """reference LGBM_BoosterUpdateOneIterCustom (c_api.cpp:1698): one
+    boosting step from caller-supplied grad/hess."""
+    grad = np.frombuffer(grad_vec[0], dtype=grad_vec[1]).astype(np.float32)
+    hess = np.frombuffer(hess_vec[0], dtype=hess_vec[1]).astype(np.float32)
+    n = bst._gbdt.train_data.num_data * bst.num_model_per_iteration()
+    if len(grad) != n or len(hess) != n:
+        raise ValueError(f"grad/hess length {len(grad)}/{len(hess)} != "
+                         f"num_data*num_class {n}")
+    with bst._lock.write():
+        return bool(bst._gbdt.train_one_iter(grad, hess))
+
+
+def booster_train_num_data(bst: Booster) -> int:
+    return int(bst._gbdt.train_data.num_data)
+
+
+def booster_num_model_per_iteration(bst: Booster) -> int:
+    return int(bst.num_model_per_iteration())
+
+
+def booster_number_of_total_model(bst: Booster) -> int:
+    return int(bst.num_trees())
+
+
+def booster_get_num_feature(bst: Booster) -> int:
+    return int(bst.num_feature())
+
+
+def booster_reset_parameter(bst: Booster, parameters: str) -> None:
+    bst.reset_parameter(_parse_params(parameters))
 
 
 def booster_rollback_one_iter(bst: Booster) -> None:
@@ -157,6 +236,84 @@ def booster_predict_for_mat(bst: Booster, mat, is_row_major: int,
         kwargs["pred_contrib"] = True
     out = bst.predict(data, num_iteration=num_iteration, **kwargs)
     return np.ascontiguousarray(out, dtype=np.float64).tobytes()
+
+
+def booster_predict_for_csr(bst: Booster, indptr_mat, indices_mat, data_mat,
+                            nindptr: int, nelem: int, num_col: int,
+                            predict_type: int, start_iteration: int,
+                            num_iteration: int, parameter: str) -> bytes:
+    """reference LGBM_BoosterPredictForCSR (c_api.cpp:1857)."""
+    import scipy.sparse as sps
+    indptr, indices, values = _sparse_parts(indptr_mat, indices_mat,
+                                            data_mat, nindptr, nelem)
+    csr = sps.csr_matrix((values, indices, indptr),
+                         shape=(nindptr - 1, num_col))
+    kwargs = {}
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        kwargs["raw_score"] = True
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        kwargs["pred_leaf"] = True
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        kwargs["pred_contrib"] = True
+    out = bst.predict(csr, start_iteration=start_iteration,
+                      num_iteration=num_iteration, **kwargs)
+    return np.ascontiguousarray(out, dtype=np.float64).tobytes()
+
+
+class _FastConfig:
+    """Pre-resolved single-row predict configuration (reference FastConfig,
+    c_api.cpp:398 + LGBM_BoosterPredictForMatSingleRowFastInit)."""
+
+    def __init__(self, bst: Booster, predict_type: int, start_iteration: int,
+                 num_iteration: int, data_type: int, ncol: int,
+                 parameter: str):
+        self.bst = bst
+        self.kwargs = {}
+        if predict_type == C_API_PREDICT_RAW_SCORE:
+            self.kwargs["raw_score"] = True
+        elif predict_type == C_API_PREDICT_LEAF_INDEX:
+            self.kwargs["pred_leaf"] = True
+        elif predict_type == C_API_PREDICT_CONTRIB:
+            self.kwargs["pred_contrib"] = True
+        self.start_iteration = start_iteration
+        self.num_iteration = num_iteration
+        self.data_type = data_type     # read back by the C layer to size
+        self.ncol = ncol               # the per-row buffer correctly
+
+
+def booster_fast_config_init(bst: Booster, predict_type: int,
+                             start_iteration: int, num_iteration: int,
+                             data_type: int, ncol: int,
+                             parameter: str) -> _FastConfig:
+    return _FastConfig(bst, predict_type, start_iteration, num_iteration,
+                       data_type, ncol, parameter)
+
+
+def booster_predict_single_row_fast(cfg: _FastConfig, row_mat) -> bytes:
+    row = np.frombuffer(row_mat[0], dtype=row_mat[1]).astype(
+        np.float64).reshape(1, cfg.ncol)
+    out = cfg.bst.predict(row, start_iteration=cfg.start_iteration,
+                          num_iteration=cfg.num_iteration, **cfg.kwargs)
+    return np.ascontiguousarray(out, dtype=np.float64).tobytes()
+
+
+def network_init(machines: str, local_listen_port: int,
+                 listen_time_out: int, num_machines: int) -> None:
+    """reference LGBM_NetworkInit (c_api.h:1300 / Network::Init): join the
+    jax.distributed cluster using the reference's machine-list convention."""
+    from .config import Config
+    from .parallel.mesh import maybe_init_distributed
+    cfg = Config({"machines": machines, "num_machines": num_machines,
+                  "local_listen_port": local_listen_port,
+                  "time_out": listen_time_out})
+    maybe_init_distributed(cfg)
+
+
+def network_free() -> None:
+    """reference LGBM_NetworkFree: leave the cluster (idempotent; resets the
+    init latch so a later LGBM_NetworkInit can rejoin)."""
+    from .parallel.mesh import shutdown_distributed
+    shutdown_distributed()
 
 
 def booster_save_model(bst: Booster, start_iteration: int,

@@ -59,6 +59,23 @@ class Metadata:
             self.num_queries = 0
 
 
+def _bin_sparse_columns(csc, real_index, mappers) -> np.ndarray:
+    """Bin a CSC matrix's columns touching only the nonzeros: zeros share
+    one precomputed bin per column (reference SparseBin construction).
+    Shared by TrainDataset.from_sparse and bin_external's sparse path."""
+    max_nb = max(m.num_bin for m in mappers)
+    out = np.empty((csc.shape[0], len(mappers)),
+                   np.uint8 if max_nb <= 256 else np.int32)
+    indptr, indices, values = csc.indptr, csc.indices, csc.data
+    for j, (real, m) in enumerate(zip(real_index, mappers)):
+        out[:, j] = m.value_to_bin(np.zeros(1))[0]
+        lo, hi = indptr[real], indptr[real + 1]
+        if hi > lo:
+            out[indices[lo:hi], j] = m.value_to_bin(
+                np.asarray(values[lo:hi], np.float64))
+    return out
+
+
 class TrainDataset:
     """Binned dataset + feature metadata, ready for the device grower."""
 
@@ -200,6 +217,220 @@ class TrainDataset:
         self.num_total_features = num_features
         return self
 
+    @classmethod
+    def from_rank_shard(cls, X_local: np.ndarray, y_local: np.ndarray,
+                        config: Config, categorical_features=None,
+                        weight_local=None,
+                        init_score_local=None) -> "TrainDataset":
+        """Distributed construction: THIS process holds only its row shard
+        (reference distributed loading, dataset_loader.cpp:182 rank-aware
+        row filter, :953,1044-1127 per-rank bin-finding + mapper sync).
+
+        Peak per-rank memory is O(local rows): the global [N, F] matrix is
+        never materialized anywhere.  Cross-rank agreement comes from two
+        small collectives at load time:
+        - bin mappers: each rank contributes a row sample; the allgathered
+          global sample is binned identically everywhere (the reference
+          instead bins feature slices and allgathers BinMappers — same
+          contract, one collective instead of F serializations);
+        - labels/weights: allgathered so the booster's score/gradient
+          arrays (O(N), small next to the O(N*F) matrix) stay global.
+        The global row order is rank-block-major: rank 0's rows, then
+        rank 1's, ...
+        """
+        import jax
+        from jax.experimental import multihost_utils
+        from .parallel.mesh import maybe_init_distributed
+        maybe_init_distributed(config)
+        nproc = jax.process_count()
+        rank = jax.process_index()
+
+        X_local = np.ascontiguousarray(np.asarray(X_local, np.float64))
+        y_local = np.asarray(y_local, np.float32).reshape(-1)
+        ln, num_features = X_local.shape
+        if len(y_local) != ln:
+            raise ValueError(f"label length {len(y_local)} != rows {ln}")
+        if weight_local is not None:
+            weight_local = np.asarray(weight_local, np.float32).reshape(-1)
+            if len(weight_local) != ln:
+                raise ValueError(
+                    f"weight length {len(weight_local)} != local rows {ln} "
+                    "(rank-sharded loading takes RANK-LOCAL weights)")
+        if init_score_local is not None:
+            init_score_local = np.asarray(init_score_local,
+                                          np.float64).reshape(-1)
+            if len(init_score_local) != ln:
+                raise ValueError(
+                    f"init_score length {len(init_score_local)} != local "
+                    f"rows {ln} (rank-sharded loading takes RANK-LOCAL "
+                    "init scores; multi-class init is unsupported here)")
+
+        sizes = np.asarray(multihost_utils.process_allgather(
+            np.asarray([ln], np.int64))).reshape(-1)
+        n_global = int(sizes.sum())
+        max_block = int(sizes.max())
+        row_offset = int(sizes[:rank].sum())
+
+        def allgather_blocks(vec, fill=0.0):
+            """[ln] per-rank -> [N] global in rank-block order."""
+            pad = np.full(max_block - len(vec), fill, vec.dtype)
+            stacked = np.asarray(multihost_utils.process_allgather(
+                np.concatenate([vec, pad])))
+            if nproc == 1:
+                stacked = stacked.reshape(1, -1)
+            return np.concatenate(
+                [stacked[r, :sizes[r]] for r in range(nproc)])
+
+        # ---- mapper sync: sample locally, allgather, bin identically ----
+        total_sample = min(n_global, config.bin_construct_sample_cnt)
+        local_sample_n = min(ln, max(1, total_sample * ln // max(n_global, 1)))
+        rng = np.random.RandomState(config.data_random_seed + rank)
+        pick = np.sort(rng.choice(ln, size=local_sample_n, replace=False))
+        samp = X_local[pick]
+        # pad sample blocks to a common size with NaN (ignored by binning
+        # as missing -> slight overcount of NaN; mark with a count vector)
+        samp_pad = np.full((max_block, num_features), np.nan, np.float64)
+        samp_pad[:local_sample_n] = samp
+        cnts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([local_sample_n], np.int64))).reshape(-1)
+        gathered = np.asarray(multihost_utils.process_allgather(samp_pad))
+        if nproc == 1:
+            gathered = gathered.reshape(1, max_block, num_features)
+        sample = np.concatenate(
+            [gathered[r, :cnts[r]] for r in range(nproc)])
+
+        cats = sorted(set(categorical_features or ()))
+        min_split = (config.min_data_in_leaf
+                     if config.feature_pre_filter else 0)
+        mappers = find_bin_mappers(
+            sample, max_bin=config.max_bin,
+            min_data_in_bin=config.min_data_in_bin,
+            categorical_features=cats, use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            min_split_data=min_split,
+            max_bin_by_feature=config.max_bin_by_feature,
+            feature_pre_filter=config.feature_pre_filter,
+            forced_bins_path=config.forcedbins_filename)
+        del sample, gathered, samp_pad
+
+        # ---- global metadata, local bins ------------------------------
+        label_g = allgather_blocks(y_local)
+        weight_g = (allgather_blocks(weight_local)
+                    if weight_local is not None else None)
+        init_g = (allgather_blocks(init_score_local)
+                  if init_score_local is not None else None)
+        metadata = Metadata(label_g, weight_g, init_score=init_g)
+
+        real_index = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        used = [mappers[i] for i in real_index]
+        if not used:
+            raise ValueError("no usable (non-trivial) features in data")
+        max_nb = max(m.num_bin for m in used)
+        bins = np.empty((ln, len(used)),
+                        np.uint8 if max_nb <= 256 else np.int32)
+        for j, (real, m) in enumerate(zip(real_index, used)):
+            bins[:, j] = m.value_to_bin(X_local[:, real])
+
+        self = cls.__new__(cls)
+        self.config = config
+        self.metadata = metadata
+        self.all_bin_mappers = mappers
+        self.raw_device = None
+        if getattr(config, "linear_tree", False):
+            from .log import log_warning
+            log_warning("linear_tree is not supported with rank-sharded "
+                        "loading; constant leaves will be used")
+        # EFB bundling decisions must agree across ranks; local conflict
+        # counts differ, so bundling is disabled for rank-local datasets
+        # (the reference similarly syncs feature groups at load).
+        self._finish_init_rank_local(bins, mappers, real_index, num_features,
+                                     metadata, n_global, sizes, row_offset)
+        return self
+
+    def _finish_init_rank_local(self, bins, mappers, real_index,
+                                num_features, metadata, n_global, sizes,
+                                row_offset) -> None:
+        """_finish_init wrapper for rank-local bins: num_data is GLOBAL,
+        the bin matrix is LOCAL, EFB is disabled (bundling decisions from
+        local conflict counts would diverge across ranks)."""
+        self.num_total_features = num_features
+        self._finish_init(bins, mappers, real_index, num_features, metadata,
+                          enable_efb=False, place_on_device=False)
+        self.rank_local = True
+        self.num_data = n_global               # override: GLOBAL row count
+        self.local_num_data = bins.shape[0]
+        self.block_sizes = np.asarray(sizes, np.int64)
+        self.row_offset = row_offset
+
+    @classmethod
+    def from_sparse(cls, sp, metadata: Metadata, config: Config,
+                    categorical_features=None) -> "TrainDataset":
+        """Construct from a scipy sparse matrix WITHOUT densifying to float64
+        (reference CSR/CSC ingestion, c_api.cpp LGBM_DatasetCreateFromCSR /
+        dataset_loader.cpp sparse bins).
+
+        The device layout stays a packed dense uint8 bin matrix — the TPU
+        histogram formulation wants it, and at uint8 it is 8x smaller than
+        the float64 dense array the old path materialized.  Sparsity is
+        exploited where it matters: per-column binning touches only the
+        nonzeros (zeros share one precomputed bin), and EFB then collapses
+        mostly-zero columns into shared bundle columns.
+        """
+        csc = sp.tocsc()
+        n, num_features = csc.shape
+        if metadata.num_data != n:
+            raise ValueError(f"label length {metadata.num_data} != rows {n}")
+        cats = sorted(set(categorical_features or ()))
+
+        # ---- bin finding on a row sample, one column BLOCK at a time so
+        # wide sparse matrices never densify across all columns ----------
+        sample_n = min(n, config.bin_construct_sample_cnt)
+        if sample_n < n:
+            rng = np.random.RandomState(config.data_random_seed)
+            pick = np.sort(rng.choice(n, size=sample_n, replace=False))
+            sampled = csc[pick]
+        else:
+            sampled = csc
+        min_split = (config.min_data_in_leaf
+                     if config.feature_pre_filter else 0)
+        col_block = max(1, int(2 ** 28 // max(sample_n, 1)))  # ~2GB f64 cap
+        mappers = []
+        for lo in range(0, num_features, col_block):
+            block = np.asarray(
+                sampled[:, lo:lo + col_block].todense(), np.float64)
+            mappers.extend(find_bin_mappers(
+                block, max_bin=config.max_bin,
+                min_data_in_bin=config.min_data_in_bin,
+                categorical_features=cats, use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing,
+                min_split_data=min_split,
+                max_bin_by_feature=config.max_bin_by_feature,
+                feature_pre_filter=config.feature_pre_filter,
+                forced_bins_path=config.forcedbins_filename,
+                col_offset=lo))
+        del sampled
+
+        # ---- column-wise binning: nonzeros only -------------------------
+        real_index = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        used = [mappers[i] for i in real_index]
+        if not used:
+            raise ValueError("no usable (non-trivial) features in data")
+        bins = _bin_sparse_columns(csc, real_index, used)
+
+        self = cls.__new__(cls)
+        self.config = config
+        self.metadata = metadata
+        self.all_bin_mappers = mappers
+        self.raw_device = None
+        if getattr(config, "linear_tree", False):
+            from .log import log_warning
+            log_warning("linear_tree requires in-memory dense raw data and "
+                        "is disabled for sparse datasets; constant leaves "
+                        "will be used")
+        self._finish_init(bins, mappers, real_index, num_features, metadata)
+        self.num_total_features = num_features
+        return self
+
     def _init_from_binned(self, bins: np.ndarray, bin_mappers,
                           num_total_features: int, metadata: Metadata,
                           config: Config) -> None:
@@ -216,7 +447,9 @@ class TrainDataset:
                           num_total_features, metadata)
 
     def _finish_init(self, bins, bin_mappers, real_feature_index,
-                     num_total_features, metadata) -> None:
+                     num_total_features, metadata,
+                     enable_efb: bool = True,
+                     place_on_device: bool = True) -> None:
         self.real_feature_index = real_feature_index
         self.feature_mappers = [bin_mappers[i] for i in real_feature_index]
         self.num_features = len(real_feature_index)
@@ -238,8 +471,17 @@ class TrainDataset:
         # dataset.cpp:100,239)
         self.bundle_map = None
         self.bundles = None
+        if not place_on_device:
+            self.device_bins = None   # the parallel learner shards it
+            self.label = jnp.asarray(metadata.label)
+            self.weight = (jnp.asarray(metadata.weight)
+                           if metadata.weight is not None else None)
+            self.query_ids = (jnp.asarray(metadata.query_ids)
+                              if metadata.query_ids is not None else None)
+            return
         cfg = self.config
-        if (getattr(cfg, "enable_bundle", True) and self.num_features >= 4):
+        if (enable_efb and getattr(cfg, "enable_bundle", True)
+                and self.num_features >= 4):
             from .efb import find_bundles, make_bundle_map, bundle_rows
             bundles = find_bundles(bins, self.feature_mappers,
                                    self.is_categorical, max_bin=cfg.max_bin)
@@ -265,6 +507,8 @@ class TrainDataset:
     def bin_external(self, data: np.ndarray) -> np.ndarray:
         """Bin new rows with this dataset's mappers (reference
         LoadFromFileAlignWithOtherDataset / _init_from_ref_dataset)."""
+        if hasattr(data, "tocsc") and not isinstance(data, np.ndarray):
+            return self._bin_external_sparse(data)
         data = np.asarray(data, dtype=np.float64)
         if data.ndim != 2 or data.shape[1] != self.num_total_features:
             raise ValueError(
@@ -275,6 +519,19 @@ class TrainDataset:
         for j, real in enumerate(self.real_feature_index):
             out[:, j] = self.feature_mappers[j].value_to_bin(data[:, real])
         return out
+
+    def _bin_external_sparse(self, sp) -> np.ndarray:
+        """Sparse counterpart of bin_external: nonzeros-only column binning
+        (reference LGBM_BoosterPredictForCSR alignment semantics)."""
+        csc = sp.tocsc()
+        if csc.shape[1] != self.num_total_features:
+            raise ValueError(
+                f"input has {csc.shape[1]} features, but the model expects "
+                f"{self.num_total_features} "
+                "(reference: LGBM_BoosterPredictForMat shape check)")
+        return _bin_sparse_columns(csc, self.real_feature_index,
+                                   self.feature_mappers).astype(
+                                       self.bins.dtype, copy=False)
 
     def to_device_space(self, per_feature_bins: np.ndarray) -> np.ndarray:
         """Re-encode a per-feature bin matrix into the device layout
@@ -304,8 +561,11 @@ class ValidDataset:
         self.bins = train.bin_external(data)
         self.device_bins = jnp.asarray(train.to_device_space(self.bins))
         # raw values kept only when linear leaves need them at score-update
-        self.raw = (np.asarray(data, np.float64)
-                    if train.raw_device is not None else None)
+        if train.raw_device is not None:
+            dense = data.toarray() if hasattr(data, "toarray") else data
+            self.raw = np.asarray(dense, np.float64)
+        else:
+            self.raw = None
         self.label = jnp.asarray(metadata.label)
         self.weight = (jnp.asarray(metadata.weight)
                        if metadata.weight is not None else None)

@@ -13,7 +13,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["detect_format", "load_svmlight_or_csv", "LineParser"]
+__all__ = ["detect_format", "load_svmlight_or_csv", "load_rank_shard",
+           "LineParser"]
 
 
 def detect_format(path: str) -> str:
@@ -109,6 +110,26 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
         for k, v in pairs:
             feats[i, k] = v
     return feats, np.asarray(labels, dtype=np.float32)
+
+
+def load_rank_shard(path: str, rank: int, nranks: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stream a data file keeping only rows ``r % nranks == rank``
+    (reference rank-aware loading, dataset_loader.cpp:182 — the
+    pre_partition=false row filter).  Peak memory is O(local rows + one
+    chunk); the full matrix is never held."""
+    xs, ys = [], []
+    base = 0
+    for X, y in LineParser(path):
+        idx = np.arange(base, base + len(y))
+        keep = (idx % nranks) == rank
+        if keep.any():
+            xs.append(np.ascontiguousarray(X[keep]))
+            ys.append(y[keep])
+        base += len(y)
+    if not xs:
+        raise ValueError(f"rank {rank}/{nranks} got no rows from {path}")
+    return np.concatenate(xs, axis=0), np.concatenate(ys)
 
 
 class LineParser:

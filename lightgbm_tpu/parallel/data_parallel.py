@@ -51,16 +51,44 @@ class DataParallelTreeLearner(SerialTreeLearner):
             top_k=int(config.top_k))
 
         n = dataset.num_data
-        self.pad = (-n) % self.n_dev
         self.multiprocess = jax.process_count() > 1
-        bins = np.asarray(dataset.to_device_space(dataset.bins))
-        if self.pad:
-            bins = np.pad(bins, ((0, self.pad), (0, 0)))
+        self.rank_local = bool(getattr(dataset, "rank_local", False))
         row_sharding = NamedSharding(self.mesh, P(self.AXIS, None))
         rep = NamedSharding(self.mesh, P())
         self._row_sharding_1d = NamedSharding(self.mesh, P(self.AXIS))
         self._rep_sharding = rep
-        self.sharded_bins = self._put(jnp.asarray(bins), row_sharding)
+        if self.rank_local:
+            # rank-sharded dataset: this process holds ONLY its row block
+            # (reference distributed loading, dataset_loader.cpp:182).
+            # Global padded layout: nproc equal blocks of n_per rows; pad
+            # rows sit at the END of each rank's block and are masked out
+            # via self._real_idx (gradients scattered in / row_leaf
+            # gathered out through it).
+            nproc = max(jax.process_count(), 1)
+            dev_per_proc = max(self.n_dev // nproc, 1)
+            sizes = dataset.block_sizes
+            n_per = -(-int(sizes.max()) // dev_per_proc) * dev_per_proc
+            self.n_per = n_per
+            self.pad = nproc * n_per - n       # total pad rows (interleaved)
+            local = dataset.bins
+            if local.shape[0] < n_per:
+                local = np.pad(local,
+                               ((0, n_per - local.shape[0]), (0, 0)))
+            self.sharded_bins = jax.make_array_from_process_local_data(
+                row_sharding, local,
+                global_shape=(nproc * n_per, local.shape[1]))
+            # static [N] index of real rows inside the padded layout
+            real_idx = np.concatenate(
+                [r * n_per + np.arange(int(sizes[r])) for r in range(nproc)])
+            self._real_idx = jnp.asarray(real_idx, jnp.int32)
+            self._n_padded = nproc * n_per
+        else:
+            self.pad = (-n) % self.n_dev
+            bins = np.asarray(dataset.to_device_space(dataset.bins))
+            if self.pad:
+                bins = np.pad(bins, ((0, self.pad), (0, 0)))
+            self.sharded_bins = self._put(jnp.asarray(bins), row_sharding)
+            self._real_idx = None
         self.num_bins_rep = self._put(dataset.num_bins_per_feature, rep)
         self.has_missing_rep = self._put(dataset.has_missing_per_feature, rep)
         self._sharded_grow = self._build_sharded_grow()
@@ -121,7 +149,18 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
     def train(self, grad, hess, sample_mask, iteration: int,
               gain_penalty=None):
-        if self.pad:
+        if self.rank_local:
+            # scatter the [N] global vectors into the rank-block padded
+            # layout (every process holds identical global score/grad
+            # arrays — O(N), small next to the O(N*F) matrix it no longer
+            # holds); pad rows stay zero => masked out of every histogram
+            def to_padded(a):
+                return jnp.zeros((self._n_padded,), a.dtype
+                                 ).at[self._real_idx].set(a)
+            grad = to_padded(grad)
+            hess = to_padded(hess)
+            sample_mask = to_padded(sample_mask)
+        elif self.pad:
             z = jnp.zeros((self.pad,), grad.dtype)
             grad = jnp.concatenate([grad, z])
             hess = jnp.concatenate([hess, z])
@@ -152,7 +191,10 @@ class DataParallelTreeLearner(SerialTreeLearner):
             # with its (non-mesh) score arrays
             state = jax.tree_util.tree_map(
                 lambda x: jnp.asarray(jax.device_get(x)), state)
-        if self.pad:
+        if self.rank_local:
+            # padded rank-block layout -> [N] global real rows
+            state = state._replace(row_leaf=state.row_leaf[self._real_idx])
+        elif self.pad:
             state = state._replace(row_leaf=state.row_leaf[:self.dataset.num_data])
         return state
 

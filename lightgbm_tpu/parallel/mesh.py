@@ -17,9 +17,20 @@ from jax.sharding import Mesh
 
 from ..log import log_info, log_warning
 
-__all__ = ["build_mesh", "maybe_init_distributed"]
+__all__ = ["build_mesh", "maybe_init_distributed", "shutdown_distributed"]
 
 _initialized = False
+
+
+def shutdown_distributed() -> None:
+    """Leave the cluster and allow a later re-init (reference
+    Network::Dispose / LGBM_NetworkFree).  Idempotent."""
+    global _initialized
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    _initialized = False
 
 
 def _local_ips() -> set:

@@ -95,6 +95,132 @@ def test_c_api_train_predict_roundtrip(lib, tmp_path):
     _check(lib, lib.LGBM_DatasetFree(ds))
 
 
+def test_c_api_train_from_csr_end_to_end(lib):
+    """CSR ingestion -> train -> PredictForCSR -> single-row FastConfig
+    (reference c_api.h:92 LGBM_DatasetCreateFromCSR, :784 PredictForCSR,
+    :922 SingleRowFastInit)."""
+    import scipy.sparse as sps
+    rng = np.random.RandomState(1)
+    n, f = 3000, 12
+    dense = rng.randn(n, f) * (rng.rand(n, f) < 0.3)   # ~70% zeros
+    y = (dense[:, 0] + dense[:, 1] > 0).astype(np.float32)
+    csr = sps.csr_matrix(dense)
+
+    indptr = np.ascontiguousarray(csr.indptr, np.int32)
+    indices = np.ascontiguousarray(csr.indices, np.int32)
+    values = np.ascontiguousarray(csr.data, np.float64)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),  # int32
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),  # float64
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+        ctypes.c_int64(f), b"max_bin=63", None, ctypes.byref(ds)))
+
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))
+    nd = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(nd)))
+    assert nd.value == n
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(10):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    ntot = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(ntot)))
+    assert ntot.value == 10
+    nf = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetNumFeature(bst, ctypes.byref(nf)))
+    assert nf.value == f
+
+    # predict through the CSR path
+    out = np.zeros(n, dtype=np.float64)
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(2),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(values)),
+        ctypes.c_int64(f), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(-1), b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, out) > 0.9
+
+    # single-row fast path agrees with the bulk path
+    cfgh = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+        bst, ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1),
+        ctypes.c_int(1), ctypes.c_int32(f), b"", ctypes.byref(cfgh)))
+    row = np.ascontiguousarray(dense[7], np.float64)
+    rout = np.zeros(1, np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFast(
+        cfgh, row.ctypes.data_as(ctypes.c_void_p), ctypes.byref(out_len),
+        rout.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(rout[0], out[7], rtol=1e-9)
+    _check(lib, lib.LGBM_FastConfigFree(cfgh))
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_update_custom_and_reset(lib):
+    """LGBM_BoosterUpdateOneIterCustom drives boosting with caller grad/hess
+    (reference c_api.h:564) and ResetParameter changes the learning rate."""
+    rng = np.random.RandomState(2)
+    n, f = 1500, 4
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xc = np.ascontiguousarray(X, np.float64)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        b"max_bin=63", None, ctypes.byref(ds)))
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)))
+    _check(lib, lib.LGBM_BoosterResetParameter(bst, b"learning_rate=0.2"))
+
+    fin = ctypes.c_int()
+    score = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    for _ in range(5):
+        # logistic grad/hess from the current raw score (custom objective)
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = np.ascontiguousarray(p - y, np.float32)
+        hess = np.ascontiguousarray(p * (1 - p), np.float32)
+        _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(fin)))
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+            ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+            ctypes.c_int(1), ctypes.c_int(0), ctypes.c_int(-1), b"",
+            ctypes.byref(out_len),
+            score.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, score) > 0.9
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
 def test_c_api_error_reporting(lib):
     ds = ctypes.c_void_p()
     rc = lib.LGBM_DatasetCreateFromFile(b"/nonexistent/file.csv", b"", None,

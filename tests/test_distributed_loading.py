@@ -1,0 +1,158 @@
+"""Distributed / rank-sharded data loading.
+
+Reference: DatasetLoader's rank-aware loading (dataset_loader.cpp:182),
+distributed bin-finding with mapper sync (:953,1044-1127).  Criteria from
+the round-4 review: each process materializes only its row shard, parity
+holds with centralized training, per-rank peak memory ~N/nranks.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import TrainDataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_csv(path, X, y):
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.7g")
+
+
+def _task(n=4000, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.6 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20, "tree_learner": "data",
+          "num_machines": 2, "num_tpu_devices": 8}
+
+
+def test_rank_shard_file_loader():
+    """load_rank_shard partitions rows round-robin and disjointly."""
+    from lightgbm_tpu.io.parser import load_rank_shard
+    X, y = _task(101, 4)
+    path = "/tmp/_lgbtpu_shard_test.csv"
+    _write_csv(path, X, y)
+    X0, y0 = load_rank_shard(path, 0, 2)
+    X1, y1 = load_rank_shard(path, 1, 2)
+    assert len(y0) == 51 and len(y1) == 50
+    np.testing.assert_allclose(
+        np.sort(np.concatenate([y0, y1])), np.sort(y), rtol=1e-6)
+    np.testing.assert_allclose(X0, X[0::2], rtol=1e-5)
+    np.testing.assert_allclose(X1, X[1::2], rtol=1e-5)
+
+
+def test_rank_local_dataset_single_process_parity(tmp_path):
+    """File loading through the rank-sharded path (1 process, virtual mesh)
+    trains to the same quality as the plain serial path, and the dataset
+    handle holds only the local (here: all) rows without EFB/global dup."""
+    X, y = _task()
+    path = str(tmp_path / "train.csv")
+    _write_csv(path, X, y)
+
+    ds = lgb.Dataset(path, params=PARAMS)
+    bst = lgb.train(PARAMS, ds, num_boost_round=8)
+    assert getattr(ds._handle, "rank_local", False)
+    assert ds._handle.bins.shape[0] == len(y)   # 1 process -> full shard
+
+    serial = lgb.train({k: v for k, v in PARAMS.items()
+                        if k not in ("tree_learner", "num_machines",
+                                     "num_tpu_devices")},
+                       lgb.Dataset(X, y), num_boost_round=8)
+    from sklearn.metrics import roc_auc_score
+    auc_d = roc_auc_score(y, bst.predict(X))
+    auc_s = roc_auc_score(y, serial.predict(X))
+    assert abs(auc_d - auc_s) < 0.02, (auc_d, auc_s)
+
+
+def test_pre_partition_arrays_single_process(tmp_path):
+    """pre_partition=true: in-memory arrays are taken as this rank's shard."""
+    X, y = _task()
+    params = dict(PARAMS, pre_partition=True)
+    ds = lgb.Dataset(X, y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=8)
+    assert getattr(ds._handle, "rank_local", False)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.85
+
+
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import lightgbm_tpu as lgb
+
+rank = int(os.environ["LIGHTGBM_TPU_RANK"])
+params = {{"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20, "tree_learner": "data",
+          "num_machines": 2, "time_out": 60,
+          "machines": "127.0.0.1:24456,127.0.0.1:24457",
+          "local_listen_port": 24456 + rank}}
+ds = lgb.Dataset({csv!r}, params=params)
+bst = lgb.train(params, ds, num_boost_round=8)
+h = ds._handle
+assert getattr(h, "rank_local", False)
+# THE memory-scaling criterion: this process binned only ~half the rows
+assert h.bins.shape[0] <= (h.num_data + 1) // 2, (h.bins.shape, h.num_data)
+if rank == 0:
+    np.save({out!r}, bst.predict(np.load({csv_x!r})))
+print("WORKER_DONE", rank, h.bins.shape[0], h.num_data, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_rank_sharded_parity(tmp_path):
+    """Each of 2 processes loads only its half of the file; the distributed
+    model matches centralized accuracy (reference DistributedMockup +
+    pre_partition=false semantics)."""
+    X, y = _task()
+    csv = str(tmp_path / "train.csv")
+    _write_csv(csv, X, y)
+    csv_x = str(tmp_path / "x.npy")
+    np.save(csv_x, X)
+    out = str(tmp_path / "pred.npy")
+    sp = str(tmp_path / "worker.py")
+    with open(sp, "w") as fh:
+        fh.write(_WORKER.format(repo=REPO, csv=csv, out=out, csv_x=csv_x))
+
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith("JAX_")}
+    env_base["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    for rank in range(2):
+        env = dict(env_base)
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, sp], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(stdout)
+    for rank, (p, text) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{text[-3000:]}"
+        assert "WORKER_DONE" in text
+
+    central = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "min_data_in_leaf": 20},
+                        lgb.Dataset(X, y), num_boost_round=8)
+    from sklearn.metrics import roc_auc_score
+    auc_c = roc_auc_score(y, central.predict(X))
+    auc_d = roc_auc_score(y, np.load(out))
+    assert abs(auc_c - auc_d) < 0.02, (auc_c, auc_d)
