@@ -100,10 +100,11 @@ PyObject* call_api(const char* fn, PyObject* args) {
   return out;
 }
 
-// 1-D/2-D float64 numpy-compatible memoryview over caller memory (copied
-// python-side before any lazy use, mirroring the reference's copy-on-push).
-PyObject* make_matrix(const void* data, int data_type, int32_t nrow,
-                      int32_t ncol) {
+// 1-D/2-D numpy-compatible payload over caller memory (copied python-side
+// before any lazy use, mirroring the reference's copy-on-push).  nrow is
+// 64-bit: CSR element counts can exceed 2^31 at TPU scale.
+PyObject* make_matrix(const void* data, int data_type, int64_t nrow,
+                      int64_t ncol) {
   // build a bytes object + shape/dtype; capi_impl reconstructs np.ndarray
   const char* dtype;
   size_t esize;
@@ -119,7 +120,9 @@ PyObject* make_matrix(const void* data, int data_type, int32_t nrow,
   PyObject* payload = PyBytes_FromStringAndSize(
       static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
   if (payload == nullptr) return nullptr;
-  PyObject* out = Py_BuildValue("(Nsii)", payload, dtype, nrow, ncol);
+  PyObject* out = Py_BuildValue("(NsLL)", payload, dtype,
+                                static_cast<long long>(nrow),
+                                static_cast<long long>(ncol));
   return out;
 }
 
@@ -226,12 +229,9 @@ namespace {
 PyObject* make_sparse_parts(const void* indptr, int indptr_type,
                             const int32_t* indices, const void* data,
                             int data_type, int64_t nindptr, int64_t nelem) {
-  PyObject* p_indptr = make_matrix(
-      indptr, indptr_type, static_cast<int32_t>(nindptr), 1);
-  PyObject* p_indices = make_matrix(
-      indices, 2 /* int32 */, static_cast<int32_t>(nelem), 1);
-  PyObject* p_data = make_matrix(
-      data, data_type, static_cast<int32_t>(nelem), 1);
+  PyObject* p_indptr = make_matrix(indptr, indptr_type, nindptr, 1);
+  PyObject* p_indices = make_matrix(indices, 2 /* int32 */, nelem, 1);
+  PyObject* p_data = make_matrix(data, data_type, nelem, 1);
   if (p_indptr == nullptr || p_indices == nullptr || p_data == nullptr) {
     Py_XDECREF(p_indptr);
     Py_XDECREF(p_indices);
@@ -359,7 +359,7 @@ LGBM_EXPORT int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
   if (run_simple("booster_train_num_data", dargs, &dres) != 0) return -1;
   long n = PyLong_AsLong(dres);
   Py_DECREF(dres);
-  int32_t len = static_cast<int32_t>(n * k);
+  int64_t len = static_cast<int64_t>(n) * static_cast<int64_t>(k);
   PyObject* g = make_matrix(grad, 0 /* float32 */, len, 1);
   PyObject* h = make_matrix(hess, 0 /* float32 */, len, 1);
   if (g == nullptr || h == nullptr) {

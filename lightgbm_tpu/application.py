@@ -81,16 +81,31 @@ class Application:
 
     # ------------------------------------------------------------------
     def _load_xy(self, path: str):
-        from .io.parser import load_svmlight_or_csv
+        from .io.parser import detect_format, load_svmlight_or_csv
         label_idx = 0
+        header = bool(self.config.header)
         lc = str(self.config.label_column)
         if lc and lc not in ("", "auto"):
             if lc.startswith("name:"):
-                raise NotImplementedError("label_column=name: needs header "
-                                          "ingestion; use column index")
-            label_idx = int(lc)
-        X, y = load_svmlight_or_csv(path, label_idx=label_idx,
-                                    header=bool(self.config.header))
+                # reference label_column=name:LABEL (config.h, requires
+                # header=true): resolve the column index from the header row
+                name = lc[len("name:"):]
+                fmt = detect_format(path)
+                if fmt == "libsvm":
+                    raise ValueError("label_column=name: requires a CSV/TSV "
+                                     "file with a header row")
+                sep = "\t" if fmt == "tsv" else ","
+                with open(path) as fh:
+                    cols = [c.strip() for c in
+                            fh.readline().rstrip("\n").split(sep)]
+                if name not in cols:
+                    raise ValueError(
+                        f"label column {name!r} not found in header {cols}")
+                label_idx = cols.index(name)
+                header = True
+            else:
+                label_idx = int(lc)
+        X, y = load_svmlight_or_csv(path, label_idx=label_idx, header=header)
         return X, y
 
     def _build_dataset(self, path: str):

@@ -132,7 +132,10 @@ class Dataset:
                         "rank-sharded file loading takes labels from the "
                         "file's label column")
             else:
-                X_local = _to_2d_numpy(data)
+                if hasattr(data, "tocsc") and not isinstance(data, np.ndarray):
+                    X_local = data      # from_rank_shard bins sparse shards
+                else:
+                    X_local = _to_2d_numpy(data)
                 y_local = np.asarray(self.label, np.float32)
             cats = self._resolve_categoricals(X_local.shape[1])
             self._handle = TrainDataset.from_rank_shard(
@@ -159,15 +162,7 @@ class Dataset:
             # two_round semantics, basic.py:608, utils/pipeline_reader.h)
             seqs = [data] if isinstance(data, Sequence) else list(data)
             n = int(sum(len(s) for s in seqs))
-            label = self.label if self.label is not None else np.zeros(
-                n, np.float32)
-            meta = Metadata(np.asarray(label),
-                            None if self.weight is None
-                            else np.asarray(self.weight),
-                            np.asarray(self.group)
-                            if self.group is not None else None,
-                            None if self.init_score is None
-                            else np.asarray(self.init_score))
+            meta = self._make_metadata(n)
             cfg = Config(self.params)
             cats = self._resolve_categoricals(0)
             self._handle = TrainDataset.from_sequences(
@@ -180,16 +175,7 @@ class Dataset:
             # scipy sparse: bin columns from the nonzeros; the dense float64
             # matrix is never materialized (reference CSR/CSC ingestion,
             # c_api.cpp LGBM_DatasetCreateFromCSR)
-            n = data.shape[0]
-            label = self.label if self.label is not None else np.zeros(
-                n, np.float32)
-            meta = Metadata(np.asarray(label),
-                            None if self.weight is None
-                            else np.asarray(self.weight),
-                            np.asarray(self.group)
-                            if self.group is not None else None,
-                            None if self.init_score is None
-                            else np.asarray(self.init_score))
+            meta = self._make_metadata(data.shape[0])
             cfg = Config(self.params)
             cats = self._resolve_categoricals(data.shape[1])
             if self.reference is not None:
@@ -224,6 +210,19 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _make_metadata(self, n: int) -> Metadata:
+        """Metadata from the user-supplied label/weight/group/init_score
+        (zero labels when none given), for the streaming/sparse paths."""
+        label = self.label if self.label is not None else np.zeros(
+            n, np.float32)
+        return Metadata(np.asarray(label),
+                        None if self.weight is None
+                        else np.asarray(self.weight),
+                        np.asarray(self.group)
+                        if self.group is not None else None,
+                        None if self.init_score is None
+                        else np.asarray(self.init_score))
 
     def _slice(self, x):
         if x is None:

@@ -245,7 +245,12 @@ class TrainDataset:
         nproc = jax.process_count()
         rank = jax.process_index()
 
-        X_local = np.ascontiguousarray(np.asarray(X_local, np.float64))
+        is_sparse = (hasattr(X_local, "tocsc")
+                     and not isinstance(X_local, np.ndarray))
+        if is_sparse:
+            X_local = X_local.tocsr()
+        else:
+            X_local = np.ascontiguousarray(np.asarray(X_local, np.float64))
         y_local = np.asarray(y_local, np.float32).reshape(-1)
         ln, num_features = X_local.shape
         if len(y_local) != ln:
@@ -286,7 +291,11 @@ class TrainDataset:
         local_sample_n = min(ln, max(1, total_sample * ln // max(n_global, 1)))
         rng = np.random.RandomState(config.data_random_seed + rank)
         pick = np.sort(rng.choice(ln, size=local_sample_n, replace=False))
-        samp = X_local[pick]
+        # the sample allgather ships dense [rows, F] blocks; rows are
+        # bounded by bin_construct_sample_cnt/nranks, so a sparse shard
+        # densifies only its sample here, never its full matrix
+        samp = (np.asarray(X_local[pick].todense(), np.float64)
+                if is_sparse else X_local[pick])
         # pad sample blocks to a common size with NaN (ignored by binning
         # as missing -> slight overcount of NaN; mark with a count vector)
         samp_pad = np.full((max_block, num_features), np.nan, np.float64)
@@ -325,11 +334,14 @@ class TrainDataset:
         used = [mappers[i] for i in real_index]
         if not used:
             raise ValueError("no usable (non-trivial) features in data")
-        max_nb = max(m.num_bin for m in used)
-        bins = np.empty((ln, len(used)),
-                        np.uint8 if max_nb <= 256 else np.int32)
-        for j, (real, m) in enumerate(zip(real_index, used)):
-            bins[:, j] = m.value_to_bin(X_local[:, real])
+        if is_sparse:
+            bins = _bin_sparse_columns(X_local.tocsc(), real_index, used)
+        else:
+            max_nb = max(m.num_bin for m in used)
+            bins = np.empty((ln, len(used)),
+                            np.uint8 if max_nb <= 256 else np.int32)
+            for j, (real, m) in enumerate(zip(real_index, used)):
+                bins[:, j] = m.value_to_bin(X_local[:, real])
 
         self = cls.__new__(cls)
         self.config = config

@@ -136,10 +136,15 @@ class LineParser:
     """Streaming row parser for chunked loading (two_round / Sequence path;
     reference utils/pipeline_reader.h + TextReader)."""
 
-    def __init__(self, path: str, chunk_rows: int = 65536):
+    def __init__(self, path: str, chunk_rows: int = 65536,
+                 header: Optional[bool] = None):
         self.path = path
         self.fmt = detect_format(path)
         self.chunk_rows = chunk_rows
+        if header is None and self.fmt != "libsvm":
+            sep = "\t" if self.fmt == "tsv" else ","
+            header = _has_header(path, sep)
+        self.header = bool(header)
 
     def __iter__(self):
         if self.fmt == "libsvm":
@@ -149,7 +154,8 @@ class LineParser:
             return
         sep = "\t" if self.fmt == "tsv" else ","
         import pandas as pd
-        for chunk in pd.read_csv(self.path, sep=sep, header=None,
+        for chunk in pd.read_csv(self.path, sep=sep,
+                                 header=0 if self.header else None,
                                  chunksize=self.chunk_rows):
             arr = chunk.to_numpy(dtype=np.float64)
             yield np.ascontiguousarray(arr[:, 1:]), arr[:, 0].astype(np.float32)

@@ -205,21 +205,31 @@ def _weighted_tie_aware_auc(score, is_pos, w):
 
 class AucMuMetric(Metric):
     """Multiclass AUC-mu (reference multiclass_metric.hpp AucMuMetric,
-    Kleiman & Page): mean over class pairs (a, b) of the tie-aware AUC that
-    ranks class-a rows above class-b rows by the score difference
-    s_a - s_b.  auc_mu_weights' off-diagonal entries scale the pairwise
-    decision direction in the reference; only the default (uniform)
-    weighting is implemented — a non-default matrix raises."""
+    Kleiman & Page): mean over class pairs (a, b) of the tie-aware AUC of
+    the partition-induced score.  With a custom ``auc_mu_weights`` matrix W
+    the pair (a, b) ranks rows by ``t1 * (curr_v . score_row)`` with
+    ``curr_v[m] = W[a][m] - W[b][m]`` and ``t1 = curr_v[a] - curr_v[b]``
+    (multiclass_metric.hpp:246-266); the default W (0 diagonal, 1
+    elsewhere) reduces this to the score difference s_a - s_b."""
     name = "auc_mu"
     is_higher_better = True
 
+    def _weight_matrix(self, k: int) -> np.ndarray:
+        raw = getattr(self.config, "auc_mu_weights", None)
+        if not raw:
+            return np.ones((k, k)) - np.eye(k)
+        w = np.asarray([float(x) for x in raw], np.float64)
+        if w.size != k * k:
+            raise ValueError(
+                f"auc_mu_weights must have num_class^2={k * k} entries, "
+                f"got {w.size} (reference config.cpp auc_mu_weights check)")
+        return w.reshape(k, k)
+
     def eval(self, raw_score, label, weight, objective, query_info=None):
-        if getattr(self.config, "auc_mu_weights", None):
-            raise NotImplementedError(
-                "custom auc_mu_weights are not supported yet")
         p = _as_np(raw_score)                       # [K, N]
         y = _as_np(label).astype(np.int64)
         k = p.shape[0]
+        W = self._weight_matrix(k)
         w = (_as_np(weight) if weight is not None
              else np.ones(p.shape[1]))
         total, cnt = 0.0, 0
@@ -228,7 +238,9 @@ class AucMuMetric(Metric):
                 sel = (y == a) | (y == b)
                 if not sel.any():
                     continue
-                s = p[a, sel] - p[b, sel]
+                curr_v = W[a] - W[b]                # [K]
+                t1 = curr_v[a] - curr_v[b]
+                s = t1 * (curr_v @ p[:, sel])
                 total += _weighted_tie_aware_auc(s, y[sel] == a, w[sel])
                 cnt += 1
         return [(self.name, total / max(cnt, 1), True)]

@@ -40,10 +40,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
         if self.forced is not None:
-            from ..log import log_warning as warning
-            warning("forcedsplits_filename is not supported by parallel "
-                    "tree learners; ignoring forced splits")
-            self.forced = None
+            # fatal, matching the reference (config.cpp:317-319
+            # "Don't support forcedsplits in data/voting tree learner")
+            raise ValueError(
+                f"forcedsplits are not supported with "
+                f"tree_learner={config.tree_learner} "
+                "(reference config.cpp:317); use serial or feature")
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
         self.grower_cfg = self.grower_cfg._replace(

@@ -38,28 +38,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
-        if self.forced is not None:
-            from ..log import log_warning as warning
-            warning("forcedsplits_filename is not supported by parallel "
-                    "tree learners; ignoring forced splits")
-            self.forced = None
         if config.grow_strategy != "compact":
             raise ValueError("tree_learner=feature requires "
                              "grow_strategy=compact")
-        if config.interaction_constraints:
-            raise ValueError("interaction_constraints are not supported "
-                             "with tree_learner=feature (feature-sharded "
-                             "scan); use data or voting parallel")
-        if config.monotone_constraints and any(config.monotone_constraints):
-            raise ValueError("monotone_constraints are not supported with "
-                             "tree_learner=feature (bound bookkeeping "
-                             "needs the global constraint vector); use "
-                             "data or voting parallel")
-        if config.feature_contri or config.cegb_penalty_feature_coupled \
-                or config.cegb_penalty_split > 0:
-            raise ValueError("feature_contri / CEGB are not supported with "
-                             "tree_learner=feature; use data or voting "
-                             "parallel")
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
         # feature-parallel scans per-feature histograms directly; EFB's
@@ -70,19 +51,22 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
         f = dataset.num_features
         self.fpad = (-f) % self.n_dev
+        fp = f + self.fpad
+
+        def _padf(vec, value=0):
+            vec = np.asarray(vec)
+            return (np.pad(vec, (0, self.fpad), constant_values=value)
+                    if self.fpad else vec)
+
         bins = dataset.bins
-        nbf = np.asarray(dataset.num_bins_per_feature)
-        hmf = np.asarray(dataset.has_missing_per_feature)
-        icf = dataset.is_categorical.astype(bool)
-        mono = np.asarray(self.monotone)
+        # padded pseudo-features get 2 bins and never win (mask False)
+        nbf = _padf(dataset.num_bins_per_feature, 2)
+        hmf = _padf(dataset.has_missing_per_feature)
+        icf = _padf(dataset.is_categorical.astype(bool))
+        mono = _padf(self.monotone)
         if self.fpad:
             bins = np.pad(bins, ((0, 0), (0, self.fpad)))
-            # padded pseudo-features get 2 bins and never win (mask False)
-            nbf = np.pad(nbf, (0, self.fpad), constant_values=2)
-            hmf = np.pad(hmf, (0, self.fpad))
-            icf = np.pad(icf, (0, self.fpad))
-            mono = np.pad(mono, (0, self.fpad))
-        self._fpadded = f + self.fpad
+        self._fpadded = fp
         col_sharding = NamedSharding(self.mesh, P(None, self.AXIS))
         fshard = NamedSharding(self.mesh, P(self.AXIS))
         rep = NamedSharding(self.mesh, P())
@@ -90,7 +74,22 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         self.num_bins_sh = jax.device_put(jnp.asarray(nbf), fshard)
         self.has_missing_sh = jax.device_put(jnp.asarray(hmf), fshard)
         self.is_cat_sh = jax.device_put(jnp.asarray(icf), fshard)
+        # per-feature SCAN vectors ride sharded; bookkeeping uses replicated
+        # GLOBAL copies indexed by the agreed winning feature (the reference
+        # shares the serial learner's constraint state in every parallel
+        # learner, so all constraint types stay supported here)
         self.mono_sh = jax.device_put(jnp.asarray(mono), fshard)
+        self.mono_global = jax.device_put(jnp.asarray(mono), rep)
+        self.igroups_global = None
+        if self.igroups is not None:
+            ig = np.asarray(self.igroups)
+            if self.fpad:
+                ig = np.pad(ig, ((0, 0), (0, self.fpad)))
+            self.igroups_global = jax.device_put(jnp.asarray(ig), rep)
+        self.gain_scale_sh = None
+        if self.gain_scale is not None:
+            self.gain_scale_sh = jax.device_put(
+                jnp.asarray(_padf(np.asarray(self.gain_scale), 1.0)), fshard)
         self._fshard = fshard
         self._rep = rep
         self._sharded_grow = self._build_sharded_grow()
@@ -107,26 +106,35 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         from ..tree_learner import TreeState, grow_tree_compact
 
         out_specs = TreeState(**{name: P() for name in TreeState._fields})
+        forced = self.forced   # closed over: constant across iterations
 
         @jax.jit
         @functools.partial(
             shard_map, mesh=self.mesh,
             in_specs=(P(None, ax), P(), P(), P(),        # bins, g, h, mask
-                      P(ax), P(ax), P(ax), P(ax), P(), P(ax)),
+                      P(ax), P(ax), P(ax), P(ax), P(), P(ax),
+                      P(), P(ax), P(ax), P()),  # igroups_g, gscale, gpen, mono_g
             out_specs=out_specs,
             check_vma=False)
-        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf):
+        def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
+                    igroups_g, gscale, gpen, mono_g):
             return grow_tree_compact(cfg, bins, grad, hess, mask, nbf, hmf,
-                                     fmask, mono, key, icf, None)
+                                     fmask, mono, key, icf, None,
+                                     igroups=igroups_g, gain_scale_f=gscale,
+                                     gain_penalty_f=gpen, forced=forced,
+                                     mono_global=mono_g)
 
         return sharded
 
     def train(self, grad, hess, sample_mask, iteration: int,
               gain_penalty=None):
-        if gain_penalty is not None:
-            raise ValueError("CEGB is not supported with "
-                             "tree_learner=feature")
         key = self.iter_key(iteration)
+        gpen_sh = None
+        if gain_penalty is not None:
+            gp = np.asarray(gain_penalty)
+            if self.fpad:
+                gp = np.pad(gp, (0, self.fpad))
+            gpen_sh = jax.device_put(jnp.asarray(gp), self._fshard)
         return self._sharded_grow(
             self.sharded_bins,
             jax.device_put(grad, self._rep),
@@ -136,4 +144,6 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             jax.device_put(self.feature_mask(), self._fshard),
             self.mono_sh,
             jax.device_put(key, self._rep),
-            self.is_cat_sh)
+            self.is_cat_sh,
+            self.igroups_global, self.gain_scale_sh, gpen_sh,
+            self.mono_global)

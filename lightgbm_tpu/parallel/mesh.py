@@ -101,7 +101,9 @@ def maybe_init_distributed(config) -> bool:
             coordinator_address=coordinator,
             num_processes=config.num_machines,
             process_id=rank,
-            initialization_timeout=config.time_out)
+            # reference time_out is in MINUTES (config.h "socket time-out in
+            # minutes"); jax's initialization_timeout is seconds
+            initialization_timeout=config.time_out * 60)
     except RuntimeError as e:
         if "before" in str(e):
             log_warning(

@@ -213,8 +213,9 @@ def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
                          bmap: Optional[BundleMap]) -> SplitResult:
     """Gather split sums at a forced (feature, threshold-bin) from the leaf's
     pooled histogram — reference GatherInfoForThresholdNumerical
-    (feature_histogram.hpp:518-546).  The missing direction is chosen by
-    gain, like the normal double scan."""
+    (feature_histogram.hpp:546-632): the right side accumulates bins above
+    the threshold EXCLUDING the missing bin, left = parent - right (missing
+    lands left; ``output->default_left = true`` unconditionally)."""
     if cfg.use_efb:
         hist = expand_bundle_hist(pool_hist, sums, bmap, num_bins_f,
                                   cfg.num_bins)
@@ -226,31 +227,22 @@ def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
     nb = num_bins_f[f_feat]
     has_na = has_missing_f[f_feat]
     is_missing_bin = has_na & (binv == nb - 1)
-    base_left = (binv <= f_thr) & (binv < nb) & ~is_missing_bin
-    left_nm = (h * base_left[:, None].astype(h.dtype)).sum(axis=0)
-    miss = (h * is_missing_bin[:, None].astype(h.dtype)).sum(axis=0)
+    right_sel = (binv > f_thr) & (binv < nb) & ~is_missing_bin
+    right = (h * right_sel[:, None].astype(h.dtype)).sum(axis=0)
+    left = sums - right
     l1, l2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
     parent_gain = leaf_gain(sums[0], sums[1], l1, l2, mds)
-
-    def side_gain(left):
-        right = sums - left
-        g = (leaf_gain(left[0], left[1], l1, l2, mds)
-             + leaf_gain(right[0], right[1], l1, l2, mds)
-             - parent_gain - cfg.min_gain_to_split)
-        ok = ((left[2] > 0) & (right[2] > 0)
-              & (left[1] > cfg.min_sum_hessian_in_leaf)
-              & (right[1] > cfg.min_sum_hessian_in_leaf))
-        return jnp.where(ok, g, _NEG_INF), right
-
-    gain_l, right_l = side_gain(left_nm + miss)
-    gain_r, right_r = side_gain(left_nm)
-    dleft = has_na & (gain_l >= gain_r)
-    gain = jnp.where(dleft, gain_l, gain_r)
-    left = jnp.where(dleft, left_nm + miss, left_nm)
-    right = jnp.where(dleft, right_l, right_r)
+    gain = (leaf_gain(left[0], left[1], l1, l2, mds)
+            + leaf_gain(right[0], right[1], l1, l2, mds)
+            - parent_gain - cfg.min_gain_to_split)
+    ok = ((left[2] > 0) & (right[2] > 0)
+          & (left[1] > cfg.min_sum_hessian_in_leaf)
+          & (right[1] > cfg.min_sum_hessian_in_leaf))
+    gain = jnp.where(ok, gain, _NEG_INF)
     return SplitResult(
         gain=gain.astype(sums.dtype),
-        feature=f_feat, threshold_bin=f_thr, default_left=dleft,
+        feature=f_feat, threshold_bin=f_thr,
+        default_left=jnp.asarray(True),
         left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
         right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
         left_output=leaf_output(left[0], left[1], l1, l2, mds),
@@ -454,6 +446,40 @@ def _apply_split_bookkeeping(state: TreeState, best_leaf, gain, feat, thr,
             .at[best_leaf].set(state.best_left[best_leaf])
             .at[new_leaf].set(state.best_right[best_leaf]),
     )
+
+
+def _recompute_monotone_bounds(node_mono, in_left, in_right, leaf_value,
+                               n_leaves, L):
+    """Dense recompute of every leaf's [lo, hi] monotone bound from the
+    CURRENT leaf outputs (reference IntermediateLeafConstraints'
+    leaves-to-update machinery, monotone_constraints.hpp:514-720).
+
+    TPU reformulation: instead of recursively walking the tree to find the
+    contiguous leaves whose constraints reference a changed output, bound
+    every left-subtree leaf of a monotone node by the extremum over the
+    node's WHOLE right subtree (and vice versa).  This is at least as tight
+    as the reference's contiguity-filtered bound, so monotonicity still
+    holds; it is one [L-1, L] masked reduction instead of a recursion.
+    """
+    inf = jnp.asarray(jnp.inf, leaf_value.dtype)
+    alive = (jnp.arange(leaf_value.shape[0]) < n_leaves)[None, :]
+    nvalid = (jnp.arange(node_mono.shape[0]) < n_leaves - 1)
+    lv = leaf_value[None, :]
+    right_min = jnp.where(in_right & alive, lv, inf).min(axis=1)    # [L-1]
+    right_max = jnp.where(in_right & alive, lv, -inf).max(axis=1)
+    left_min = jnp.where(in_left & alive, lv, inf).min(axis=1)
+    left_max = jnp.where(in_left & alive, lv, -inf).max(axis=1)
+    pos = (node_mono > 0) & nvalid
+    neg = (node_mono < 0) & nvalid
+    # mono+: left leaves capped by the right side's minimum, right leaves
+    # floored by the left side's maximum; mono-: mirrored
+    hi = jnp.minimum(
+        jnp.where(pos[:, None] & in_left, right_min[:, None], inf).min(0),
+        jnp.where(neg[:, None] & in_right, left_min[:, None], inf).min(0))
+    lo = jnp.maximum(
+        jnp.where(pos[:, None] & in_right, left_max[:, None], -inf).max(0),
+        jnp.where(neg[:, None] & in_left, right_max[:, None], -inf).max(0))
+    return lo, hi
 
 
 def _store_best(state: TreeState, leaf, res: SplitResult) -> TreeState:
@@ -705,8 +731,16 @@ def grow_tree_compact(cfg: GrowerConfig,
                       gain_scale_f: Optional[jnp.ndarray] = None,
                       gain_penalty_f: Optional[jnp.ndarray] = None,
                       forced: Optional[ForcedSplits] = None,
+                      mono_global: Optional[jnp.ndarray] = None,
                       ) -> TreeState:
-    """Grow one tree with the partition-order strategy; same TreeState out."""
+    """Grow one tree with the partition-order strategy; same TreeState out.
+
+    Feature-parallel constraint handling: per-feature SCAN vectors
+    (monotone, gain_scale_f, gain_penalty_f, num_bins_f, ...) are the
+    shard's local slice, while `igroups` and `mono_global` stay GLOBAL and
+    replicated — split bookkeeping indexes them with the globally-agreed
+    winning feature id (the reference shares the serial learner's
+    constraint state across all parallel learners the same way)."""
     n, g = bins.shape            # g = storage columns (bundles under EFB)
     f = num_bins_f.shape[0]      # original feature count
     L = cfg.num_leaves
@@ -744,10 +778,21 @@ def grow_tree_compact(cfg: GrowerConfig,
     def interaction_mask(used, fmask):
         if not cfg.use_interaction:
             return fmask
-        # reference ColSampler::GetByNode (col_sampler.hpp)
+        # reference ColSampler::GetByNode (col_sampler.hpp); `used` and
+        # `igroups` are in GLOBAL feature space — under feature-parallel
+        # each shard slices out its own feature window afterwards
         ok = ~jnp.any(used[None, :] & ~igroups, axis=1)        # [G]
-        allowed = jnp.any(igroups & ok[:, None], axis=0)       # [F]
+        allowed = jnp.any(igroups & ok[:, None], axis=0)       # [F_global]
+        if mode == "feature":
+            me = jax.lax.axis_index(ax)
+            allowed = jax.lax.dynamic_slice(allowed, (me * f,), (f,))
         return fmask & allowed
+
+    # bookkeeping indexes constraints by the GLOBAL winning feature id
+    mono_bk = (mono_global if (mode == "feature" and mono_global is not None)
+               else monotone)
+    f_used = (igroups.shape[1] if (cfg.use_interaction and igroups is not None)
+              else f)
 
     def extra_bins(step):
         if not cfg.extra_trees:
@@ -816,6 +861,16 @@ def grow_tree_compact(cfg: GrowerConfig,
                      "feature": scan_feature_parallel,
                      "voting": scan_voting}[mode]
 
+    # intermediate/advanced monotone methods recompute EVERY leaf's bound
+    # (and its cached best split) after each split — the reference's
+    # stale-leaf update (monotone_constraints.hpp:514 leaves_to_update).
+    # Dense equivalent: subtree-membership matrices + a vmapped full rescan.
+    # Feature/voting modes keep split-time-only bounds (scan collectives
+    # don't batch under vmap); serial + data-parallel get the full recompute.
+    recompute_mono = (cfg.use_monotone
+                      and cfg.monotone_method in ("intermediate", "advanced")
+                      and mode in ("none", "data"))
+
     # ---- root ----------------------------------------------------------
     root_hist = psum_(build_histogram(
         bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
@@ -825,7 +880,7 @@ def grow_tree_compact(cfg: GrowerConfig,
         root_sums = jax.lax.psum(root_sums, ax)
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
-    state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f)
+    state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f_used)
     root_res = scan_dispatch(root_hist, root_sums, jnp.int32(0),
                              interaction_mask(state.leaf_used[0],
                                               node_feature_mask(0)),
@@ -843,29 +898,56 @@ def grow_tree_compact(cfg: GrowerConfig,
     leaf_count = jnp.zeros((L,), jnp.int32).at[0].set(n)
 
     def body(step, carry):
-        state, order, leaf_start, leaf_count, pool, f_aborted = carry
+        state, order, leaf_start, leaf_count, pool, f_aborted, *mono_carry \
+            = carry
         if forced is not None:
             # forced-splits prefix (reference ForceSplits,
-            # serial_tree_learner.cpp:450-562): steps < S split the scheduled
-            # leaf at the scheduled (feature, bin) instead of the best-gain
-            # candidate, regardless of gain — feasibility (non-empty
-            # children, target leaf exists) is the only gate.  The first
-            # infeasible entry aborts the whole remaining schedule
-            # (abort_last_forced_split), since later entries' precomputed
-            # leaf ids assume every earlier forced split happened.
+            # serial_tree_learner.cpp:450-562): steps < S split the
+            # scheduled leaf at the scheduled (feature, bin) instead of the
+            # best-gain candidate, provided the forced split's gain is
+            # positive (feature_histogram.hpp:606 rejects worse-gain forced
+            # splits).  The first rejected entry aborts the whole remaining
+            # schedule (abort_last_forced_split), since later entries'
+            # precomputed leaf ids assume every earlier forced split
+            # happened.
             S = forced.leaf.shape[0]
             si = jnp.minimum(step, S - 1)
             f_leaf = forced.leaf[si]
-            res_f = _forced_split_result(cfg, pool[f_leaf],
-                                         state.leaf_sum[f_leaf],
-                                         forced.feat[si], forced.thr[si],
-                                         num_bins_f, has_missing_f, bmap)
-            # gain is -inf iff a side_gain constraint (empty child / min
-            # hessian) failed; a merely-negative gain is still feasible —
-            # forced splits apply regardless of gain.
-            f_feasible = ((res_f.left_count > 0) & (res_f.right_count > 0)
-                          & jnp.isfinite(res_f.gain)
-                          & (f_leaf < state.n_leaves))
+            if mode == "feature":
+                # only the shard owning the forced feature holds its
+                # histogram slice; it gathers the split info and broadcasts
+                # it (reference: feature-parallel shares the serial
+                # learner's ForceSplits because storage is replicated —
+                # here one [SplitResult] psum replaces the replication)
+                me = jax.lax.axis_index(ax)
+                gfeat = forced.feat[si]
+                owner = gfeat // jnp.int32(f)
+                lf = jnp.clip(gfeat - owner * jnp.int32(f), 0, f - 1)
+                res_local = _forced_split_result(
+                    cfg, pool[f_leaf], state.leaf_sum[f_leaf], lf,
+                    forced.thr[si], num_bins_f, has_missing_f, bmap)
+                is_owner = me == owner
+
+                def _bcast(x):
+                    if x.dtype == jnp.bool_:
+                        return jax.lax.psum(
+                            jnp.where(is_owner, x, False).astype(jnp.int32),
+                            ax) > 0
+                    return jax.lax.psum(
+                        jnp.where(is_owner, x, jnp.zeros_like(x)), ax)
+
+                res_f = jax.tree_util.tree_map(_bcast, res_local)
+                res_f = res_f._replace(feature=gfeat)
+            else:
+                res_f = _forced_split_result(cfg, pool[f_leaf],
+                                             state.leaf_sum[f_leaf],
+                                             forced.feat[si], forced.thr[si],
+                                             num_bins_f, has_missing_f, bmap)
+            # reference gate (feature_histogram.hpp:606): a forced split
+            # whose gain is not positive is "ignored since the gain getting
+            # worse", which then aborts the remaining schedule
+            # (forceSplitMap.erase -> abort_last_forced_split)
+            f_feasible = (res_f.gain > 0.0) & (f_leaf < state.n_leaves)
             f_valid = (step < S) & ~f_aborted & f_feasible
             f_aborted = f_aborted | ((step < S) & ~f_feasible)
             state = jax.lax.cond(
@@ -882,7 +964,8 @@ def grow_tree_compact(cfg: GrowerConfig,
             found = gain > K_EPSILON
 
         def do_split(carry):
-            state, order, leaf_start, leaf_count, pool, f_aborted = carry
+            state, order, leaf_start, leaf_count, pool, f_aborted, \
+                *mono_carry = carry
             new_leaf = state.n_leaves
             feat = state.best_feature[best_leaf]
             thr = state.best_threshold[best_leaf]
@@ -970,11 +1053,55 @@ def grow_tree_compact(cfg: GrowerConfig,
             depth = state.leaf_depth[best_leaf] + 1
             new_state = _apply_split_bookkeeping(
                 state, best_leaf, gain, feat, thr, dleft, split_cat,
-                cat_mask, cfg, monotone)
+                cat_mask, cfg, mono_bk)
 
             fmask = interaction_mask(new_state.leaf_used[best_leaf],
                                      node_feature_mask(step + 1))
             rb = extra_bins(step + 1)
+            if recompute_mono:
+                # update subtree membership, recompute every leaf's bound
+                # from the now-current outputs, then rescan ALL leaves so
+                # no cached best split is stale (reference leaves_to_update)
+                in_left, in_right, node_mono = mono_carry
+                node = new_leaf - 1
+                in_left = in_left.at[:, new_leaf].set(in_left[:, best_leaf]) \
+                                 .at[node, best_leaf].set(True)
+                in_right = in_right.at[:, new_leaf].set(
+                    in_right[:, best_leaf]).at[node, new_leaf].set(True)
+                nm = jnp.where(split_cat, jnp.int8(0),
+                               mono_bk[feat].astype(jnp.int8))
+                node_mono = node_mono.at[node].set(nm)
+                lo, hi = _recompute_monotone_bounds(
+                    node_mono, in_left, in_right, new_state.leaf_value,
+                    new_state.n_leaves, L)
+                new_state = new_state._replace(leaf_lo=lo, leaf_hi=hi)
+                nmask = node_feature_mask(step + 1)
+                fmask_all = jax.vmap(
+                    lambda used: interaction_mask(used, nmask)
+                )(new_state.leaf_used)
+                res_all = jax.vmap(
+                    lambda h, s, d, fm, lo_, hi_: scan_plain(
+                        h, s, d, fm, (lo_, hi_), rb)
+                )(pool, new_state.leaf_sum, new_state.leaf_depth, fmask_all,
+                  lo, hi)
+                live = jnp.arange(L) < new_state.n_leaves
+                new_state = new_state._replace(
+                    best_gain=jnp.where(live, res_all.gain, _NEG_INF),
+                    best_feature=res_all.feature,
+                    best_threshold=res_all.threshold_bin,
+                    best_default_left=res_all.default_left,
+                    best_left=jnp.stack([res_all.left_sum_g,
+                                         res_all.left_sum_h,
+                                         res_all.left_count], axis=1),
+                    best_right=jnp.stack([res_all.right_sum_g,
+                                          res_all.right_sum_h,
+                                          res_all.right_count], axis=1),
+                    best_left_out=res_all.left_output,
+                    best_right_out=res_all.right_output,
+                    best_is_cat=res_all.is_cat,
+                    best_cat_mask=res_all.cat_mask)
+                return (new_state, order, leaf_start, leaf_count, pool,
+                        f_aborted, in_left, in_right, node_mono)
             res_l = scan_dispatch(hist_l, new_state.leaf_sum[best_leaf],
                                   depth, fmask,
                                   (new_state.leaf_lo[best_leaf],
@@ -989,11 +1116,17 @@ def grow_tree_compact(cfg: GrowerConfig,
 
         return jax.lax.cond(found, do_split, lambda c: c,
                             (state, order, leaf_start, leaf_count, pool,
-                             f_aborted))
+                             f_aborted, *mono_carry))
 
-    carry = (state, order, leaf_start, leaf_count, pool, jnp.asarray(False))
-    state, order, leaf_start, leaf_count, _, _ = jax.lax.fori_loop(
-        0, L - 1, body, carry)
+    mono_init = ()
+    if recompute_mono:
+        mono_init = (jnp.zeros((L - 1, L), bool),   # in_left[node, leaf]
+                     jnp.zeros((L - 1, L), bool),   # in_right[node, leaf]
+                     jnp.zeros((L - 1,), jnp.int8))  # node monotone dir
+    carry = (state, order, leaf_start, leaf_count, pool, jnp.asarray(False),
+             *mono_init)
+    state, order, leaf_start, leaf_count = jax.lax.fori_loop(
+        0, L - 1, body, carry)[:4]
 
     # -- row -> leaf vector for the train-score fast path (one scatter per
     #    tree; segments -> positions via a tiny sort + searchsorted).
@@ -1183,11 +1316,12 @@ class SerialTreeLearner:
             # python-API form: [[0,1],[2,3]]
             grp_lists = [[int(x) for x in grp] for grp in raw]
         else:
-            # config-file form: "[0,1,2],[2,3]"
+            # config-file form "[0,1,2],[2,3]" or the stringified python
+            # form "[[0, 1], [2, 3]]" — match innermost bracket groups
             import re as _re
             grp_lists = [[int(x) for x in grp.replace(" ", "").split(",")
                           if x]
-                         for grp in _re.findall(r"\[([^\]]*)\]", str(raw))]
+                         for grp in _re.findall(r"\[([^\[\]]*)\]", str(raw))]
         groups = []
         for idxs in grp_lists:
             row = np.zeros(dataset.num_features, bool)

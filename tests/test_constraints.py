@@ -28,7 +28,7 @@ def _is_monotone(bst, feature, sign, base):
     return np.all(sign * diffs >= -1e-10)
 
 
-@pytest.mark.parametrize("method", ["basic", "intermediate"])
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
 def test_monotone_constraints_hold(method):
     X, y = _monotone_data()
     params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
@@ -147,3 +147,86 @@ def test_forced_splits_bad_feature_ignored(tmp_path):
               "min_data_in_leaf": 5, "forcedsplits_filename": path}
     bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
     assert bst.num_trees() == 2
+
+
+def test_forced_splits_feature_parallel(tmp_path):
+    """The reference supports forcedsplits under the feature-parallel
+    learner (only data/voting are fatal, config.cpp:317); the owner shard
+    gathers the forced split info and broadcasts it."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(4000, 4).astype(np.float32)
+    y = (X[:, 0] + 2.0 * X[:, 1] + 0.1 * rng.randn(4000)).astype(np.float32)
+    fs = {"feature": 2, "threshold": 0.5}
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump(fs, fh)
+    params = {"objective": "regression", "num_leaves": 16, "verbosity": -1,
+              "min_data_in_leaf": 5, "forcedsplits_filename": path,
+              "tree_learner": "feature", "num_machines": 8,
+              "num_tpu_devices": 8}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
+    for tree in bst.dump_model()["tree_info"]:
+        root = tree["tree_structure"]
+        assert root["split_feature"] == 2
+        assert abs(root["threshold"] - 0.5) < 0.1
+
+
+def test_forced_splits_fatal_with_data_parallel(tmp_path):
+    """reference config.cpp:317: forcedsplits + data/voting learner is a
+    fatal config error, not a silent ignore."""
+    fs = {"feature": 0, "threshold": 0.5}
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump(fs, fh)
+    X = np.random.RandomState(0).rand(500, 4)
+    y = X[:, 0].astype(np.float32)
+    params = {"objective": "regression", "verbosity": -1,
+              "forcedsplits_filename": path, "tree_learner": "data",
+              "num_machines": 8, "num_tpu_devices": 8}
+    with pytest.raises(Exception, match="forcedsplits"):
+        lgb.train(params, lgb.Dataset(X, y), num_boost_round=1)
+
+
+@pytest.mark.parametrize("method", ["intermediate", "advanced"])
+def test_monotone_stale_leaf_recompute(method):
+    """The scenario the reference's leaves_to_update machinery exists for
+    (monotone_constraints.hpp:514): after a sibling subtree resplits, other
+    leaves' bounds must tighten to the sibling's NEW child outputs — with
+    recompute, an exhaustive global monotonicity check passes even on deep
+    trees where split-time-only bounds go stale."""
+    X, y = _monotone_data(seed=11, n=6000)
+    params = {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+              "min_data_in_leaf": 5, "learning_rate": 0.2,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": method}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=30)
+    rng = np.random.RandomState(3)
+    # denser probe than the basic test: 200 random slices x 50-point grids
+    for _ in range(200):
+        base = rng.rand(3)
+        grid = np.linspace(0.01, 0.99, 50)
+        rows = np.tile(base, (50, 1))
+        rows[:, 0] = grid
+        d = np.diff(bst.predict(rows))
+        assert np.all(d >= -1e-9), (method, float(d.min()))
+        rows = np.tile(base, (50, 1))
+        rows[:, 1] = grid
+        d = np.diff(bst.predict(rows))
+        assert np.all(d <= 1e-9), (method, float(d.max()))
+
+
+def test_monotone_data_parallel_recompute():
+    """Intermediate recompute also runs under the data-parallel learner
+    (the reference shares constraint state across parallel learners)."""
+    X, y = _monotone_data(seed=12, n=4000)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0],
+              "monotone_constraints_method": "intermediate",
+              "tree_learner": "data", "num_machines": 8,
+              "num_tpu_devices": 8}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10)
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        base = rng.rand(3)
+        assert _is_monotone(bst, 0, +1, base)
+        assert _is_monotone(bst, 1, -1, base)

@@ -111,3 +111,58 @@ def test_unwired_params_warn():
         lgb_log.register_log_callback(None)
     assert any("two_round" in m and "NOT implemented" in m
                for m in messages), messages
+
+
+def test_auc_mu_custom_weight_matrix():
+    """Custom auc_mu_weights follow the reference's partition scoring
+    (multiclass_metric.hpp:246-266); the default matrix must equal the
+    uniform path."""
+    import jax; jax.config.update("jax_platforms", "cpu")
+    rng = np.random.RandomState(4)
+    k, n = 3, 600
+    X = rng.randn(n, 5)
+    y = rng.randint(0, k, n).astype(np.float32)
+    base = {"objective": "multiclass", "num_class": k, "verbosity": -1,
+            "metric": "auc_mu", "num_leaves": 7}
+    res_d, res_w, res_u = {}, {}, {}
+    ds = lambda: lgb.Dataset(X, y)
+    va = lambda tr: lgb.Dataset(X, y, reference=tr)
+    t1 = ds(); lgb.train(base, t1, 3, valid_sets=[va(t1)], evals_result=res_d)
+    W_default = [0, 1, 1, 1, 0, 1, 1, 1, 0]
+    t2 = ds(); lgb.train({**base, "auc_mu_weights": W_default}, t2, 3,
+                         valid_sets=[va(t2)], evals_result=res_w)
+    W_custom = [0, 2, 1, 1, 0, 1, 1, 3, 0]
+    t3 = ds(); lgb.train({**base, "auc_mu_weights": W_custom}, t3, 3,
+                         valid_sets=[va(t3)], evals_result=res_u)
+    d = res_d["valid_0"]["auc_mu"]
+    w = res_w["valid_0"]["auc_mu"]
+    u = res_u["valid_0"]["auc_mu"]
+    np.testing.assert_allclose(d, w, rtol=1e-12)   # explicit default == auto
+    assert all(0.0 <= v <= 1.0 for v in u)
+
+
+def test_label_column_by_name(tmp_path):
+    """CLI label_column=name:LABEL resolves through the header row
+    (reference config label_column name: form)."""
+    from lightgbm_tpu.application import Application
+    rng = np.random.RandomState(6)
+    X = rng.rand(300, 3)
+    y = (X[:, 1] > 0.5).astype(np.float32)
+    path = str(tmp_path / "train.csv")
+    with open(path, "w") as fh:
+        fh.write("f0,target,f1,f2\n")
+        for i in range(300):
+            fh.write(f"{X[i,0]:.6f},{y[i]:.0f},{X[i,1]:.6f},{X[i,2]:.6f}\n")
+    out = str(tmp_path / "model.txt")
+    app = Application([
+        "task=train", f"data={path}", "header=true",
+        "label_column=name:target", "objective=binary", "num_leaves=7",
+        "num_iterations=3", "verbosity=-1", f"output_model={out}"])
+    app.run()
+    import os
+    assert os.path.exists(out)
+    bst = lgb.Booster(model_file=out)
+    pred = bst.predict(np.delete(
+        np.column_stack([X[:, 0], y, X[:, 1], X[:, 2]]), 1, axis=1))
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, pred) > 0.9
