@@ -1,0 +1,80 @@
+# R bindings for lightgbm_tpu — surface of the reference R-package
+# (R-package/R/lgb.Dataset.R, lgb.train.R:51, lgb.Booster.R) over the
+# C ABI.  Load order: dyn.load the glue built from src/lightgbm_tpu_R.c
+# (which links c_api/lib_lightgbm_tpu.so).
+
+#' Construct a Dataset (reference lgb.Dataset, lgb.Dataset.R)
+#' @param data numeric matrix [n, f]
+#' @param label numeric response vector
+#' @param params named list of dataset parameters (max_bin, ...)
+lgb.Dataset <- function(data, label = NULL, params = list()) {
+  data <- as.matrix(data)
+  storage.mode(data) <- "double"
+  handle <- .Call("LGBM_R_DatasetCreate", data, nrow(data), ncol(data),
+                  .lgb.params.str(params))
+  ds <- list(handle = handle, dim = dim(data))
+  class(ds) <- "lgb.Dataset"
+  if (!is.null(label)) {
+    lgb.Dataset.set.label(ds, label)
+  }
+  ds
+}
+
+#' Attach the label field (reference setinfo / lgb.Dataset.set.label)
+lgb.Dataset.set.label <- function(dataset, label) {
+  .Call("LGBM_R_DatasetSetLabel", dataset$handle, as.double(label))
+  invisible(dataset)
+}
+
+#' Train a model (reference lgb.train, lgb.train.R:51)
+#' @param params named list (objective, num_leaves, ...)
+#' @param data an lgb.Dataset
+#' @param nrounds number of boosting iterations
+lgb.train <- function(params = list(), data, nrounds = 100L) {
+  stopifnot(inherits(data, "lgb.Dataset"))
+  handle <- .Call("LGBM_R_BoosterCreate", data$handle,
+                  .lgb.params.str(params))
+  bst <- list(handle = handle)
+  class(bst) <- "lgb.Booster"
+  for (i in seq_len(nrounds)) {
+    finished <- .Call("LGBM_R_BoosterUpdateOneIter", handle)
+    if (isTRUE(finished)) break
+  }
+  bst
+}
+
+#' Predict (reference predict.lgb.Booster)
+predict.lgb.Booster <- function(object, newdata, rawscore = FALSE,
+                                num_iteration = -1L, ...) {
+  newdata <- as.matrix(newdata)
+  storage.mode(newdata) <- "double"
+  .Call("LGBM_R_BoosterPredict", object$handle, newdata, nrow(newdata),
+        ncol(newdata), isTRUE(rawscore), as.integer(num_iteration))
+}
+
+#' Save the model in the reference text format (reference lgb.save)
+lgb.save <- function(booster, filename) {
+  .Call("LGBM_R_BoosterSaveModel", booster$handle, filename)
+  invisible(booster)
+}
+
+#' Load a model file (reference lgb.load)
+lgb.load <- function(filename) {
+  handle <- .Call("LGBM_R_BoosterLoadModel", filename)
+  bst <- list(handle = handle)
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+#' Number of trained trees
+lgb.num.trees <- function(booster) {
+  .Call("LGBM_R_BoosterNumTrees", booster$handle)
+}
+
+# "k1=v1 k2=v2" serialization (reference lgb.params2str, utils.R)
+.lgb.params.str <- function(params) {
+  if (length(params) == 0L) return("")
+  paste(vapply(names(params), function(k) {
+    paste0(k, "=", paste(params[[k]], collapse = ","))
+  }, character(1L)), collapse = " ")
+}

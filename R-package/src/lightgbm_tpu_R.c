@@ -1,0 +1,176 @@
+/* R glue for the lightgbm_tpu C ABI — the role of the reference's
+ * R-package/src/lightgbm_R.cpp: SEXP-taking wrappers around the LGBM_*
+ * entry points of c_api/lib_lightgbm_tpu.so, registered for .Call().
+ *
+ * Build (from R-package/): R CMD SHLIB src/lightgbm_tpu_R.c \
+ *   -L../c_api -l:lib_lightgbm_tpu.so
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+
+#include <stdint.h>
+#include <string.h>
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                     int32_t nrow, int32_t ncol,
+                                     int is_row_major, const char* parameters,
+                                     const DatasetHandle reference,
+                                     DatasetHandle* out);
+extern int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                                const void* data, int num_element, int type);
+extern int LGBM_DatasetFree(DatasetHandle handle);
+extern int LGBM_BoosterCreate(const DatasetHandle train_data,
+                              const char* parameters, BoosterHandle* out);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+extern int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int is_row_major,
+                                     int predict_type, int start_iteration,
+                                     int num_iteration, const char* parameter,
+                                     int64_t* out_len, double* out_result);
+extern int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                                 int num_iteration,
+                                 int feature_importance_type,
+                                 const char* filename);
+extern int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                           int* out_num_iterations,
+                                           BoosterHandle* out);
+extern int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out);
+extern int LGBM_BoosterNumModelPerIteration(BoosterHandle handle, int* out);
+extern int LGBM_BoosterFree(BoosterHandle handle);
+
+static void check_call(int rc) {
+  if (rc != 0) {
+    Rf_error("lightgbm_tpu: %s", LGBM_GetLastError());
+  }
+}
+
+static void dataset_finalizer(SEXP ptr) {
+  DatasetHandle h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void booster_finalizer(SEXP ptr) {
+  BoosterHandle h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+/* data: numeric matrix (column-major in R); params: scalar string */
+SEXP LGBM_R_DatasetCreate(SEXP data, SEXP nrow, SEXP ncol, SEXP params) {
+  DatasetHandle h = NULL;
+  check_call(LGBM_DatasetCreateFromMat(
+      REAL(data), 1 /* float64 */, Rf_asInteger(nrow), Rf_asInteger(ncol),
+      0 /* column-major */, CHAR(Rf_asChar(params)), NULL, &h));
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, dataset_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP LGBM_R_DatasetSetLabel(SEXP handle, SEXP label) {
+  int n = Rf_length(label);
+  float* buf = (float*)R_alloc(n, sizeof(float));
+  double* src = REAL(label);
+  for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+  check_call(LGBM_DatasetSetField(R_ExternalPtrAddr(handle), "label", buf, n,
+                                  0 /* float32 */));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_BoosterCreate(SEXP train, SEXP params) {
+  BoosterHandle h = NULL;
+  check_call(LGBM_BoosterCreate(R_ExternalPtrAddr(train),
+                                CHAR(Rf_asChar(params)), &h));
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, booster_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP LGBM_R_BoosterUpdateOneIter(SEXP handle) {
+  int fin = 0;
+  check_call(LGBM_BoosterUpdateOneIter(R_ExternalPtrAddr(handle), &fin));
+  return Rf_ScalarLogical(fin);
+}
+
+SEXP LGBM_R_BoosterPredict(SEXP handle, SEXP data, SEXP nrow, SEXP ncol,
+                           SEXP rawscore, SEXP num_iteration) {
+  int n = Rf_asInteger(nrow);
+  /* the predict payload is n * num_class doubles (multiclass models
+   * return one column per class) — size the R vector accordingly */
+  int k = 1;
+  check_call(LGBM_BoosterNumModelPerIteration(R_ExternalPtrAddr(handle), &k));
+  if (k < 1) k = 1;
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)n * k));
+  int64_t out_len = 0;
+  check_call(LGBM_BoosterPredictForMat(
+      R_ExternalPtrAddr(handle), REAL(data), 1 /* float64 */, n,
+      Rf_asInteger(ncol), 0 /* column-major */,
+      Rf_asLogical(rawscore) ? 1 : 0, 0, Rf_asInteger(num_iteration), "",
+      &out_len, REAL(out)));
+  if (out_len != (int64_t)n * k) {
+    UNPROTECT(1);
+    Rf_error("lightgbm_tpu: predict returned %lld values, expected %lld",
+             (long long)out_len, (long long)n * k);
+  }
+  if (k > 1) {
+    /* row-major [n, k] payload -> R matrix attribute for the caller */
+    SEXP dim = PROTECT(Rf_allocVector(INTSXP, 2));
+    INTEGER(dim)[0] = k;
+    INTEGER(dim)[1] = n;
+    Rf_setAttrib(out, R_DimSymbol, dim);
+    UNPROTECT(1);
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBM_R_BoosterSaveModel(SEXP handle, SEXP filename) {
+  check_call(LGBM_BoosterSaveModel(R_ExternalPtrAddr(handle), 0, -1, 0,
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBM_R_BoosterLoadModel(SEXP filename) {
+  BoosterHandle h = NULL;
+  int n_iter = 0;
+  check_call(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)),
+                                             &n_iter, &h));
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, booster_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP LGBM_R_BoosterNumTrees(SEXP handle) {
+  int n = 0;
+  check_call(LGBM_BoosterNumberOfTotalModel(R_ExternalPtrAddr(handle), &n));
+  return Rf_ScalarInteger(n);
+}
+
+static const R_CallMethodDef call_methods[] = {
+    {"LGBM_R_DatasetCreate", (DL_FUNC)&LGBM_R_DatasetCreate, 4},
+    {"LGBM_R_DatasetSetLabel", (DL_FUNC)&LGBM_R_DatasetSetLabel, 2},
+    {"LGBM_R_BoosterCreate", (DL_FUNC)&LGBM_R_BoosterCreate, 2},
+    {"LGBM_R_BoosterUpdateOneIter", (DL_FUNC)&LGBM_R_BoosterUpdateOneIter, 1},
+    {"LGBM_R_BoosterPredict", (DL_FUNC)&LGBM_R_BoosterPredict, 6},
+    {"LGBM_R_BoosterSaveModel", (DL_FUNC)&LGBM_R_BoosterSaveModel, 2},
+    {"LGBM_R_BoosterLoadModel", (DL_FUNC)&LGBM_R_BoosterLoadModel, 1},
+    {"LGBM_R_BoosterNumTrees", (DL_FUNC)&LGBM_R_BoosterNumTrees, 1},
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

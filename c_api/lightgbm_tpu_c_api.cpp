@@ -196,6 +196,14 @@ LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
   return run_simple("dataset_set_field", args, nullptr);
 }
 
+LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                            DatasetHandle source) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(target),
+                                 static_cast<PyObject*>(source));
+  return run_simple("dataset_add_features_from", args, nullptr);
+}
+
 LGBM_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
   Gil gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));

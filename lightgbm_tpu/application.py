@@ -95,7 +95,8 @@ class Application:
                     raise ValueError("label_column=name: requires a CSV/TSV "
                                      "file with a header row")
                 sep = "\t" if fmt == "tsv" else ","
-                with open(path) as fh:
+                from .io.file_io import open_readable
+                with open_readable(path) as fh:
                     cols = [c.strip() for c in
                             fh.readline().rstrip("\n").split(sep)]
                 if name not in cols:

@@ -263,6 +263,40 @@ class Dataset:
         ds.reference = self.reference
         return ds
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Merge another Dataset's features into this one column-wise
+        (reference Dataset::AddFeaturesFrom, dataset.cpp:754 /
+        LGBM_DatasetAddFeaturesFrom).  Both datasets keep their own bin
+        mappers; the other's feature indices shift by this dataset's
+        feature count.  Raw data (linear-tree support) is not carried."""
+        self.construct()
+        other.construct()
+        a, b = self._handle, other._handle
+        if not isinstance(a, TrainDataset) or not isinstance(b, TrainDataset):
+            raise LightGBMError("add_features_from requires two constructed "
+                                "train Datasets")
+        if a.num_data != b.num_data:
+            raise LightGBMError(
+                f"cannot add features: row counts differ "
+                f"({a.num_data} vs {b.num_data})")
+        if getattr(a, "rank_local", False) or getattr(b, "rank_local", False):
+            raise LightGBMError("add_features_from is not supported for "
+                                "rank-sharded datasets")
+        mappers = list(a.all_bin_mappers) + list(b.all_bin_mappers)
+        bins = np.concatenate([np.asarray(a.bins), np.asarray(b.bins)],
+                              axis=1)
+        merged = TrainDataset.__new__(TrainDataset)
+        merged._init_from_binned(
+            bins, mappers, a.num_total_features + b.num_total_features,
+            a.metadata, a.config)
+        self._handle = merged
+        if self._feature_names and other._feature_names:
+            self._feature_names = (list(self._feature_names)
+                                   + list(other._feature_names))
+        else:
+            self._feature_names = None
+        return self
+
     def set_label(self, label):
         self.label = label
         if self._handle is not None:

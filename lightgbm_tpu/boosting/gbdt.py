@@ -323,23 +323,26 @@ class GBDT:
         return np.asarray(self.train_score[cls])
 
     def _cegb_penalty(self):
-        """Per-feature CEGB gain penalty for this iteration (reference
-        CostEfficientGradientBoosting::DetlaGain: tradeoff * (split penalty
-        + coupled feature penalty for features not yet used anywhere in the
-        model); the lazy per-datapoint penalty is not implemented)."""
+        """Coupled per-feature CEGB penalty for this iteration (reference
+        CostEfficientGradientBoosting::DetlaGain second term: tradeoff *
+        coupled cost for features not yet used anywhere in the model).
+        The split penalty scales with leaf size inside the scan
+        (GrowerConfig.cegb_split_penalty) and the lazy per-datapoint
+        penalty rides the grower's used-rows matrix."""
         if not getattr(self.tree_learner, "use_cegb", False):
             return None
         cfg = self.config
         ds = self.train_data
         if not hasattr(self, "_cegb_used"):
             self._cegb_used = np.zeros(ds.num_features, bool)
-        pen = np.full(ds.num_features,
-                      cfg.cegb_tradeoff * cfg.cegb_penalty_split, np.float32)
+        pen = np.zeros(ds.num_features, np.float32)
         if cfg.cegb_penalty_feature_coupled:
             coupled = list(cfg.cegb_penalty_feature_coupled)
             for inner, real in enumerate(ds.real_feature_index):
                 if real < len(coupled) and not self._cegb_used[inner]:
                     pen[inner] += cfg.cegb_tradeoff * float(coupled[real])
+        elif not cfg.cegb_penalty_feature_lazy:
+            return None            # split-size penalty alone needs no vector
         return jnp.asarray(pen)
 
     def _cegb_mark_used(self, tree: Tree):

@@ -19,6 +19,7 @@ __all__ = [
     "dataset_create_from_mat", "dataset_create_from_file",
     "dataset_create_from_csr", "dataset_create_from_csc",
     "dataset_set_field", "dataset_num_data", "dataset_num_feature",
+    "dataset_add_features_from",
     "booster_create", "booster_create_from_modelfile", "booster_add_valid",
     "booster_update_one_iter", "booster_update_one_iter_custom",
     "booster_rollback_one_iter",
@@ -116,6 +117,11 @@ def dataset_create_from_csc(indptr_mat, indices_mat, data_mat, nindptr: int,
     return Dataset(csc, params=_parse_params(parameters),
                    reference=reference if isinstance(reference, Dataset)
                    else None, free_raw_data=False)
+
+
+def dataset_add_features_from(target: Dataset, source: Dataset) -> None:
+    """reference LGBM_DatasetAddFeaturesFrom (c_api.cpp:1429)."""
+    target.add_features_from(source)
 
 
 def dataset_set_field(ds: Dataset, field_name: str, vec) -> None:

@@ -83,6 +83,14 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
     dask partition the same way, dask.py:164).  Workers join through
     jax.distributed using an auto-built `machines` list; training runs
     whatever ``tree_learner`` the params select (default data-parallel).
+
+    Data partitioning (reference _split_to_parts, dask.py:341): pass
+    ``pre_partition=True`` in params and have data_fn return only THIS
+    rank's rows — each worker then bins just its shard and the learner
+    consumes rank-local blocks (TrainDataset.from_rank_shard), so per-rank
+    memory is O(N/num_workers).  Without it, every worker must return the
+    FULL dataset (reference pre_partition=false semantics).
+
     Only localhost launch is implemented — on a multi-host pod, start one
     process per host yourself with LIGHTGBM_TPU_RANK + the same params and
     this module's machines list convention.

@@ -13,13 +13,15 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from .file_io import open_readable
+
 __all__ = ["detect_format", "load_svmlight_or_csv", "load_rank_shard",
            "LineParser"]
 
 
 def detect_format(path: str) -> str:
     """Return 'libsvm' | 'csv' | 'tsv' (reference parser.cpp auto-detect)."""
-    with open(path) as fh:
+    with open_readable(path) as fh:
         for _ in range(10):
             line = fh.readline()
             if not line:
@@ -43,7 +45,7 @@ def detect_format(path: str) -> str:
 
 
 def _has_header(path: str, sep: str) -> bool:
-    with open(path) as fh:
+    with open_readable(path) as fh:
         first = fh.readline().strip()
     if not first:
         return False
@@ -72,7 +74,8 @@ def load_svmlight_or_csv(path: str, label_idx: int = 0,
         header = _has_header(path, sep)
     try:
         import pandas as pd
-        df = pd.read_csv(path, sep=sep, header=0 if header else None)
+        with open_readable(path) as _fh:
+            df = pd.read_csv(_fh, sep=sep, header=0 if header else None)
         arr = df.to_numpy(dtype=np.float64)
     except ImportError:
         arr = np.loadtxt(path, delimiter=sep,
@@ -88,7 +91,7 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
     labels = []
     rows = []
     max_feat = -1
-    with open(path) as fh:
+    with open_readable(path) as fh:
         for line in fh:
             line = line.strip()
             if not line or line.startswith("#"):
@@ -154,8 +157,10 @@ class LineParser:
             return
         sep = "\t" if self.fmt == "tsv" else ","
         import pandas as pd
-        for chunk in pd.read_csv(self.path, sep=sep,
-                                 header=0 if self.header else None,
-                                 chunksize=self.chunk_rows):
-            arr = chunk.to_numpy(dtype=np.float64)
-            yield np.ascontiguousarray(arr[:, 1:]), arr[:, 0].astype(np.float32)
+        with open_readable(self.path) as _fh:
+            for chunk in pd.read_csv(_fh, sep=sep,
+                                     header=0 if self.header else None,
+                                     chunksize=self.chunk_rows):
+                arr = chunk.to_numpy(dtype=np.float64)
+                yield (np.ascontiguousarray(arr[:, 1:]),
+                       arr[:, 0].astype(np.float32))

@@ -81,6 +81,7 @@ def find_best_split(
     path_smooth: float = 0.0,                 # reference path_smooth
     gain_scale_f: Optional[jnp.ndarray] = None,    # [F] feature_contri
     gain_penalty_f: Optional[jnp.ndarray] = None,  # [F] CEGB gain penalty
+    cegb_split_penalty: float = 0.0,  # CEGB tradeoff*penalty_split (x leaf n)
     rand_bin_f: Optional[jnp.ndarray] = None,      # [F] extra_trees bin
     is_cat_f: Optional[jnp.ndarray] = None,   # [F] bool, None = no cats (static)
     cat_l2: float = 10.0, cat_smooth: float = 10.0,
@@ -202,8 +203,14 @@ def find_best_split(
         improvement = improvement * gain_scale_f[None, :, None]
     if gain_penalty_f is not None:
         # CEGB gain haircut (reference CostEfficientGradientBoosting::
-        # DetlaGain, cost_effective_gradient_boosting.hpp:22)
+        # DetlaGain, cost_effective_gradient_boosting.hpp:22): the caller's
+        # per-feature vector carries the coupled (+ lazy, via the grower's
+        # per-leaf notused counts) terms
         improvement = improvement - gain_penalty_f[None, :, None]
+    if cegb_split_penalty:
+        # tradeoff * cegb_penalty_split * num_data_in_leaf (DetlaGain's
+        # first term — scales with the leaf's bagged row count)
+        improvement = improvement - cegb_split_penalty * (lc + rc)
 
     # validity masks (reference FindBestThresholdSequentially constraints)
     valid = (lc >= min_data_in_leaf) & (rc >= min_data_in_leaf)

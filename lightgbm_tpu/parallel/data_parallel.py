@@ -39,6 +39,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
+        if config.cegb_penalty_feature_lazy is not None:
+            raise NotImplementedError(
+                "cegb_penalty_feature_lazy is not supported by parallel "
+                "tree learners here (the per-row used matrix would need "
+                "row-sharded carry); use tree_learner=serial")
         if self.forced is not None:
             # fatal, matching the reference (config.cpp:317-319
             # "Don't support forcedsplits in data/voting tree learner")

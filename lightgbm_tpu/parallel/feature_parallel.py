@@ -38,6 +38,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
+        if config.cegb_penalty_feature_lazy is not None:
+            raise NotImplementedError(
+                "cegb_penalty_feature_lazy is not supported by parallel "
+                "tree learners here; use tree_learner=serial")
         if config.grow_strategy != "compact":
             raise ValueError("tree_learner=feature requires "
                              "grow_strategy=compact")

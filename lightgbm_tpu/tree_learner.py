@@ -89,6 +89,11 @@ class GrowerConfig(NamedTuple):
     extra_trees: bool = False
     use_gain_scale: bool = False
     use_gain_penalty: bool = False
+    # CEGB (cost_effective_gradient_boosting.hpp DetlaGain): split penalty
+    # scales with the leaf's bagged row count; the lazy per-datapoint
+    # penalty charges each not-yet-using row of the leaf (compact grower)
+    cegb_split_penalty: float = 0.0
+    use_cegb_lazy: bool = False
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
@@ -131,6 +136,10 @@ class TreeState(NamedTuple):
     internal_count: jnp.ndarray  # [L-1]
     node_is_cat: jnp.ndarray     # [L-1] bool
     node_cat_mask: jnp.ndarray   # [L-1, B] bool
+    # CEGB lazy: rows that have used each feature so far, carried ACROSS
+    # trees by the booster (reference feature_used_in_data_ bitset,
+    # cost_effective_gradient_boosting.hpp:60); [0, 0] when lazy is off
+    cegb_used: jnp.ndarray       # [N, F] bool (or [0, 0] placeholder)
 
 
 class ForcedSplits(NamedTuple):
@@ -296,6 +305,7 @@ def _scan_leaf(hist, sums, depth, cfg: GrowerConfig, num_bins_f, has_missing_f,
         path_smooth=cfg.path_smooth,
         gain_scale_f=gain_scale_f if cfg.use_gain_scale else None,
         gain_penalty_f=gain_penalty_f if cfg.use_gain_penalty else None,
+        cegb_split_penalty=cfg.cegb_split_penalty,
         rand_bin_f=rand_bin_f if cfg.extra_trees else None,
         is_cat_f=is_cat_f if cfg.use_categorical else None,
         cat_l2=cfg.cat_l2, cat_smooth=cfg.cat_smooth,
@@ -360,6 +370,7 @@ def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
         internal_count=jnp.zeros((L - 1,), fdt),
         node_is_cat=jnp.zeros((L - 1,), bool),
         node_cat_mask=jnp.zeros((L - 1, B), bool),
+        cegb_used=jnp.zeros((0, 0), bool),
     )
 
 
@@ -732,6 +743,8 @@ def grow_tree_compact(cfg: GrowerConfig,
                       gain_penalty_f: Optional[jnp.ndarray] = None,
                       forced: Optional[ForcedSplits] = None,
                       mono_global: Optional[jnp.ndarray] = None,
+                      lazy_pen_f: Optional[jnp.ndarray] = None,
+                      used_init: Optional[jnp.ndarray] = None,
                       ) -> TreeState:
     """Grow one tree with the partition-order strategy; same TreeState out.
 
@@ -801,10 +814,13 @@ def grow_tree_compact(cfg: GrowerConfig,
         u = jax.random.uniform(k, (f,))
         return (u * (num_bins_f - 1).astype(u.dtype)).astype(jnp.int32)
 
-    def scan_plain(hist, sums, depth, fmask, bounds=None, rand_bin=None):
+    def scan_plain(hist, sums, depth, fmask, bounds=None, rand_bin=None,
+                   pen_f=None):
         return _scan_leaf(hist, sums, depth, cfg, num_bins_f, has_missing_f,
                           fmask, monotone, is_cat_f, bmap, bounds,
-                          gain_scale_f, gain_penalty_f, rand_bin)
+                          gain_scale_f,
+                          gain_penalty_f if pen_f is None else pen_f,
+                          rand_bin)
 
     def scan_feature_parallel(hist_local, sums, depth, fmask, bounds=None,
                               rand_bin=None):
@@ -871,6 +887,22 @@ def grow_tree_compact(cfg: GrowerConfig,
                       and cfg.monotone_method in ("intermediate", "advanced")
                       and mode in ("none", "data"))
 
+    # CEGB lazy per-datapoint penalty (reference CalculateOndemandCosts,
+    # cost_effective_gradient_boosting.hpp:124): splitting leaf l on
+    # feature j costs tradeoff * penalty_lazy[j] per bagged row of l that
+    # has never traversed a j-split before; `used` rows are marked at each
+    # applied split and carried across trees by the booster.
+    use_lazy = (cfg.use_cegb_lazy and lazy_pen_f is not None
+                and mode == "none")
+    if use_lazy:
+        used0 = (used_init if used_init is not None
+                 else jnp.zeros((n, f), bool))
+        bagged = sample_mask > 0
+
+        def pen_plus(nu):
+            base = 0.0 if gain_penalty_f is None else gain_penalty_f
+            return base + lazy_pen_f * nu
+
     # ---- root ----------------------------------------------------------
     root_hist = psum_(build_histogram(
         bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
@@ -881,10 +913,14 @@ def grow_tree_compact(cfg: GrowerConfig,
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
     state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f_used)
+    root_kw = {}
+    if use_lazy:
+        nu_root = ((~used0) & bagged[:, None]).sum(0).astype(jnp.float32)
+        root_kw["pen_f"] = pen_plus(nu_root)
     root_res = scan_dispatch(root_hist, root_sums, jnp.int32(0),
                              interaction_mask(state.leaf_used[0],
                                               node_feature_mask(0)),
-                             None, extra_bins(0))
+                             None, extra_bins(0), **root_kw)
     state = _store_best(state, 0, root_res)
 
     # histogram pool (reference HistogramPool, feature_histogram.hpp:1095;
@@ -898,8 +934,9 @@ def grow_tree_compact(cfg: GrowerConfig,
     leaf_count = jnp.zeros((L,), jnp.int32).at[0].set(n)
 
     def body(step, carry):
-        state, order, leaf_start, leaf_count, pool, f_aborted, *mono_carry \
+        state, order, leaf_start, leaf_count, pool, f_aborted, *extras \
             = carry
+        mono_carry = extras[:3] if recompute_mono else ()
         if forced is not None:
             # forced-splits prefix (reference ForceSplits,
             # serial_tree_learner.cpp:450-562): steps < S split the
@@ -965,7 +1002,9 @@ def grow_tree_compact(cfg: GrowerConfig,
 
         def do_split(carry):
             state, order, leaf_start, leaf_count, pool, f_aborted, \
-                *mono_carry = carry
+                *extras = carry
+            mono_carry = extras[:3] if recompute_mono else ()
+            used = extras[-1] if use_lazy else None
             new_leaf = state.n_leaves
             feat = state.best_feature[best_leaf]
             thr = state.best_threshold[best_leaf]
@@ -1024,6 +1063,38 @@ def grow_tree_compact(cfg: GrowerConfig,
                 s + n_left)
             leaf_count = leaf_count.at[best_leaf].set(n_left).at[new_leaf].set(
                 n_right)
+
+            if use_lazy:
+                # mark the split leaf's bagged rows as having used `feat`
+                # (reference UpdateLeafBestSplits InsertBitset over
+                # GetIndexOnLeaf(best_leaf)); the segment [s, s+k) still
+                # holds exactly the parent's rows after partitioning
+                def mark(kp):
+                    rows = jax.lax.dynamic_slice(order, (s,), (kp,))
+                    validh = jnp.arange(kp, dtype=jnp.int32) < k
+                    rc_ = jnp.clip(rows, 0, n - 1)
+                    rows_safe = jnp.where(validh & bagged[rc_], rc_, n)
+                    return used.at[rows_safe, feat].set(True, mode="drop")
+
+                midx = jnp.searchsorted(bucket_arr, k, side="left")
+                used = jax.lax.switch(
+                    midx, [functools.partial(mark, kp) for kp in buckets])
+
+                def nu_of(s_, k_):
+                    # bagged not-yet-using-feature row counts per feature
+                    # for one child segment (CalculateOndemandCosts)
+                    def one(kp):
+                        rows = jax.lax.dynamic_slice(order, (s_,), (kp,))
+                        validh = jnp.arange(kp, dtype=jnp.int32) < k_
+                        rc_ = jnp.clip(rows, 0, n - 1)
+                        w = (validh & bagged[rc_])[:, None]
+                        return ((~used[rc_]) & w).sum(0).astype(jnp.float32)
+                    idx = jnp.searchsorted(bucket_arr, k_, side="left")
+                    return jax.lax.switch(
+                        idx, [functools.partial(one, kp) for kp in buckets])
+
+                nu_l = nu_of(s, n_left)
+                nu_r = nu_of(s + n_left, n_right)
 
             # -- smaller child by GLOBAL bagged count (uniform across shards
             #    under shard_map, so every shard subtracts the same way)
@@ -1101,32 +1172,42 @@ def grow_tree_compact(cfg: GrowerConfig,
                     best_is_cat=res_all.is_cat,
                     best_cat_mask=res_all.cat_mask)
                 return (new_state, order, leaf_start, leaf_count, pool,
-                        f_aborted, in_left, in_right, node_mono)
+                        f_aborted, in_left, in_right, node_mono,
+                        *((used,) if use_lazy else ()))
+            kw_l, kw_r = {}, {}
+            if use_lazy:
+                kw_l["pen_f"] = pen_plus(nu_l)
+                kw_r["pen_f"] = pen_plus(nu_r)
             res_l = scan_dispatch(hist_l, new_state.leaf_sum[best_leaf],
                                   depth, fmask,
                                   (new_state.leaf_lo[best_leaf],
-                                   new_state.leaf_hi[best_leaf]), rb)
+                                   new_state.leaf_hi[best_leaf]), rb, **kw_l)
             res_r = scan_dispatch(hist_r, new_state.leaf_sum[new_leaf],
                                   depth, fmask,
                                   (new_state.leaf_lo[new_leaf],
-                                   new_state.leaf_hi[new_leaf]), rb)
+                                   new_state.leaf_hi[new_leaf]), rb, **kw_r)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
-            return (new_state, order, leaf_start, leaf_count, pool, f_aborted)
+            return (new_state, order, leaf_start, leaf_count, pool, f_aborted,
+                    *((used,) if use_lazy else ()))
 
         return jax.lax.cond(found, do_split, lambda c: c,
                             (state, order, leaf_start, leaf_count, pool,
-                             f_aborted, *mono_carry))
+                             f_aborted, *extras))
 
-    mono_init = ()
+    extras_init = ()
     if recompute_mono:
-        mono_init = (jnp.zeros((L - 1, L), bool),   # in_left[node, leaf]
-                     jnp.zeros((L - 1, L), bool),   # in_right[node, leaf]
-                     jnp.zeros((L - 1,), jnp.int8))  # node monotone dir
+        extras_init = (jnp.zeros((L - 1, L), bool),   # in_left[node, leaf]
+                       jnp.zeros((L - 1, L), bool),   # in_right[node, leaf]
+                       jnp.zeros((L - 1,), jnp.int8))  # node monotone dir
+    if use_lazy:
+        extras_init = (*extras_init, used0)
     carry = (state, order, leaf_start, leaf_count, pool, jnp.asarray(False),
-             *mono_init)
-    state, order, leaf_start, leaf_count = jax.lax.fori_loop(
-        0, L - 1, body, carry)[:4]
+             *extras_init)
+    final = jax.lax.fori_loop(0, L - 1, body, carry)
+    state, order, leaf_start, leaf_count = final[:4]
+    if use_lazy:
+        state = state._replace(cegb_used=final[-1])
 
     # -- row -> leaf vector for the train-score fast path (one scatter per
     #    tree; segments -> positions via a tiny sort + searchsorted).
@@ -1282,12 +1363,42 @@ class SerialTreeLearner:
             self.gain_scale = jnp.asarray(fc)
             self.grower_cfg = self.grower_cfg._replace(use_gain_scale=True)
         # CEGB (reference cost_effective_gradient_boosting.hpp): the
-        # per-iteration penalty vector comes from the booster (it tracks
-        # globally-used features for the coupled penalty)
+        # coupled per-feature penalty vector comes from the booster (it
+        # tracks globally-used features); the split penalty scales with
+        # leaf size inside the scan; the lazy per-datapoint penalty carries
+        # a [N, F] used-rows matrix through the compact grower
         self.use_cegb = (config.cegb_penalty_split > 0
-                         or config.cegb_penalty_feature_coupled is not None)
+                         or config.cegb_penalty_feature_coupled is not None
+                         or config.cegb_penalty_feature_lazy is not None)
         if self.use_cegb:
-            self.grower_cfg = self.grower_cfg._replace(use_gain_penalty=True)
+            self.grower_cfg = self.grower_cfg._replace(
+                use_gain_penalty=True,
+                cegb_split_penalty=float(config.cegb_tradeoff
+                                         * config.cegb_penalty_split))
+        self.cegb_lazy_pen = None
+        self._cegb_used = None
+        if config.cegb_penalty_feature_lazy is not None:
+            if config.grow_strategy != "compact":
+                raise ValueError("cegb_penalty_feature_lazy requires "
+                                 "grow_strategy=compact")
+            if (self.grower_cfg.use_monotone
+                    and config.monotone_constraints_method
+                    in ("intermediate", "advanced")):
+                raise ValueError(
+                    "cegb_penalty_feature_lazy cannot be combined with "
+                    "monotone_constraints_method=intermediate/advanced "
+                    "(the full-rescan path has no per-leaf lazy counts)")
+            lazy = list(config.cegb_penalty_feature_lazy)
+            lp = np.zeros(dataset.num_features, np.float32)
+            for inner, real in enumerate(dataset.real_feature_index):
+                if real < len(lazy):
+                    lp[inner] = config.cegb_tradeoff * float(lazy[real])
+            self.cegb_lazy_pen = jnp.asarray(lp)
+            self.grower_cfg = self.grower_cfg._replace(use_cegb_lazy=True)
+            # allocate eagerly so the grower compiles once (None vs array
+            # would be two trace signatures)
+            self._cegb_used = jnp.zeros(
+                (dataset.num_data, dataset.num_features), bool)
         # forced splits (reference forcedsplits_filename): compact grower
         # only — the dense grower keeps no per-leaf histogram pool to gather
         # threshold sums from
@@ -1384,9 +1495,16 @@ class SerialTreeLearner:
         kw = {}
         if self.config.grow_strategy == "compact":
             kw["forced"] = self.forced
+            if self.cegb_lazy_pen is not None:
+                kw["lazy_pen_f"] = self.cegb_lazy_pen
+                kw["used_init"] = self._cegb_used
         state = grow(self.grower_cfg, ds.device_bins, grad, hess,
                      sample_mask, ds.num_bins_per_feature,
                      ds.has_missing_per_feature, self.feature_mask(),
                      self.monotone, key, self.is_cat_f, self.bmap,
                      self.igroups, self.gain_scale, gain_penalty, **kw)
+        if self.cegb_lazy_pen is not None:
+            # carry the used-rows matrix to the next tree (reference
+            # feature_used_in_data_ persists across iterations)
+            self._cegb_used = state.cegb_used
         return state

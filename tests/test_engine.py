@@ -218,3 +218,26 @@ def test_cv(binary_data):
     assert "binary_logloss-mean" in res
     assert len(res["binary_logloss-mean"]) == 10
     assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
+
+
+def test_add_features_from():
+    """reference Dataset::AddFeaturesFrom (dataset.cpp:754): column-merge
+    of two binned datasets; training on the merged set sees both signals."""
+    rng = np.random.RandomState(8)
+    n = 2000
+    Xa = rng.randn(n, 2)
+    Xb = rng.randn(n, 2)
+    y = (Xa[:, 0] + Xb[:, 0] > 0).astype(np.float32)
+    da = lgb.Dataset(Xa, y, free_raw_data=False)
+    db = lgb.Dataset(Xb, free_raw_data=False)
+    da.add_features_from(db)
+    assert da.num_feature() == 4
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, da, 10)
+    used = set()
+    for t in bst._gbdt.models:
+        used.update(int(f) for f in t.split_feature[:t.num_leaves - 1])
+    assert any(f >= 2 for f in used), used   # merged features get used
+    from sklearn.metrics import roc_auc_score
+    X_all = np.hstack([Xa, Xb])
+    assert roc_auc_score(y, bst.predict(X_all)) > 0.9

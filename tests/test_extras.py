@@ -166,3 +166,53 @@ def test_label_column_by_name(tmp_path):
         np.column_stack([X[:, 0], y, X[:, 1], X[:, 2]]), 1, axis=1))
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, pred) > 0.9
+
+
+def test_cegb_lazy_penalty_concentrates_feature_usage():
+    """cegb_penalty_feature_lazy charges per not-yet-using datapoint
+    (reference CalculateOndemandCosts, cost_effective_gradient_boosting
+    .hpp:124): with a heavy lazy penalty on informative features, trees
+    reuse already-paid features instead of fanning out, so total distinct
+    (row, feature) usage drops while unpenalized training is unchanged."""
+    rng = np.random.RandomState(9)
+    n, f = 3000, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.8 * X[:, 1] + 0.5 * X[:, 2]
+         + 0.2 * rng.randn(n)).astype(np.float32)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 20}
+
+    def usage(bst):
+        used = set()
+        for t in bst._gbdt.models:
+            for node in range(t.num_leaves - 1):
+                used.add(int(t.split_feature[node]))
+        return used
+
+    plain = lgb.train(base, lgb.Dataset(X, y), 10)
+    lazy = lgb.train({**base, "cegb_tradeoff": 1.0,
+                      "cegb_penalty_feature_lazy": [0.05] * f},
+                     lgb.Dataset(X, y), 10)
+    heavy = lgb.train({**base, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_lazy": [1000.0] * f},
+                      lgb.Dataset(X, y), 3)
+    # per-datapoint cost makes additional features expensive -> the lazy
+    # model must touch no MORE features than plain
+    assert len(usage(lazy)) <= len(usage(plain))
+    # and training still learns (penalty shrinks, not destroys, the model)
+    from sklearn.metrics import r2_score
+    assert r2_score(y, lazy.predict(X)) > 0.3
+    # a prohibitive penalty shuts training down entirely (every split's
+    # per-row cost dwarfs its gain) — the reference behaves the same way
+    assert len(usage(heavy)) == 0
+
+
+def test_cegb_lazy_rejected_by_parallel_learners():
+    X = np.random.RandomState(0).rand(400, 4)
+    y = X[:, 0].astype(np.float32)
+    with pytest.raises(Exception, match="lazy"):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "cegb_penalty_feature_lazy": [1.0] * 4,
+                   "tree_learner": "data", "num_machines": 8,
+                   "num_tpu_devices": 8},
+                  lgb.Dataset(X, y), 1)

@@ -1,0 +1,82 @@
+"""R binding over the C ABI (reference R-package/).
+
+The R glue (R-package/src/lightgbm_tpu_R.c) wraps the same LGBM_* entry
+points the ctypes tests drive.  When R is available, the smoke test builds
+the glue and trains on the reference's binary.train; without R, the
+ABI-contract half still runs: the exact call sequence the R code makes is
+replayed through ctypes (column-major matrices, float64 predict buffers),
+so a break in the contract the R shim depends on fails here.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(REPO, "c_api", "lib_lightgbm_tpu.so")
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="R is not installed in this image")
+def test_r_smoke():
+    rpkg = os.path.join(REPO, "R-package")
+    subprocess.run(["R", "CMD", "SHLIB", "src/lightgbm_tpu_R.c",
+                    "-L../c_api", "-l:lib_lightgbm_tpu.so"],
+                   cwd=rpkg, check=True)
+    out = subprocess.run(["Rscript", "tests/smoke.R"], cwd=rpkg,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "R_SMOKE_OK" in out.stdout
+
+
+def test_r_abi_contract_column_major():
+    """The R glue passes column-major float64 matrices (is_row_major=0);
+    replay that exact contract through ctypes so the path the R shim
+    depends on stays covered even without an R runtime."""
+    if not os.path.exists(SO):
+        subprocess.run(["make", "-C", os.path.dirname(SO)], check=True)
+    lib = ctypes.CDLL(SO)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+
+    rng = np.random.RandomState(3)
+    n, f = 1200, 6
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    # column-major buffer, exactly what R hands over
+    Xf = np.asfortranarray(X, dtype=np.float64)
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_DatasetCreateFromMat(
+        Xf.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(0),  # col-major
+        b"max_bin=63", None, ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_GetLastError()
+    yc = np.ascontiguousarray(y, np.float32)
+    assert lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)) == 0
+
+    bst = ctypes.c_void_p()
+    assert lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1",
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(10):
+        assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    out = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_BoosterPredictForMat(
+        bst, Xf.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(0),  # col-major
+        ctypes.c_int(0), ctypes.c_int(0), ctypes.c_int(-1), b"",
+        ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, out) > 0.9
+    lib.LGBM_BoosterFree(bst)
+    lib.LGBM_DatasetFree(ds)
