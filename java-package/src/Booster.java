@@ -1,0 +1,64 @@
+package lightgbm_tpu;
+
+/**
+ * Java interface to the lightgbm_tpu framework — the role the reference
+ * fills with SWIG-generated wrappers (swig/lightgbmlib.i): train, predict,
+ * save and load over the stable C ABI (c_api/lib_lightgbm_tpu.so).
+ *
+ * Usage:
+ * <pre>
+ *   long ds = Booster.datasetCreate(flatRowMajorX, nrow, ncol, "max_bin=63");
+ *   Booster.datasetSetLabel(ds, labels);
+ *   long bst = Booster.boosterCreate(ds, "objective=binary num_leaves=15");
+ *   for (int i = 0; i &lt; 10; i++) Booster.updateOneIter(bst);
+ *   double[] preds = Booster.predictForMat(bst, flatRowMajorX, nrow, ncol,
+ *                                          false);
+ *   Booster.saveModel(bst, "model.txt");   // reference text format
+ * </pre>
+ *
+ * Build: compile src/lightgbm_tpu_jni.c against any JDK (see its header)
+ * and {@code System.loadLibrary("lightgbm_tpu_jni")}.
+ */
+public final class Booster {
+    static {
+        System.loadLibrary("lightgbm_tpu_jni");
+    }
+
+    private Booster() {}
+
+    /** LGBM_DatasetCreateFromMat over a row-major float64 matrix. */
+    public static native long datasetCreate(double[] data, int nrow,
+                                            int ncol, String params);
+
+    /** LGBM_DatasetSetField("label"). */
+    public static native void datasetSetLabel(long dataset, float[] label);
+
+    /** LGBM_DatasetFree. */
+    public static native void datasetFree(long dataset);
+
+    /** LGBM_BoosterCreate. */
+    public static native long boosterCreate(long dataset, String params);
+
+    /** LGBM_BoosterUpdateOneIter; returns true when no further splits. */
+    public static native boolean updateOneIter(long booster);
+
+    /**
+     * LGBM_BoosterPredictForMat; returns nrow values (nrow * numClass for
+     * multiclass models, class-minor).
+     */
+    public static native double[] predictForMat(long booster, double[] data,
+                                                int nrow, int ncol,
+                                                boolean rawScore);
+
+    /** LGBM_BoosterSaveModel (reference-compatible model text). */
+    public static native void saveModel(long booster, String filename);
+
+    /** LGBM_BoosterCreateFromModelfile. */
+    public static native long loadModel(String filename);
+
+    /** LGBM_BoosterNumberOfTotalModel. */
+    public static native int numTotalModel(long booster);
+
+    /** LGBM_BoosterFree. */
+    public static native void boosterFree(long booster);
+}

@@ -1,0 +1,184 @@
+/* JNI glue for the lightgbm_tpu C ABI — the role of the reference's SWIG
+ * layer (swig/lightgbmlib.i generates Java wrappers over c_api.h; here the
+ * handful of entry points the Java API class needs are hand-written, which
+ * is smaller and carries no SWIG build dependency).
+ *
+ * Build (any JDK; the rpath makes the C ABI library resolvable at load
+ * time without LD_LIBRARY_PATH):
+ *   gcc -shared -fPIC -I"$JAVA_HOME/include" -I"$JAVA_HOME/include/linux" \
+ *       src/lightgbm_tpu_jni.c -L../c_api -l:lib_lightgbm_tpu.so \
+ *       -Wl,-rpath,"$(realpath ../c_api)" -o liblightgbm_tpu_jni.so
+ */
+#include <jni.h>
+#include <stdint.h>
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+extern const char* LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t, int,
+                                     const char*, const DatasetHandle,
+                                     DatasetHandle*);
+extern int LGBM_DatasetSetField(DatasetHandle, const char*, const void*, int,
+                                int);
+extern int LGBM_DatasetFree(DatasetHandle);
+extern int LGBM_BoosterCreate(const DatasetHandle, const char*,
+                              BoosterHandle*);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int*);
+extern int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int, int32_t,
+                                     int32_t, int, int, int, int, const char*,
+                                     int64_t*, double*);
+extern int LGBM_BoosterSaveModel(BoosterHandle, int, int, int, const char*);
+extern int LGBM_BoosterCreateFromModelfile(const char*, int*, BoosterHandle*);
+extern int LGBM_BoosterNumberOfTotalModel(BoosterHandle, int*);
+extern int LGBM_BoosterNumModelPerIteration(BoosterHandle, int*);
+extern int LGBM_BoosterFree(BoosterHandle);
+
+static void throw_last_error(JNIEnv* env) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  if (cls != NULL) {
+    (*env)->ThrowNew(env, cls, LGBM_GetLastError());
+  }
+}
+
+JNIEXPORT jlong JNICALL
+Java_lightgbm_1tpu_Booster_datasetCreate(JNIEnv* env, jclass cls,
+                                         jdoubleArray data, jint nrow,
+                                         jint ncol, jstring params) {
+  jdouble* buf = (*env)->GetDoubleArrayElements(env, data, NULL);
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  DatasetHandle h = NULL;
+  int rc = LGBM_DatasetCreateFromMat(buf, 1 /* float64 */, nrow, ncol,
+                                     1 /* row-major */, p, NULL, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  (*env)->ReleaseDoubleArrayElements(env, data, buf, JNI_ABORT);
+  if (rc != 0) {
+    throw_last_error(env);
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL
+Java_lightgbm_1tpu_Booster_datasetSetLabel(JNIEnv* env, jclass cls,
+                                           jlong handle, jfloatArray label) {
+  jsize n = (*env)->GetArrayLength(env, label);
+  jfloat* buf = (*env)->GetFloatArrayElements(env, label, NULL);
+  int rc = LGBM_DatasetSetField((DatasetHandle)(intptr_t)handle, "label",
+                                buf, (int)n, 0 /* float32 */);
+  (*env)->ReleaseFloatArrayElements(env, label, buf, JNI_ABORT);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT void JNICALL
+Java_lightgbm_1tpu_Booster_datasetFree(JNIEnv* env, jclass cls,
+                                       jlong handle) {
+  LGBM_DatasetFree((DatasetHandle)(intptr_t)handle);
+}
+
+JNIEXPORT jlong JNICALL
+Java_lightgbm_1tpu_Booster_boosterCreate(JNIEnv* env, jclass cls,
+                                         jlong dataset, jstring params) {
+  const char* p = (*env)->GetStringUTFChars(env, params, NULL);
+  BoosterHandle h = NULL;
+  int rc = LGBM_BoosterCreate((DatasetHandle)(intptr_t)dataset, p, &h);
+  (*env)->ReleaseStringUTFChars(env, params, p);
+  if (rc != 0) {
+    throw_last_error(env);
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jboolean JNICALL
+Java_lightgbm_1tpu_Booster_updateOneIter(JNIEnv* env, jclass cls,
+                                         jlong handle) {
+  int fin = 0;
+  if (LGBM_BoosterUpdateOneIter((BoosterHandle)(intptr_t)handle, &fin) != 0) {
+    throw_last_error(env);
+  }
+  return fin ? JNI_TRUE : JNI_FALSE;
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_lightgbm_1tpu_Booster_predictForMat(JNIEnv* env, jclass cls,
+                                         jlong handle, jdoubleArray data,
+                                         jint nrow, jint ncol,
+                                         jboolean rawScore) {
+  int k = 1;
+  if (LGBM_BoosterNumModelPerIteration((BoosterHandle)(intptr_t)handle, &k)
+      != 0) {
+    throw_last_error(env);
+    return NULL;
+  }
+  if (k < 1) k = 1;
+  long total = (long)nrow * k;
+  if (total > 0x7fffffffL) {   /* jsize is jint; refuse instead of wrapping */
+    jclass ex = (*env)->FindClass(env, "java/lang/IllegalArgumentException");
+    if (ex != NULL) (*env)->ThrowNew(env, ex, "nrow * num_class > 2^31-1");
+    return NULL;
+  }
+  jdoubleArray out = (*env)->NewDoubleArray(env, (jsize)total);
+  if (out == NULL) return NULL;          /* OutOfMemoryError pending */
+  jdouble* buf = (*env)->GetDoubleArrayElements(env, data, NULL);
+  jdouble* obuf = (*env)->GetDoubleArrayElements(env, out, NULL);
+  if (buf == NULL || obuf == NULL) {
+    if (buf != NULL)
+      (*env)->ReleaseDoubleArrayElements(env, data, buf, JNI_ABORT);
+    if (obuf != NULL)
+      (*env)->ReleaseDoubleArrayElements(env, out, obuf, JNI_ABORT);
+    return NULL;                         /* exception pending */
+  }
+  int64_t out_len = 0;
+  int rc = LGBM_BoosterPredictForMat(
+      (BoosterHandle)(intptr_t)handle, buf, 1 /* float64 */, nrow, ncol,
+      1 /* row-major */, rawScore ? 1 : 0, 0, -1, "", &out_len, obuf);
+  (*env)->ReleaseDoubleArrayElements(env, data, buf, JNI_ABORT);
+  (*env)->ReleaseDoubleArrayElements(env, out, obuf, 0);
+  if (rc != 0) {
+    throw_last_error(env);
+    return NULL;
+  }
+  return out;
+}
+
+JNIEXPORT void JNICALL
+Java_lightgbm_1tpu_Booster_saveModel(JNIEnv* env, jclass cls, jlong handle,
+                                     jstring filename) {
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  int rc = LGBM_BoosterSaveModel((BoosterHandle)(intptr_t)handle, 0, -1, 0, f);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  if (rc != 0) throw_last_error(env);
+}
+
+JNIEXPORT jlong JNICALL
+Java_lightgbm_1tpu_Booster_loadModel(JNIEnv* env, jclass cls,
+                                     jstring filename) {
+  const char* f = (*env)->GetStringUTFChars(env, filename, NULL);
+  BoosterHandle h = NULL;
+  int n_iter = 0;
+  int rc = LGBM_BoosterCreateFromModelfile(f, &n_iter, &h);
+  (*env)->ReleaseStringUTFChars(env, filename, f);
+  if (rc != 0) {
+    throw_last_error(env);
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jint JNICALL
+Java_lightgbm_1tpu_Booster_numTotalModel(JNIEnv* env, jclass cls,
+                                         jlong handle) {
+  int n = 0;
+  if (LGBM_BoosterNumberOfTotalModel((BoosterHandle)(intptr_t)handle, &n)
+      != 0) {
+    throw_last_error(env);
+  }
+  return n;
+}
+
+JNIEXPORT void JNICALL
+Java_lightgbm_1tpu_Booster_boosterFree(JNIEnv* env, jclass cls,
+                                       jlong handle) {
+  LGBM_BoosterFree((BoosterHandle)(intptr_t)handle);
+}
