@@ -368,11 +368,22 @@ class Tree:
                         "leaf_weight": float(self.leaf_weight[leaf]),
                         "leaf_count": int(self.leaf_count[leaf])}
             dt = int(self.decision_type[node])
+            if dt & K_CATEGORICAL_MASK:
+                # reference Tree::ToJSON: "||"-joined category list
+                cat_idx = int(self.threshold_in_bin[node])
+                lo = self.cat_boundaries[cat_idx]
+                hi = self.cat_boundaries[cat_idx + 1]
+                cats = [str(32 * wi + b)
+                        for wi, w in enumerate(self.cat_threshold[lo:hi])
+                        for b in range(32) if (int(w) >> b) & 1]
+                thr_json = "||".join(cats)
+            else:
+                thr_json = float(self.threshold[node])
             out = {
                 "split_index": int(node),
                 "split_feature": int(self.split_feature[node]),
                 "split_gain": float(self.split_gain[node]),
-                "threshold": float(self.threshold[node]),
+                "threshold": thr_json,
                 "decision_type": "==" if dt & K_CATEGORICAL_MASK else "<=",
                 "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
                 "missing_type": _MISSING_NAME[(dt >> 2) & 3],

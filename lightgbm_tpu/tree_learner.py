@@ -155,7 +155,10 @@ class ForcedSplits(NamedTuple):
     """
     leaf: jnp.ndarray   # [S] int32
     feat: jnp.ndarray   # [S] int32 (inner feature index)
-    thr: jnp.ndarray    # [S] int32 (threshold bin)
+    thr: jnp.ndarray    # [S] int32 (threshold bin; the single left-going
+    #                     category bin for categorical entries)
+    is_cat: jnp.ndarray  # [S] bool (categorical one-hot forced split,
+    #                      reference GatherInfoForThresholdCategorical)
 
 
 def parse_forced_splits(spec, dataset, max_splits: int):
@@ -164,9 +167,9 @@ def parse_forced_splits(spec, dataset, max_splits: int):
     at the start of every tree; here the walk happens once, up front).
 
     ``spec`` is a path to the JSON file (config forcedsplits_filename) or an
-    already-parsed dict.  Numerical features only — the reference also
-    forces categorical splits; unsupported nodes end the schedule early with
-    a warning, mirroring the reference's abort-on-bad-node behavior.
+    already-parsed dict.  Numerical entries split at the threshold's bin;
+    categorical entries are one-hot splits sending the threshold's single
+    category left (reference GatherInfoForThresholdCategorical).
     """
     import json as _json
     from collections import deque
@@ -183,7 +186,7 @@ def parse_forced_splits(spec, dataset, max_splits: int):
         return None
     inv = {real: inner for inner, real in
            enumerate(dataset.real_feature_index)}
-    leaves, feats, thrs = [], [], []
+    leaves, feats, thrs, cats = [], [], [], []
     q = deque([(root, 0)])
     s = 0
     while q and s < max_splits:
@@ -195,15 +198,16 @@ def parse_forced_splits(spec, dataset, max_splits: int):
             break
         inner = inv[real]
         mapper = dataset.feature_mappers[inner]
-        if mapper.bin_type == BinType.CATEGORICAL:
-            warning("categorical forced splits are not supported; "
-                    "stopping forced splits here")
-            break
+        is_cat = mapper.bin_type == BinType.CATEGORICAL
+        # numerical: threshold value -> bin; categorical: the threshold IS
+        # the single left-going category (reference
+        # GatherInfoForThresholdCategorical one-hot semantics)
         tbin = int(np.asarray(mapper.value_to_bin(
             np.asarray([float(node["threshold"])])))[0])
         leaves.append(leaf)
         feats.append(inner)
         thrs.append(tbin)
+        cats.append(is_cat)
         left_leaf, right_leaf = leaf, s + 1
         for key, child_leaf in (("left", left_leaf), ("right", right_leaf)):
             ch = node.get(key)
@@ -214,17 +218,21 @@ def parse_forced_splits(spec, dataset, max_splits: int):
         return None
     return ForcedSplits(leaf=jnp.asarray(leaves, jnp.int32),
                         feat=jnp.asarray(feats, jnp.int32),
-                        thr=jnp.asarray(thrs, jnp.int32))
+                        thr=jnp.asarray(thrs, jnp.int32),
+                        is_cat=jnp.asarray(cats, bool))
 
 
 def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
                          num_bins_f, has_missing_f,
-                         bmap: Optional[BundleMap]) -> SplitResult:
+                         bmap: Optional[BundleMap],
+                         f_is_cat=None) -> SplitResult:
     """Gather split sums at a forced (feature, threshold-bin) from the leaf's
     pooled histogram — reference GatherInfoForThresholdNumerical
     (feature_histogram.hpp:546-632): the right side accumulates bins above
     the threshold EXCLUDING the missing bin, left = parent - right (missing
-    lands left; ``output->default_left = true`` unconditionally)."""
+    lands left; ``output->default_left = true`` unconditionally).
+    Categorical entries are one-hot splits: the single category bin
+    ``f_thr`` goes left (GatherInfoForThresholdCategorical, :648-710)."""
     if cfg.use_efb:
         hist = expand_bundle_hist(pool_hist, sums, bmap, num_bins_f,
                                   cfg.num_bins)
@@ -237,8 +245,13 @@ def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
     has_na = has_missing_f[f_feat]
     is_missing_bin = has_na & (binv == nb - 1)
     right_sel = (binv > f_thr) & (binv < nb) & ~is_missing_bin
-    right = (h * right_sel[:, None].astype(h.dtype)).sum(axis=0)
-    left = sums - right
+    right_num = (h * right_sel[:, None].astype(h.dtype)).sum(axis=0)
+    left_num = sums - right_num
+    if f_is_cat is None:
+        f_is_cat = jnp.asarray(False)
+    left_cat = h[jnp.clip(f_thr, 0, B - 1)]
+    left = jnp.where(f_is_cat, left_cat, left_num)
+    right = sums - left
     l1, l2, mds = cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
     parent_gain = leaf_gain(sums[0], sums[1], l1, l2, mds)
     gain = (leaf_gain(left[0], left[1], l1, l2, mds)
@@ -246,18 +259,20 @@ def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
             - parent_gain - cfg.min_gain_to_split)
     ok = ((left[2] > 0) & (right[2] > 0)
           & (left[1] > cfg.min_sum_hessian_in_leaf)
-          & (right[1] > cfg.min_sum_hessian_in_leaf))
+          & (right[1] > cfg.min_sum_hessian_in_leaf)
+          # reference rejects cat thresholds outside [1, num_bin)
+          & jnp.where(f_is_cat, (f_thr >= 1) & (f_thr < nb), True))
     gain = jnp.where(ok, gain, _NEG_INF)
     return SplitResult(
         gain=gain.astype(sums.dtype),
         feature=f_feat, threshold_bin=f_thr,
-        default_left=jnp.asarray(True),
+        default_left=~f_is_cat,     # numerical: missing left; cat: false
         left_sum_g=left[0], left_sum_h=left[1], left_count=left[2],
         right_sum_g=right[0], right_sum_h=right[1], right_count=right[2],
         left_output=leaf_output(left[0], left[1], l1, l2, mds),
         right_output=leaf_output(right[0], right[1], l1, l2, mds),
-        is_cat=jnp.asarray(False),
-        cat_mask=jnp.zeros((B,), bool))
+        is_cat=f_is_cat,
+        cat_mask=(binv == f_thr) & f_is_cat)
 
 
 def _child_weights(grad_m, hess_m, mask, left_m, right_m):
@@ -962,7 +977,8 @@ def grow_tree_compact(cfg: GrowerConfig,
                 lf = jnp.clip(gfeat - owner * jnp.int32(f), 0, f - 1)
                 res_local = _forced_split_result(
                     cfg, pool[f_leaf], state.leaf_sum[f_leaf], lf,
-                    forced.thr[si], num_bins_f, has_missing_f, bmap)
+                    forced.thr[si], num_bins_f, has_missing_f, bmap,
+                    f_is_cat=forced.is_cat[si])
                 is_owner = me == owner
 
                 def _bcast(x):
@@ -979,7 +995,8 @@ def grow_tree_compact(cfg: GrowerConfig,
                 res_f = _forced_split_result(cfg, pool[f_leaf],
                                              state.leaf_sum[f_leaf],
                                              forced.feat[si], forced.thr[si],
-                                             num_bins_f, has_missing_f, bmap)
+                                             num_bins_f, has_missing_f, bmap,
+                                             f_is_cat=forced.is_cat[si])
             # reference gate (feature_histogram.hpp:606): a forced split
             # whose gain is not positive is "ignored since the gain getting
             # worse", which then aborts the remaining schedule

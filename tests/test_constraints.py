@@ -230,3 +230,29 @@ def test_monotone_data_parallel_recompute():
         base = rng.rand(3)
         assert _is_monotone(bst, 0, +1, base)
         assert _is_monotone(bst, 1, -1, base)
+
+
+def test_forced_splits_categorical(tmp_path):
+    """Categorical forced splits are one-hot: the scheduled category goes
+    left (reference GatherInfoForThresholdCategorical,
+    feature_histogram.hpp:648)."""
+    rng = np.random.RandomState(13)
+    n = 4000
+    cat = rng.randint(0, 6, n)
+    X = np.column_stack([cat.astype(np.float64), rng.rand(n, 2)])
+    y = (0.8 * (cat == 3) + X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    fs = {"feature": 0, "threshold": 3}       # category 3 left
+    path = str(tmp_path / "forced.json")
+    with open(path, "w") as fh:
+        json.dump(fs, fh)
+    params = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+              "min_data_in_leaf": 5, "forcedsplits_filename": path,
+              "categorical_feature": [0]}
+    bst = lgb.train(params, lgb.Dataset(X, y,
+                                        categorical_feature=[0]), 2)
+    for tree in bst.dump_model()["tree_info"]:
+        root = tree["tree_structure"]
+        assert root["split_feature"] == 0
+        assert root["decision_type"] == "=="
+        # the left branch holds exactly category 3
+        assert str(root["threshold"]).split("||") == ["3"]
