@@ -196,6 +196,138 @@ LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
   return run_simple("dataset_set_field", args, nullptr);
 }
 
+namespace {
+
+// Copy a python list[str] into the reference's string-array out-params
+// (len slots of buffer_len chars each; out_buffer_len reports the longest
+// string + NUL so callers can retry with bigger buffers, c_api.h:247).
+int fill_string_array(PyObject* list, int len, int* out_len,
+                      size_t buffer_len, size_t* out_buffer_len,
+                      char** out_strs) {
+  Py_ssize_t n = PyList_Size(list);
+  *out_len = static_cast<int>(n);
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t sz = 0;
+    const char* s = PyUnicode_AsUTF8AndSize(PyList_GetItem(list, i), &sz);
+    if (s == nullptr) return -1;
+    if (static_cast<size_t>(sz) + 1 > need) need = sz + 1;
+    if (i < len && out_strs != nullptr && out_strs[i] != nullptr &&
+        buffer_len > 0) {
+      size_t ncopy = static_cast<size_t>(sz) + 1 <= buffer_len
+                         ? static_cast<size_t>(sz) + 1
+                         : buffer_len;
+      std::memcpy(out_strs[i], s, ncopy);
+      out_strs[i][ncopy - 1] = '\0';
+    }
+  }
+  *out_buffer_len = need;
+  return 0;
+}
+
+}  // namespace
+
+LGBM_EXPORT int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                            const char** feature_names,
+                                            int num_element) {
+  Gil gil;
+  PyObject* names = PyList_New(num_element);
+  if (names == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  for (int i = 0; i < num_element; ++i) {
+    PyObject* s = feature_names[i] != nullptr
+                      ? PyUnicode_FromString(feature_names[i])
+                      : nullptr;
+    if (s == nullptr) {
+      Py_DECREF(names);
+      if (!PyErr_Occurred()) {
+        set_error("feature name is NULL or not valid UTF-8");
+        return -1;
+      }
+      capture_py_error();
+      return -1;
+    }
+    PyList_SetItem(names, i, s);
+  }
+  PyObject* args = Py_BuildValue("(ON)", static_cast<PyObject*>(handle),
+                                 names);
+  return run_simple("dataset_set_feature_names", args, nullptr);
+}
+
+LGBM_EXPORT int LGBM_DatasetGetFeatureNames(
+    DatasetHandle handle, const int len, int* out_len,
+    const size_t buffer_len, size_t* out_buffer_len, char** feature_names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("dataset_get_feature_names", args, &res) != 0) return -1;
+  int rc = fill_string_array(res, len, out_len, buffer_len, out_buffer_len,
+                             feature_names);
+  Py_DECREF(res);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalCounts(BoosterHandle handle,
+                                          int* out_len) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_get_eval_counts", args, &res) != 0) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalNames(
+    BoosterHandle handle, const int len, int* out_len,
+    const size_t buffer_len, size_t* out_buffer_len, char** out_strs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* res = nullptr;
+  if (run_simple("booster_get_eval_names", args, &res) != 0) return -1;
+  int rc = fill_string_array(res, len, out_len, buffer_len, out_buffer_len,
+                             out_strs);
+  Py_DECREF(res);
+  if (rc != 0) capture_py_error();
+  return rc;
+}
+
+LGBM_EXPORT int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                              int num_iteration,
+                                              int importance_type,
+                                              double* out_results) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)", static_cast<PyObject*>(handle),
+                                 num_iteration, importance_type);
+  PyObject* res = nullptr;
+  if (run_simple("booster_feature_importance", args, &res) != 0) return -1;
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0) {
+    Py_DECREF(res);
+    capture_py_error();
+    return -1;
+  }
+  std::memcpy(out_results, buf, static_cast<size_t>(nbytes));
+  Py_DECREF(res);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForFile(
+    BoosterHandle handle, const char* data_filename, int data_has_header,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, const char* result_filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Osiiiiss)", static_cast<PyObject*>(handle), data_filename,
+      data_has_header, predict_type, start_iteration, num_iteration,
+      parameter ? parameter : "", result_filename);
+  return run_simple("booster_predict_for_file", args, nullptr);
+}
+
 LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
                                             DatasetHandle source) {
   Gil gil;

@@ -145,6 +145,20 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        if (isinstance(data, str) and cfg0.two_round
+                and self.reference is None and self._used_indices is None):
+            # two_round (reference config.h two_round / TwoPassLoading):
+            # stream the file twice, binning chunks straight into the
+            # packed matrix — the raw float64 matrix never materializes
+            self._handle = TrainDataset.from_text_two_round(
+                data, cfg0,
+                categorical_features=self._resolve_categoricals(0),
+                weight=self.weight, group=self.group,
+                init_score=self.init_score,
+                label_override=self.label)
+            if self.free_raw_data:
+                self.data = None
+            return self
         if isinstance(data, str):
             from .io.parser import load_svmlight_or_csv
             arr, label = load_svmlight_or_csv(data)

@@ -20,6 +20,9 @@ __all__ = [
     "dataset_create_from_csr", "dataset_create_from_csc",
     "dataset_set_field", "dataset_num_data", "dataset_num_feature",
     "dataset_add_features_from",
+    "dataset_set_feature_names", "dataset_get_feature_names",
+    "booster_get_eval_counts", "booster_get_eval_names",
+    "booster_feature_importance", "booster_predict_for_file",
     "booster_create", "booster_create_from_modelfile", "booster_add_valid",
     "booster_update_one_iter", "booster_update_one_iter_custom",
     "booster_rollback_one_iter",
@@ -117,6 +120,75 @@ def dataset_create_from_csc(indptr_mat, indices_mat, data_mat, nindptr: int,
     return Dataset(csc, params=_parse_params(parameters),
                    reference=reference if isinstance(reference, Dataset)
                    else None, free_raw_data=False)
+
+
+def dataset_set_feature_names(ds: Dataset, names) -> None:
+    """reference LGBM_DatasetSetFeatureNames."""
+    ds._feature_names = [str(n) for n in names]
+
+
+def dataset_get_feature_names(ds: Dataset):
+    """reference LGBM_DatasetGetFeatureNames."""
+    return list(ds.get_feature_names())
+
+
+def booster_get_eval_counts(bst: Booster) -> int:
+    """reference LGBM_BoosterGetEvalCounts."""
+    return len(booster_get_eval_names(bst))
+
+
+def booster_get_eval_names(bst: Booster):
+    """reference LGBM_BoosterGetEvalNames: metric names in eval order."""
+    names = []
+    for m in bst._gbdt.train_metrics:
+        n = getattr(m, "name", None)
+        if isinstance(n, (list, tuple)):
+            names.extend(str(x) for x in n)
+        elif n:
+            names.append(str(n))
+    return names
+
+
+def booster_feature_importance(bst: Booster, num_iteration: int,
+                               importance_type: int) -> bytes:
+    """reference LGBM_BoosterFeatureImportance (0=split, 1=gain)."""
+    kind = "gain" if importance_type == 1 else "split"
+    imp = bst.feature_importance(importance_type=kind,
+                                 iteration=num_iteration)
+    return np.ascontiguousarray(imp, np.float64).tobytes()
+
+
+def booster_predict_for_file(bst: Booster, data_filename: str,
+                             data_has_header: int, predict_type: int,
+                             start_iteration: int, num_iteration: int,
+                             parameter: str, result_filename: str) -> None:
+    """reference LGBM_BoosterPredictForFile (c_api.cpp:1748): predict a
+    text file and write one result row per line."""
+    if parameter.strip():
+        from .log import log_warning
+        log_warning("LGBM_BoosterPredictForFile: the `parameter` string is "
+                    f"accepted for compatibility but ignored here "
+                    f"({parameter!r}); pass prediction params at predict "
+                    "call sites instead")
+    from .io.parser import load_svmlight_or_csv
+    X, _ = load_svmlight_or_csv(data_filename,
+                                header=bool(data_has_header))
+    kwargs = {}
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        kwargs["raw_score"] = True
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        kwargs["pred_leaf"] = True
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        kwargs["pred_contrib"] = True
+    out = bst.predict(X, start_iteration=start_iteration,
+                      num_iteration=num_iteration, **kwargs)
+    out = np.atleast_2d(np.asarray(out))
+    if out.shape[0] == 1 and X.shape[0] != 1:
+        out = out.T
+    with open(result_filename, "w") as fh:
+        for row in out:
+            fh.write("\t".join(repr(float(v)) for v in np.ravel(row)))
+            fh.write("\n")
 
 
 def dataset_add_features_from(target: Dataset, source: Dataset) -> None:

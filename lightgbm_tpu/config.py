@@ -318,7 +318,7 @@ class Config:
 
     # accepted for reference-config compatibility but NOT implemented —
     # setting them must warn, never silently change semantics (VERDICT r3):
-    _UNWIRED = ("two_round",)
+    _UNWIRED = ()
 
     def _warn_unwired(self, merged: Dict[str, Any]) -> None:
         from .log import log_warning

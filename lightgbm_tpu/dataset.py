@@ -218,6 +218,91 @@ class TrainDataset:
         return self
 
     @classmethod
+    def from_text_two_round(cls, path: str, config: Config,
+                            categorical_features=None, weight=None,
+                            group=None, init_score=None,
+                            label_override=None) -> "TrainDataset":
+        """two_round loading (reference config two_round / dataset_loader
+        .cpp:182 TwoPassLoading): pass 1 streams the file to count rows and
+        sample for bin finding, pass 2 streams again binning each chunk
+        straight into the packed uint8 matrix.  Peak memory = binned
+        matrix + one chunk; the raw float64 matrix never materializes."""
+        from .io.parser import LineParser
+
+        # ---- pass 1: count + chunk-vectorized reservoir sample ---------
+        # (Algorithm R per chunk: rows are copied out so no 64k-row raw
+        # chunk stays pinned by a view)
+        rng = np.random.RandomState(config.data_random_seed)
+        target = config.bin_construct_sample_cnt
+        sample = None
+        labels = []
+        n = 0
+        for Xc, yc in LineParser(path):
+            labels.append(yc)
+            m = len(yc)
+            take = 0
+            if sample is None or len(sample) < target:
+                have = 0 if sample is None else len(sample)
+                take = min(target - have, m)
+                block = np.array(Xc[:take], np.float64)   # copy, not view
+                sample = block if sample is None else np.concatenate(
+                    [sample, block])
+            if take < m:
+                # vectorized replacement: row (n + i) survives with
+                # probability target / (n + i + 1), into a uniform slot
+                idx_global = n + np.arange(take, m) + 1
+                accept = rng.rand(m - take) < (target / idx_global)
+                if accept.any():
+                    slots = rng.randint(0, target, size=int(accept.sum()))
+                    sample[slots] = Xc[take:][accept]
+            n += m
+        if n == 0:
+            raise ValueError(f"no rows in {path}")
+        label = np.concatenate(labels)
+        del labels
+
+        cats = sorted(set(categorical_features or ()))
+        min_split = (config.min_data_in_leaf
+                     if config.feature_pre_filter else 0)
+        mappers = find_bin_mappers(
+            sample, max_bin=config.max_bin,
+            min_data_in_bin=config.min_data_in_bin,
+            categorical_features=cats, use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            min_split_data=min_split,
+            max_bin_by_feature=config.max_bin_by_feature,
+            feature_pre_filter=config.feature_pre_filter,
+            forced_bins_path=config.forcedbins_filename)
+        num_features = sample.shape[1]
+        del sample
+
+        # ---- pass 2: stream chunks into the packed bin matrix ----------
+        real_index = [i for i, m in enumerate(mappers) if not m.is_trivial]
+        used = [mappers[i] for i in real_index]
+        if not used:
+            raise ValueError("no usable (non-trivial) features in data")
+        max_nb = max(m.num_bin for m in used)
+        bins = np.empty((n, len(used)),
+                        np.uint8 if max_nb <= 256 else np.int32)
+        row0 = 0
+        for Xc, _ in LineParser(path):
+            for j, (real, m) in enumerate(zip(real_index, used)):
+                bins[row0:row0 + len(Xc), j] = m.value_to_bin(Xc[:, real])
+            row0 += len(Xc)
+
+        if label_override is not None:
+            label = np.asarray(label_override, np.float32).reshape(-1)
+        metadata = Metadata(label, weight, group, init_score)
+        self = cls.__new__(cls)
+        self.config = config
+        self.metadata = metadata
+        self.all_bin_mappers = mappers
+        self.raw_device = None
+        self.num_total_features = num_features
+        self._finish_init(bins, mappers, real_index, num_features, metadata)
+        return self
+
+    @classmethod
     def from_rank_shard(cls, X_local: np.ndarray, y_local: np.ndarray,
                         config: Config, categorical_features=None,
                         weight_local=None,

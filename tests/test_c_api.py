@@ -227,3 +227,77 @@ def test_c_api_error_reporting(lib):
                                         ctypes.byref(ds))
     assert rc == -1
     assert b"" != lib.LGBM_GetLastError()
+
+
+def test_c_api_names_importance_and_file_predict(lib, tmp_path):
+    """Feature names round-trip, eval names/counts, feature importance,
+    and PredictForFile (reference c_api.h:214-262,700-731,1748)."""
+    rng = np.random.RandomState(5)
+    n, f = 1500, 4
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xc = np.ascontiguousarray(X, np.float64)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        b"max_bin=63", None, ctypes.byref(ds)))
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))
+
+    names_in = (ctypes.c_char_p * f)(b"alpha", b"beta", b"gamma", b"delta")
+    _check(lib, lib.LGBM_DatasetSetFeatureNames(
+        ds, names_in, ctypes.c_int(f)))
+    bufs = [ctypes.create_string_buffer(32) for _ in range(f)]
+    arr = (ctypes.c_char_p * f)(*[ctypes.addressof(b) for b in bufs])
+    out_n = ctypes.c_int()
+    out_buf = ctypes.c_size_t()
+    _check(lib, lib.LGBM_DatasetGetFeatureNames(
+        ds, ctypes.c_int(f), ctypes.byref(out_n), ctypes.c_size_t(32),
+        ctypes.byref(out_buf), arr))
+    assert out_n.value == f
+    assert bufs[0].value == b"alpha" and bufs[3].value == b"delta"
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary metric=auc,binary_logloss verbosity=-1 "
+            b"num_leaves=15", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(8):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    cnt = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+    assert cnt.value >= 2
+    ebufs = [ctypes.create_string_buffer(32) for _ in range(cnt.value)]
+    earr = (ctypes.c_char_p * cnt.value)(
+        *[ctypes.addressof(b) for b in ebufs])
+    _check(lib, lib.LGBM_BoosterGetEvalNames(
+        bst, ctypes.c_int(cnt.value), ctypes.byref(out_n),
+        ctypes.c_size_t(32), ctypes.byref(out_buf), earr))
+    enames = {b.value for b in ebufs}
+    assert b"auc" in enames, enames
+
+    imp = np.zeros(f, np.float64)
+    _check(lib, lib.LGBM_BoosterFeatureImportance(
+        bst, ctypes.c_int(-1), ctypes.c_int(0),
+        imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert imp[0] == imp.max() and imp.sum() > 0
+
+    data_file = str(tmp_path / "pred_in.csv")
+    np.savetxt(data_file, np.column_stack([y, X]), delimiter=",",
+               fmt="%.7g")
+    result_file = str(tmp_path / "pred_out.txt")
+    _check(lib, lib.LGBM_BoosterPredictForFile(
+        bst, data_file.encode(), ctypes.c_int(0), ctypes.c_int(0),
+        ctypes.c_int(0), ctypes.c_int(-1), b"", result_file.encode()))
+    preds = np.loadtxt(result_file)
+    assert preds.shape == (n,)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, preds) > 0.9
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
