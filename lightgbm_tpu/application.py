@@ -45,11 +45,8 @@ def _parse_args(argv: List[str]) -> Dict[str, str]:
 
 
 def _load_side_file(path: str) -> Optional[np.ndarray]:
-    """Optional .weight / .query companion files (reference Metadata
-    loads `<data>.weight` and `<data>.query`, src/io/metadata.cpp)."""
-    if os.path.exists(path):
-        return np.loadtxt(path, dtype=np.float64, ndmin=1)
-    return None
+    from .io.parser import load_side_file
+    return load_side_file(path)
 
 
 class Application:

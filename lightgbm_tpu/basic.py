@@ -145,6 +145,16 @@ class Dataset:
             if self.free_raw_data:
                 self.data = None
             return self
+        if isinstance(data, str) and self._used_indices is None:
+            # side files (reference DatasetLoader::LoadFromFile picks up
+            # <data>.weight and <data>.query automatically); applies to
+            # every file-loading branch below, but not to subsets (a full
+            # -file group cannot align with sliced rows)
+            from .io.parser import load_side_file
+            if self.weight is None:
+                self.weight = load_side_file(data + ".weight")
+            if self.group is None:
+                self.group = load_side_file(data + ".query")
         if (isinstance(data, str) and cfg0.two_round
                 and self.reference is None and self._used_indices is None):
             # two_round (reference config.h two_round / TwoPassLoading):

@@ -115,6 +115,14 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return feats, np.asarray(labels, dtype=np.float32)
 
 
+def load_side_file(path: str) -> Optional[np.ndarray]:
+    """Optional .weight / .query companion file (reference Metadata loads
+    `<data>.weight` and `<data>.query`, src/io/metadata.cpp)."""
+    if os.path.exists(path):
+        return np.loadtxt(path, dtype=np.float64, ndmin=1)
+    return None
+
+
 def load_rank_shard(path: str, rank: int, nranks: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Stream a data file keeping only rows ``r % nranks == rank``

@@ -1,0 +1,91 @@
+"""Distributed training through the CLI, mirroring the reference's
+DistributedMockup exactly (tests/distributed/_test_distributed.py:54-120):
+N copies of the real CLI entry point, each with its own train{i}.conf and a
+shared machines list, pre_partition=true, tree_learner=data; distributed
+accuracy must match centralized."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_cli_distributed_mockup(tmp_path):
+    rng = np.random.RandomState(0)
+    n, f = 4000, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(
+        np.float32)
+
+    # pre-partitioned per-rank data files (reference pre_partition=true)
+    paths = []
+    for rank in range(2):
+        p = str(tmp_path / f"train{rank}.csv")
+        sl = slice(rank, None, 2)
+        np.savetxt(p, np.column_stack([y[sl], X[sl]]), delimiter=",",
+                   fmt="%.7g")
+        paths.append(p)
+
+    machines = "127.0.0.1:25456,127.0.0.1:25457"
+    model_out = str(tmp_path / "model.txt")
+    confs = []
+    for rank in range(2):
+        conf = str(tmp_path / f"train{rank}.conf")
+        with open(conf, "w") as fh:
+            fh.write(f"""task = train
+objective = binary
+data = {paths[rank]}
+num_leaves = 15
+min_data_in_leaf = 20
+num_iterations = 8
+tree_learner = data
+pre_partition = true
+num_machines = 2
+machines = {machines}
+local_listen_port = {25456 + rank}
+time_out = 2
+verbosity = -1
+output_model = {model_out if rank == 0 else str(tmp_path / 'm1.txt')}
+""")
+        confs.append(conf)
+
+    env_base = {k: v for k, v in os.environ.items()
+                if not k.startswith("JAX_")}
+    env_base["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    for rank in range(2):
+        env = dict(env_base)
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu", f"config={confs[rank]}"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(stdout)
+    for rank, (p, text) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{text[-3000:]}"
+
+    # centralized comparison
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import lightgbm_tpu as lgb
+    central = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "min_data_in_leaf": 20},
+                        lgb.Dataset(X, y), 8)
+    dist = lgb.Booster(model_file=model_out)
+    from sklearn.metrics import roc_auc_score
+    auc_c = roc_auc_score(y, central.predict(X))
+    auc_d = roc_auc_score(y, dist.predict(X))
+    assert abs(auc_c - auc_d) < 0.02, (auc_c, auc_d)

@@ -86,3 +86,16 @@ def test_ndcg_metric_matches_numpy(rank_data):
     ours = dict((name, val) for name, val, _ in res)
     expect = _ndcg_at(scores, y_train, q_train, 5)
     assert abs(ours["ndcg@5"] - expect) < 0.02
+
+
+def test_query_side_file_autoload():
+    """Dataset(path) picks up <data>.query automatically (reference
+    DatasetLoader side-file convention), so the lambdarank example trains
+    straight from its file pair."""
+    tr = "/root/reference/examples/lambdarank/rank.train"
+    ds = lgb.Dataset(tr)
+    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 20,
+                     "metric": "ndcg", "ndcg_eval_at": [3]}, ds, 5)
+    assert bst.num_trees() == 5
+    assert ds._handle.metadata.num_queries > 0
