@@ -328,6 +328,62 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(
   return run_simple("booster_predict_for_file", args, nullptr);
 }
 
+LGBM_EXPORT int LGBM_DatasetGetField(DatasetHandle handle,
+                                     const char* field_name, int* out_len,
+                                     const void** out_ptr, int* out_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 field_name);
+  PyObject* res = nullptr;
+  if (run_simple("dataset_get_field", args, &res) != 0) return -1;
+  // (address, length, type_code); the buffer is pinned on the Dataset
+  // object python-side, so it lives as long as the handle does
+  long long addr = PyLong_AsLongLong(PyTuple_GetItem(res, 0));
+  *out_len = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  *out_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 2)));
+  *out_ptr = reinterpret_cast<const void*>(static_cast<intptr_t>(addr));
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+
+// Shared retry-sizing string return (reference string-out protocol:
+// out_len always reports size+1; the copy happens only when the caller's
+// buffer fits and is non-null).
+int copy_string_result(PyObject* res, int64_t buffer_len, int64_t* out_len,
+                       char* out_str) {
+  Py_ssize_t size;
+  const char* s = PyUnicode_AsUTF8AndSize(res, &size);
+  if (s == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  *out_len = static_cast<int64_t>(size) + 1;
+  if (buffer_len >= size + 1 && out_str != nullptr) {
+    std::memcpy(out_str, s, static_cast<size_t>(size) + 1);
+  }
+  return 0;
+}
+
+}  // namespace
+
+LGBM_EXPORT int LGBM_BoosterDumpModel(BoosterHandle handle,
+                                      int start_iteration, int num_iteration,
+                                      int feature_importance_type,
+                                      int64_t buffer_len, int64_t* out_len,
+                                      char* out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiii)", static_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration,
+                                 feature_importance_type);
+  PyObject* res = nullptr;
+  if (run_simple("booster_dump_model", args, &res) != 0) return -1;
+  int rc = copy_string_result(res, buffer_len, out_len, out_str);
+  Py_DECREF(res);
+  return rc;
+}
+
 LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
                                             DatasetHandle source) {
   Gil gil;
@@ -787,14 +843,9 @@ LGBM_EXPORT int LGBM_BoosterSaveModelToString(
                                  start_iteration, num_iteration);
   PyObject* res = nullptr;
   if (run_simple("booster_save_model_to_string", args, &res) != 0) return -1;
-  Py_ssize_t size;
-  const char* s = PyUnicode_AsUTF8AndSize(res, &size);
-  *out_len = static_cast<int64_t>(size) + 1;
-  if (buffer_len >= size + 1) {
-    std::memcpy(out_str, s, static_cast<size_t>(size) + 1);
-  }
+  int rc = copy_string_result(res, buffer_len, out_len, out_str);
   Py_DECREF(res);
-  return 0;
+  return rc;
 }
 
 LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,

@@ -766,20 +766,26 @@ class Booster:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 
-    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> dict:
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0,
+                   importance_type: str = "split") -> dict:
         if num_iteration < 0 and self.best_iteration > 0:
             num_iteration = self.best_iteration
         models = (self._gbdt.models if self._gbdt else self._loaded_trees)
         k = self.num_model_per_iteration()
         trees = self._trees_for_range(start_iteration, num_iteration) \
             if models else []
+        names = self.feature_name()
+        imp = self.feature_importance(importance_type=importance_type)
         return {
             "name": "tree",
             "version": "v3",
             "num_class": k,
             "num_tree_per_iteration": k,
             "max_feature_idx": self.num_feature() - 1,
-            "feature_names": self.feature_name(),
+            "feature_names": names,
+            # reference DumpModel always includes this section
+            "feature_importances": {n: float(v)
+                                    for n, v in zip(names, imp) if v > 0},
             "tree_info": [t.to_json(i) for i, t in enumerate(trees)],
         }
 

@@ -21,6 +21,7 @@ __all__ = [
     "dataset_set_field", "dataset_num_data", "dataset_num_feature",
     "dataset_add_features_from",
     "dataset_set_feature_names", "dataset_get_feature_names",
+    "dataset_get_field", "booster_dump_model",
     "booster_get_eval_counts", "booster_get_eval_names",
     "booster_feature_importance", "booster_predict_for_file",
     "booster_create", "booster_create_from_modelfile", "booster_add_valid",
@@ -189,6 +190,51 @@ def booster_predict_for_file(bst: Booster, data_filename: str,
         for row in out:
             fh.write("\t".join(repr(float(v)) for v in np.ravel(row)))
             fh.write("\n")
+
+
+_FIELD_DTYPES = {"label": (np.float32, 0), "weight": (np.float32, 0),
+                 "init_score": (np.float64, 1), "group": (np.int32, 2)}
+
+
+def dataset_get_field(ds: Dataset, field_name: str):
+    """reference LGBM_DatasetGetField (c_api.cpp:1528): returns
+    (address, length, type_code) of a buffer that stays alive as long as
+    the Dataset handle (stashed on the object, like the reference's
+    internal arrays)."""
+    ds.construct()
+    dtype, code = _FIELD_DTYPES[field_name]   # KeyError -> rc=-1 upstream
+    if not hasattr(ds, "_field_refs"):
+        ds._field_refs = {}
+    arr = ds._field_refs.get(field_name)
+    if arr is None:
+        md = ds._handle.metadata
+        if field_name == "label":
+            raw = md.label
+        elif field_name == "weight":
+            raw = md.weight
+        elif field_name == "init_score":
+            raw = md.init_score
+        else:                                  # "group"
+            # reference returns query BOUNDARIES for "group"
+            raw = md.query_boundaries
+        if raw is None:
+            return (0, 0, code)
+        arr = np.ascontiguousarray(np.asarray(raw), dtype=dtype)
+        # pin ONCE per handle: repeated calls must return the SAME buffer
+        # (a caller may hold the earlier pointer — reference lifetime
+        # contract, c_api.h:385)
+        ds._field_refs[field_name] = arr
+    return (int(arr.__array_interface__["data"][0]), int(arr.size), code)
+
+
+def booster_dump_model(bst: Booster, start_iteration: int,
+                       num_iteration: int, importance_type: int) -> str:
+    """reference LGBM_BoosterDumpModel: JSON model string."""
+    import json as _json
+    kind = "gain" if importance_type == 1 else "split"
+    return _json.dumps(bst.dump_model(num_iteration=num_iteration,
+                                      start_iteration=start_iteration,
+                                      importance_type=kind))
 
 
 def dataset_add_features_from(target: Dataset, source: Dataset) -> None:

@@ -301,3 +301,55 @@ def test_c_api_names_importance_and_file_predict(lib, tmp_path):
 
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_get_field_and_dump_model(lib):
+    """LGBM_DatasetGetField returns live buffers (c_api.h:385) and
+    LGBM_BoosterDumpModel emits the JSON dump with retry sizing."""
+    import json
+    rng = np.random.RandomState(6)
+    n, f = 800, 3
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xc = np.ascontiguousarray(X, np.float64)
+
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        b"max_bin=63", None, ctypes.byref(ds)))
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))
+
+    out_len = ctypes.c_int()
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int()
+    _check(lib, lib.LGBM_DatasetGetField(
+        ds, b"label", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)))
+    assert out_len.value == n and out_type.value == 0   # float32
+    got = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), (n,))
+    np.testing.assert_allclose(got, y)
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    need = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, ctypes.c_int64(0), ctypes.byref(need), None))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(lib, lib.LGBM_BoosterDumpModel(
+        bst, 0, -1, 0, ctypes.c_int64(need.value), ctypes.byref(need), buf))
+    model = json.loads(buf.value.decode())
+    assert model["num_class"] == 1 and len(model["tree_info"]) == 3
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
