@@ -818,6 +818,19 @@ LGBM_EXPORT int LGBM_NetworkInit(const char* machines, int local_listen_port,
   return run_simple("network_init", args, nullptr);
 }
 
+LGBM_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                              void* reduce_scatter_ext_fun,
+                                              void* allgather_ext_fun) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(iiLL)", num_machines, rank,
+      static_cast<long long>(
+          reinterpret_cast<intptr_t>(reduce_scatter_ext_fun)),
+      static_cast<long long>(
+          reinterpret_cast<intptr_t>(allgather_ext_fun)));
+  return run_simple("network_init_with_functions", args, nullptr);
+}
+
 LGBM_EXPORT int LGBM_NetworkFree() {
   Gil gil;
   PyObject* args = Py_BuildValue("()");

@@ -116,9 +116,9 @@ class Dataset:
                 raise LightGBMError(
                     "query/group data requires pre-partitioned loading by "
                     "query; not supported with rank-sharded ingestion")
-            from .parallel.mesh import maybe_init_distributed
+            from .parallel.mesh import (comm_rank, comm_size,
+                                        maybe_init_distributed)
             maybe_init_distributed(cfg0)
-            import jax
             if isinstance(data, str):
                 if cfg0.pre_partition:
                     from .io.parser import load_svmlight_or_csv
@@ -126,7 +126,7 @@ class Dataset:
                 else:
                     from .io.parser import load_rank_shard
                     X_local, y_local = load_rank_shard(
-                        data, jax.process_index(), jax.process_count())
+                        data, comm_rank(), comm_size())
                 if self.label is not None:
                     raise LightGBMError(
                         "rank-sharded file loading takes labels from the "
@@ -325,18 +325,45 @@ class Dataset:
         self.label = label
         if self._handle is not None:
             self._handle.metadata.label = np.asarray(label, np.float32)
+            h = self._handle
+            if hasattr(h, "label"):
+                import jax.numpy as jnp
+                h.label = jnp.asarray(h.metadata.label)
         return self
+
+    def _refresh_metadata(self) -> None:
+        """Propagate post-construct field updates into the live handle
+        (reference Metadata::SetWeights/SetQuery mutate in place)."""
+        h = self._handle
+        if h is None:
+            return
+        md = h.metadata
+        new = Metadata(md.label, self.weight,
+                       np.asarray(self.group) if self.group is not None
+                       else None,
+                       self.init_score)
+        h.metadata = new
+        import jax.numpy as jnp
+        if hasattr(h, "weight"):
+            h.weight = (jnp.asarray(new.weight)
+                        if new.weight is not None else None)
+        if hasattr(h, "query_ids"):
+            h.query_ids = (jnp.asarray(new.query_ids)
+                           if new.query_ids is not None else None)
 
     def set_weight(self, weight):
         self.weight = weight
+        self._refresh_metadata()
         return self
 
     def set_group(self, group):
         self.group = group
+        self._refresh_metadata()
         return self
 
     def set_init_score(self, init_score):
         self.init_score = init_score
+        self._refresh_metadata()
         return self
 
     def get_label(self):
@@ -775,7 +802,8 @@ class Booster:
         trees = self._trees_for_range(start_iteration, num_iteration) \
             if models else []
         names = self.feature_name()
-        imp = self.feature_importance(importance_type=importance_type)
+        imp = self.feature_importance(importance_type=importance_type,
+                                      iteration=num_iteration)
         return {
             "name": "tree",
             "version": "v3",

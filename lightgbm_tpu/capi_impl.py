@@ -35,7 +35,7 @@ __all__ = [
     "booster_fast_config_init", "booster_predict_single_row_fast",
     "booster_save_model",
     "booster_save_model_to_string", "booster_load_model_from_string",
-    "network_init", "network_free",
+    "network_init", "network_init_with_functions", "network_free",
 ]
 
 # reference c_api.h predict type constants
@@ -244,6 +244,9 @@ def dataset_add_features_from(target: Dataset, source: Dataset) -> None:
 
 def dataset_set_field(ds: Dataset, field_name: str, vec) -> None:
     arr = np.frombuffer(vec[0], dtype=vec[1])
+    # a new field value invalidates any buffer GetField pinned for it
+    if hasattr(ds, "_field_refs"):
+        ds._field_refs.pop(field_name, None)
     if field_name == "label":
         ds.set_label(arr)
     elif field_name == "weight":
@@ -431,6 +434,20 @@ def network_init(machines: str, local_listen_port: int,
                   "local_listen_port": local_listen_port,
                   "time_out": listen_time_out})
     maybe_init_distributed(cfg)
+
+
+def network_init_with_functions(num_machines: int, rank: int,
+                                reduce_scatter_addr: int,
+                                allgather_addr: int) -> None:
+    """reference LGBM_NetworkInitWithFunctions (c_api.h:1319): register
+    user-supplied collective functions.  They own the HOST-side
+    communication (distributed loading's mapper/label sync); device-side
+    collectives are compiled XLA programs over ICI — pre-initialize
+    jax.distributed to let an outer system own that layer (documented
+    deviation from the reference, where the same sockets serve both)."""
+    from .parallel.mesh import register_external_collectives
+    register_external_collectives(num_machines, rank, reduce_scatter_addr,
+                                  allgather_addr)
 
 
 def network_free() -> None:

@@ -323,12 +323,11 @@ class TrainDataset:
         The global row order is rank-block-major: rank 0's rows, then
         rank 1's, ...
         """
-        import jax
-        from jax.experimental import multihost_utils
-        from .parallel.mesh import maybe_init_distributed
+        from .parallel.mesh import (comm_rank, comm_size, host_allgather,
+                                    maybe_init_distributed)
         maybe_init_distributed(config)
-        nproc = jax.process_count()
-        rank = jax.process_index()
+        nproc = comm_size()
+        rank = comm_rank()
 
         is_sparse = (hasattr(X_local, "tocsc")
                      and not isinstance(X_local, np.ndarray))
@@ -355,8 +354,7 @@ class TrainDataset:
                     f"rows {ln} (rank-sharded loading takes RANK-LOCAL "
                     "init scores; multi-class init is unsupported here)")
 
-        sizes = np.asarray(multihost_utils.process_allgather(
-            np.asarray([ln], np.int64))).reshape(-1)
+        sizes = host_allgather(np.asarray([ln], np.int64)).reshape(-1)
         n_global = int(sizes.sum())
         max_block = int(sizes.max())
         row_offset = int(sizes[:rank].sum())
@@ -364,10 +362,7 @@ class TrainDataset:
         def allgather_blocks(vec, fill=0.0):
             """[ln] per-rank -> [N] global in rank-block order."""
             pad = np.full(max_block - len(vec), fill, vec.dtype)
-            stacked = np.asarray(multihost_utils.process_allgather(
-                np.concatenate([vec, pad])))
-            if nproc == 1:
-                stacked = stacked.reshape(1, -1)
+            stacked = host_allgather(np.concatenate([vec, pad]))
             return np.concatenate(
                 [stacked[r, :sizes[r]] for r in range(nproc)])
 
@@ -385,11 +380,9 @@ class TrainDataset:
         # as missing -> slight overcount of NaN; mark with a count vector)
         samp_pad = np.full((max_block, num_features), np.nan, np.float64)
         samp_pad[:local_sample_n] = samp
-        cnts = np.asarray(multihost_utils.process_allgather(
-            np.asarray([local_sample_n], np.int64))).reshape(-1)
-        gathered = np.asarray(multihost_utils.process_allgather(samp_pad))
-        if nproc == 1:
-            gathered = gathered.reshape(1, max_block, num_features)
+        cnts = host_allgather(
+            np.asarray([local_sample_n], np.int64)).reshape(-1)
+        gathered = host_allgather(samp_pad)
         sample = np.concatenate(
             [gathered[r, :cnts[r]] for r in range(nproc)])
 

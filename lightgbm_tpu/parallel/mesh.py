@@ -17,20 +17,111 @@ from jax.sharding import Mesh
 
 from ..log import log_info, log_warning
 
-__all__ = ["build_mesh", "maybe_init_distributed", "shutdown_distributed"]
+__all__ = ["build_mesh", "maybe_init_distributed", "shutdown_distributed",
+           "register_external_collectives", "external_collectives",
+           "comm_size", "comm_rank", "host_allgather"]
 
 _initialized = False
+
+# -- injected collectives (reference LGBM_NetworkInitWithFunctions,
+# c_api.h:1319 / Network::Init with external fns, meta.h:65-75) ----------
+#
+# Design note: on TPU the DEVICE collectives (histogram psum, vote
+# allgather) are compiled into the XLA program and ride ICI — they cannot
+# be swapped for user C callbacks without leaving the compiler's execution
+# model, and jax.distributed pre-initialization is the supported way to
+# let an outer system own that layer.  What CAN be externally owned is the
+# HOST-side communication this framework performs around training:
+# distributed loading's bin-mapper sample sync and label/weight exchange
+# (dataset.py:from_rank_shard).  When registered, those route through the
+# injected allgather instead of jax's multihost utilities.
+_external = None
+
+
+def register_external_collectives(num_machines: int, rank: int,
+                                  reduce_scatter_addr: int,
+                                  allgather_addr: int) -> None:
+    """Store the injected collective functions (reference typedefs,
+    meta.h:68-75; called via LGBM_NetworkInitWithFunctions)."""
+    import ctypes
+    comm_size_t = ctypes.c_int32
+    buf_t = ctypes.POINTER(ctypes.c_char)   # no NUL-truncating conversions
+    AllgatherF = ctypes.CFUNCTYPE(
+        None, buf_t, comm_size_t, ctypes.POINTER(comm_size_t),
+        ctypes.POINTER(comm_size_t), ctypes.c_int, buf_t, comm_size_t)
+    ReduceScatterF = ctypes.CFUNCTYPE(
+        None, buf_t, comm_size_t, ctypes.c_int,
+        ctypes.POINTER(comm_size_t), ctypes.POINTER(comm_size_t),
+        ctypes.c_int, buf_t, comm_size_t, ctypes.c_void_p)
+    if num_machines > 1 and not allgather_addr:
+        raise ValueError(
+            "LGBM_NetworkInitWithFunctions with num_machines > 1 requires "
+            "an allgather function (the host-side exchanges depend on it)")
+    global _external
+    _external = {
+        "num_machines": int(num_machines),
+        "rank": int(rank),
+        "allgather": AllgatherF(allgather_addr) if allgather_addr else None,
+        "reduce_scatter": (ReduceScatterF(reduce_scatter_addr)
+                           if reduce_scatter_addr else None),
+    }
+
+
+def external_collectives():
+    return _external
+
+
+def comm_size() -> int:
+    if _external is not None:
+        return _external["num_machines"]
+    return jax.process_count()
+
+
+def comm_rank() -> int:
+    if _external is not None:
+        return _external["rank"]
+    return jax.process_index()
+
+
+def host_allgather(arr: np.ndarray) -> np.ndarray:
+    """Allgather equal-shaped host arrays -> [num_machines, ...] — the
+    reference's Network::Allgather contract, via the injected function
+    when registered, else jax.experimental.multihost_utils."""
+    arr = np.ascontiguousarray(arr)
+    if _external is None or _external["allgather"] is None:
+        from jax.experimental import multihost_utils
+        out = np.asarray(multihost_utils.process_allgather(arr))
+        if jax.process_count() == 1:   # no leading axis is added then
+            out = out.reshape((1,) + arr.shape)
+        return out
+    import ctypes
+    n = _external["num_machines"]
+    bsz = arr.nbytes
+    block_start = (np.arange(n, dtype=np.int32) * bsz)
+    block_len = np.full(n, bsz, np.int32)
+    out = np.zeros(n * max(bsz, 1), np.uint8)
+    inp = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+    c_i32p = ctypes.POINTER(ctypes.c_int32)
+    buf_t = ctypes.POINTER(ctypes.c_char)
+    _external["allgather"](
+        inp.ctypes.data_as(buf_t), bsz,
+        block_start.ctypes.data_as(c_i32p),
+        block_len.ctypes.data_as(c_i32p), n,
+        out.ctypes.data_as(buf_t), out.nbytes)
+    return out.view(arr.dtype).reshape((n,) + arr.shape)
 
 
 def shutdown_distributed() -> None:
     """Leave the cluster and allow a later re-init (reference
-    Network::Dispose / LGBM_NetworkFree).  Idempotent."""
-    global _initialized
+    Network::Dispose / LGBM_NetworkFree).  Idempotent; also drops any
+    injected collective functions."""
+    global _initialized, _external
     try:
         jax.distributed.shutdown()
     except Exception:
         pass
     _initialized = False
+    _external = None
 
 
 def _local_ips() -> set:

@@ -120,5 +120,20 @@ def rank_data():
     return (X[:tr], y[:tr], sizes[:half], X[tr:], y[tr:], sizes[half:])
 
 
+@pytest.fixture(scope="session")
+def capi_lib():
+    """The C ABI shared library, built on demand (single canonical
+    build/load point for every ctypes-driven test)."""
+    import ctypes
+    import subprocess
+    so = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "c_api", "lib_lightgbm_tpu.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", os.path.dirname(so)], check=True)
+    lib = ctypes.CDLL(so)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running multi-process test")

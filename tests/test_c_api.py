@@ -18,12 +18,8 @@ SO = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 
 
 @pytest.fixture(scope="module")
-def lib():
-    if not os.path.exists(SO):
-        subprocess.run(["make", "-C", os.path.dirname(SO)], check=True)
-    lib = ctypes.CDLL(SO)
-    lib.LGBM_GetLastError.restype = ctypes.c_char_p
-    return lib
+def lib(capi_lib):
+    return capi_lib
 
 
 def _check(lib, rc):
