@@ -43,13 +43,21 @@ lgb.train <- function(params = list(), data, nrounds = 100L) {
   bst
 }
 
-#' Predict (reference predict.lgb.Booster)
+#' Predict (reference predict.lgb.Booster: multiclass returns an
+#' [nrow, num_class] matrix)
 predict.lgb.Booster <- function(object, newdata, rawscore = FALSE,
                                 num_iteration = -1L, ...) {
   newdata <- as.matrix(newdata)
   storage.mode(newdata) <- "double"
-  .Call("LGBM_R_BoosterPredict", object$handle, newdata, nrow(newdata),
-        ncol(newdata), isTRUE(rawscore), as.integer(num_iteration))
+  out <- .Call("LGBM_R_BoosterPredict", object$handle, newdata,
+               nrow(newdata), ncol(newdata), isTRUE(rawscore),
+               as.integer(num_iteration))
+  # the C payload is row-major [n, k]; the glue tags dim = c(k, n), so
+  # transpose to the reference's [n, k] orientation
+  if (!is.null(dim(out))) {
+    out <- t(out)
+  }
+  out
 }
 
 #' Save the model in the reference text format (reference lgb.save)

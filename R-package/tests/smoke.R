@@ -5,22 +5,29 @@
 dyn.load(file.path("src", paste0("lightgbm_tpu_R", .Platform$dynlib.ext)))
 source(file.path("R", "lightgbm_tpu.R"))
 
-read_svmlight <- function(path, n_features) {
+read_label_first <- function(path, n_features) {
+  # binary.train is dense TSV (label first); also handles sparse k:v pairs
   lines <- readLines(path)
   y <- numeric(length(lines))
   X <- matrix(0, nrow = length(lines), ncol = n_features)
   for (i in seq_along(lines)) {
-    toks <- strsplit(lines[[i]], " ")[[1]]
+    toks <- strsplit(lines[[i]], "[ \t]+")[[1]]
+    toks <- toks[nzchar(toks)]
     y[i] <- as.numeric(toks[[1]])
-    for (t in toks[-1]) {
-      kv <- strsplit(t, ":")[[1]]
-      X[i, as.integer(kv[[1]]) + 1L] <- as.numeric(kv[[2]])
+    rest <- toks[-1]
+    if (length(rest) > 0 && grepl(":", rest[[1]], fixed = TRUE)) {
+      for (t in rest) {
+        kv <- strsplit(t, ":", fixed = TRUE)[[1]]
+        X[i, as.integer(kv[[1]]) + 1L] <- as.numeric(kv[[2]])
+      }
+    } else {
+      X[i, seq_along(rest)] <- as.numeric(rest)
     }
   }
   list(X = X, y = y)
 }
 
-d <- read_svmlight("/root/reference/examples/binary_classification/binary.train", 28)
+d <- read_label_first("/root/reference/examples/binary_classification/binary.train", 28)
 train <- lgb.Dataset(d$X, label = d$y, params = list(max_bin = 63))
 bst <- lgb.train(list(objective = "binary", num_leaves = 15,
                       verbosity = -1), train, nrounds = 10L)

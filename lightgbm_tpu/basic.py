@@ -120,13 +120,27 @@ class Dataset:
                                         maybe_init_distributed)
             maybe_init_distributed(cfg0)
             if isinstance(data, str):
+                from .io.parser import load_side_file
+                side_w = load_side_file(data + ".weight")
+                if load_side_file(data + ".query") is not None:
+                    raise LightGBMError(
+                        "a .query side file requires query-aligned "
+                        "partitioning; not supported with rank-sharded "
+                        "ingestion")
                 if cfg0.pre_partition:
+                    # the file (and its side files) already hold only this
+                    # rank's rows
                     from .io.parser import load_svmlight_or_csv
                     X_local, y_local = load_svmlight_or_csv(data)
+                    if side_w is not None and self.weight is None:
+                        self.weight = side_w
                 else:
                     from .io.parser import load_rank_shard
-                    X_local, y_local = load_rank_shard(
-                        data, comm_rank(), comm_size())
+                    rk, nm = comm_rank(), comm_size()
+                    X_local, y_local = load_rank_shard(data, rk, nm)
+                    if side_w is not None and self.weight is None:
+                        # slice the global side file the same round-robin way
+                        self.weight = side_w[rk::nm]
                 if self.label is not None:
                     raise LightGBMError(
                         "rank-sharded file loading takes labels from the "

@@ -139,7 +139,11 @@ def booster_get_eval_counts(bst: Booster) -> int:
 
 
 def booster_get_eval_names(bst: Booster):
-    """reference LGBM_BoosterGetEvalNames: metric names in eval order."""
+    """reference LGBM_BoosterGetEvalNames: metric names in eval order
+    (empty for predictor boosters loaded from a model file, like the
+    reference)."""
+    if bst._gbdt is None:
+        return []
     names = []
     for m in bst._gbdt.train_metrics:
         n = getattr(m, "name", None)

@@ -376,12 +376,14 @@ class TrainDataset:
         # densifies only its sample here, never its full matrix
         samp = (np.asarray(X_local[pick].todense(), np.float64)
                 if is_sparse else X_local[pick])
-        # pad sample blocks to a common size with NaN (ignored by binning
-        # as missing -> slight overcount of NaN; mark with a count vector)
-        samp_pad = np.full((max_block, num_features), np.nan, np.float64)
-        samp_pad[:local_sample_n] = samp
+        # gather sample COUNTS first, then pad blocks only to the largest
+        # SAMPLE (never to a rank's full row count — that would ship a
+        # global-dataset-sized array and defeat per-rank memory scaling)
         cnts = host_allgather(
             np.asarray([local_sample_n], np.int64)).reshape(-1)
+        max_sample = int(cnts.max())
+        samp_pad = np.full((max_sample, num_features), np.nan, np.float64)
+        samp_pad[:local_sample_n] = samp
         gathered = host_allgather(samp_pad)
         sample = np.concatenate(
             [gathered[r, :cnts[r]] for r in range(nproc)])

@@ -145,7 +145,47 @@ def load_rank_shard(path: str, rank: int, nranks: int
 
 class LineParser:
     """Streaming row parser for chunked loading (two_round / Sequence path;
-    reference utils/pipeline_reader.h + TextReader)."""
+    reference utils/pipeline_reader.h + TextReader).  libsvm streams too:
+    a cheap token pre-scan finds the feature count, then rows are parsed
+    chunk by chunk — the full matrix is never held for any format."""
+
+    def _libsvm_num_features(self) -> int:
+        max_feat = -1
+        with open_readable(self.path) as fh:
+            for line in fh:
+                for t in line.split()[1:]:
+                    k, sep_, _ = t.partition(":")
+                    if sep_:
+                        ki = int(k)
+                        if ki > max_feat:
+                            max_feat = ki
+        return max_feat + 1
+
+    def _iter_libsvm(self):
+        f = self._libsvm_num_features()
+        rows, labels = [], []
+        with open_readable(self.path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                toks = line.split()
+                labels.append(float(toks[0]))
+                rows.append([(int(k), float(v)) for k, _, v in
+                             (t.partition(":") for t in toks[1:]) if _])
+                if len(rows) >= self.chunk_rows:
+                    yield self._densify_libsvm(rows, labels, f)
+                    rows, labels = [], []
+        if rows:
+            yield self._densify_libsvm(rows, labels, f)
+
+    @staticmethod
+    def _densify_libsvm(rows, labels, f):
+        X = np.zeros((len(rows), f), np.float64)
+        for i, pairs in enumerate(rows):
+            for k, v in pairs:
+                X[i, k] = v
+        return X, np.asarray(labels, np.float32)
 
     def __init__(self, path: str, chunk_rows: int = 65536,
                  header: Optional[bool] = None):
@@ -159,9 +199,7 @@ class LineParser:
 
     def __iter__(self):
         if self.fmt == "libsvm":
-            X, y = _load_libsvm(self.path)
-            for i in range(0, len(y), self.chunk_rows):
-                yield X[i:i + self.chunk_rows], y[i:i + self.chunk_rows]
+            yield from self._iter_libsvm()
             return
         sep = "\t" if self.fmt == "tsv" else ","
         import pandas as pd

@@ -71,7 +71,20 @@ class DataParallelTreeLearner(SerialTreeLearner):
             # rows sit at the END of each rank's block and are masked out
             # via self._real_idx (gradients scattered in / row_leaf
             # gathered out through it).
-            nproc = max(jax.process_count(), 1)
+            from .mesh import comm_size
+            nproc = max(comm_size(), 1)
+            if nproc != len(dataset.block_sizes):
+                raise ValueError(
+                    f"rank-sharded dataset has {len(dataset.block_sizes)} "
+                    f"blocks but the communicator reports {nproc} machines "
+                    "(did the collective registration change between "
+                    "loading and training?)")
+            if nproc > 1 and jax.process_count() != nproc:
+                raise NotImplementedError(
+                    "rank-sharded TRAINING needs a jax.distributed mesh "
+                    "spanning the machines (injected host collectives "
+                    "cover loading-phase exchanges only; pre-initialize "
+                    "jax.distributed for multi-machine training)")
             dev_per_proc = max(self.n_dev // nproc, 1)
             sizes = dataset.block_sizes
             n_per = -(-int(sizes.max()) // dev_per_proc) * dev_per_proc

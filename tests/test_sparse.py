@@ -56,15 +56,25 @@ def test_sparse_valid_set_aligned():
 
 
 def test_sparse_never_materializes_dense_float64(monkeypatch):
-    """The train path must not call .toarray() on the full matrix."""
-    dense, y = _sparse_task()
+    """Every densification on the train path must stay bounded by the
+    bin-finding SAMPLE (rows <= bin_construct_sample_cnt), never the full
+    matrix — spying both csr.toarray and csc.todense (the path actually
+    used by from_sparse's column-blocked sampling)."""
+    dense, y = _sparse_task(n=20000)
     csr = sps.csr_matrix(dense)
-    called = []
-    orig = sps.csr_matrix.toarray
+    sample_cnt = 1000
+    calls = []
+    for cls, name in ((sps.csr_matrix, "toarray"),
+                      (sps.csc_matrix, "toarray"),
+                      (sps.csc_matrix, "todense"),
+                      (sps.csr_matrix, "todense")):
+        orig = getattr(cls, name)
 
-    def spy(self, *a, **k):
-        called.append(self.shape)
-        return orig(self, *a, **k)
-    monkeypatch.setattr(sps.csr_matrix, "toarray", spy)
-    lgb.train(PARAMS, lgb.Dataset(csr, y), 3)
-    assert not called, f"train densified the sparse input: {called}"
+        def spy(self, *a, _orig=orig, **k):
+            calls.append(self.shape)
+            return _orig(self, *a, **k)
+        monkeypatch.setattr(cls, name, spy)
+    lgb.train({**PARAMS, "bin_construct_sample_cnt": sample_cnt},
+              lgb.Dataset(csr, y), 3)
+    too_big = [s for s in calls if s[0] > sample_cnt]
+    assert not too_big, f"train densified beyond the sample: {too_big}"
