@@ -328,6 +328,35 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(
   return run_simple("booster_predict_for_file", args, nullptr);
 }
 
+LGBM_EXPORT int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                              int64_t num_total_row,
+                                              DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)",
+                                 static_cast<PyObject*>(reference),
+                                 static_cast<long long>(num_total_row));
+  PyObject* handle = nullptr;
+  if (run_simple("dataset_create_by_reference", args, &handle) != 0)
+    return -1;
+  *out = handle;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row) {
+  Gil gil;
+  PyObject* mat = make_matrix(data, data_type, nrow, ncol);
+  if (mat == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* args = Py_BuildValue("(ONiii)",
+                                 static_cast<PyObject*>(dataset), mat,
+                                 nrow, ncol, start_row);
+  return run_simple("dataset_push_rows", args, nullptr);
+}
+
 LGBM_EXPORT int LGBM_DatasetGetField(DatasetHandle handle,
                                      const char* field_name, int* out_len,
                                      const void** out_ptr, int* out_type) {

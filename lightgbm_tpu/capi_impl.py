@@ -22,6 +22,7 @@ __all__ = [
     "dataset_add_features_from",
     "dataset_set_feature_names", "dataset_get_feature_names",
     "dataset_get_field", "booster_dump_model",
+    "dataset_create_by_reference", "dataset_push_rows",
     "booster_get_eval_counts", "booster_get_eval_names",
     "booster_feature_importance", "booster_predict_for_file",
     "booster_create", "booster_create_from_modelfile", "booster_add_valid",
@@ -121,6 +122,48 @@ def dataset_create_from_csc(indptr_mat, indices_mat, data_mat, nindptr: int,
     return Dataset(csc, params=_parse_params(parameters),
                    reference=reference if isinstance(reference, Dataset)
                    else None, free_raw_data=False)
+
+
+def dataset_create_by_reference(reference: Dataset,
+                                num_total_row: int) -> Dataset:
+    """reference LGBM_DatasetCreateByReference (c_api.h:125): an empty
+    dataset aligned to `reference`'s bin mappers; rows stream in through
+    dataset_push_rows and are binned IMMEDIATELY (uint8), so the raw
+    float matrix never accumulates — the streaming-construction path the
+    SWIG/Java ChunkedArray flows use."""
+    reference.construct()
+    ds = Dataset(None, reference=reference, free_raw_data=False)
+    train = reference._handle
+    ds._push_bins = np.zeros((int(num_total_row), train.num_features),
+                             train.bins.dtype)
+    ds._push_seen = 0
+    ds._push_total = int(num_total_row)
+    return ds
+
+
+def dataset_push_rows(ds: Dataset, mat, nrow: int, ncol: int,
+                      start_row: int) -> None:
+    """reference LGBM_DatasetPushRows (c_api.h:139); on the final block
+    the dataset finishes loading (FinishLoad) as an aligned valid set.
+    Fields set via LGBM_DatasetSetField before the final block are
+    honored (the reference allows SetField any time before FinishLoad)."""
+    if not hasattr(ds, "_push_bins"):
+        raise ValueError("dataset was not created by "
+                         "LGBM_DatasetCreateByReference")
+    block = _matrix(mat, 1).reshape(nrow, ncol)     # row-major
+    train = ds.reference._handle
+    ds._push_bins[start_row:start_row + nrow] = train.bin_external(block)
+    if train.raw_device is not None:        # linear trees score on raw rows
+        if not hasattr(ds, "_push_raw"):
+            ds._push_raw = np.zeros((ds._push_total, ncol), np.float64)
+        ds._push_raw[start_row:start_row + nrow] = block
+    ds._push_seen += nrow
+    if ds._push_seen >= ds._push_total:
+        from .dataset import ValidDataset
+        ds._handle = ValidDataset.from_prebinned(
+            train, ds._push_bins, ds._make_metadata(ds._push_total),
+            raw=getattr(ds, "_push_raw", None))
+        del ds._push_bins
 
 
 def dataset_set_feature_names(ds: Dataset, names) -> None:

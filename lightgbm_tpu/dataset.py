@@ -646,6 +646,28 @@ class ValidDataset:
     """Validation set binned with the training mappers (reference aligned
     valid Dataset, basic.py:1232 _init_from_ref_dataset semantics)."""
 
+    @classmethod
+    def from_prebinned(cls, train: TrainDataset, bins: np.ndarray,
+                       metadata: Metadata,
+                       raw: Optional[np.ndarray] = None) -> "ValidDataset":
+        """Construct from already-binned rows (streaming PushRows path,
+        reference FinishLoad) — single place that knows the field list."""
+        self = cls.__new__(cls)
+        self.train = train
+        self.metadata = metadata
+        self.num_data = metadata.num_data
+        self.bins = bins
+        self.device_bins = jnp.asarray(train.to_device_space(bins))
+        self.raw = (np.asarray(raw, np.float64)
+                    if raw is not None and train.raw_device is not None
+                    else None)
+        self.label = jnp.asarray(metadata.label)
+        self.weight = (jnp.asarray(metadata.weight)
+                       if metadata.weight is not None else None)
+        self.query_ids = (jnp.asarray(metadata.query_ids)
+                          if metadata.query_ids is not None else None)
+        return self
+
     def __init__(self, train: TrainDataset, data: np.ndarray, metadata: Metadata):
         self.train = train
         self.metadata = metadata

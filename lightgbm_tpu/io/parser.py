@@ -88,31 +88,15 @@ def load_svmlight_or_csv(path: str, label_idx: int = 0,
 
 
 def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
-    labels = []
-    rows = []
-    max_feat = -1
-    with open_readable(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            toks = line.split()
-            labels.append(float(toks[0]))
-            pairs = []
-            for t in toks[1:]:
-                if ":" not in t:
-                    continue
-                k, v = t.split(":", 1)
-                k = int(k)
-                pairs.append((k, float(v)))
-                max_feat = max(max_feat, k)
-            rows.append(pairs)
-    n = len(rows)
-    feats = np.zeros((n, max_feat + 1), dtype=np.float64)
-    for i, pairs in enumerate(rows):
-        for k, v in pairs:
-            feats[i, k] = v
-    return feats, np.asarray(labels, dtype=np.float32)
+    """Single libsvm parser: the streaming LineParser chunks, concatenated
+    (one code path for single-process, two_round, and rank-sharded loads)."""
+    xs, ys = [], []
+    for X, y in LineParser(path):
+        xs.append(X)
+        ys.append(y)
+    if not xs:
+        return np.zeros((0, 0), np.float64), np.zeros((0,), np.float32)
+    return np.concatenate(xs, axis=0), np.concatenate(ys)
 
 
 def load_side_file(path: str) -> Optional[np.ndarray]:
@@ -153,10 +137,16 @@ class LineParser:
         max_feat = -1
         with open_readable(self.path) as fh:
             for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):   # same skip rule as
+                    continue                           # the row parser
                 for t in line.split()[1:]:
                     k, sep_, _ = t.partition(":")
                     if sep_:
-                        ki = int(k)
+                        try:
+                            ki = int(k)
+                        except ValueError:
+                            continue                   # non-index token
                         if ki > max_feat:
                             max_feat = ki
         return max_feat + 1

@@ -349,3 +349,62 @@ def test_c_api_get_field_and_dump_model(lib):
 
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_push_rows_streaming_valid_set(lib):
+    """LGBM_DatasetCreateByReference + PushRows stream a validation set in
+    blocks, binned immediately against the reference mappers (the SWIG
+    ChunkedArray flow, c_api.h:125-144)."""
+    rng = np.random.RandomState(8)
+    n, f = 2000, 4
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xc = np.ascontiguousarray(X, np.float64)
+
+    train = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        Xc.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+        ctypes.c_int32(n), ctypes.c_int32(f), ctypes.c_int(1),
+        b"max_bin=63", None, ctypes.byref(train)))
+    yc = np.ascontiguousarray(y, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        train, b"label", yc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(n), ctypes.c_int(0)))
+
+    nv = 600
+    Xv = np.ascontiguousarray(rng.randn(nv, f), np.float64)
+    yv = (Xv[:, 0] > 0).astype(np.float32)
+    valid = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateByReference(
+        train, ctypes.c_int64(nv), ctypes.byref(valid)))
+    for lo in range(0, nv, 256):                  # stream in blocks
+        hi = min(lo + 256, nv)
+        block = np.ascontiguousarray(Xv[lo:hi])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            valid, block.ctypes.data_as(ctypes.c_void_p), ctypes.c_int(1),
+            ctypes.c_int32(hi - lo), ctypes.c_int32(f),
+            ctypes.c_int32(lo)))
+    yvc = np.ascontiguousarray(yv, np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        valid, b"label", yvc.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int(nv), ctypes.c_int(0)))
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        train, b"objective=binary num_leaves=15 metric=auc verbosity=-1",
+        ctypes.byref(bst)))
+    _check(lib, lib.LGBM_BoosterAddValidData(bst, valid))
+    fin = ctypes.c_int()
+    for _ in range(8):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    res = np.zeros(4, np.float64)
+    out_n = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetEval(
+        bst, ctypes.c_int(1), ctypes.byref(out_n),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_n.value >= 1
+    assert 0.8 < res[0] <= 1.0          # held-out AUC on the streamed set
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(valid))
+    _check(lib, lib.LGBM_DatasetFree(train))
