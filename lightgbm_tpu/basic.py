@@ -694,6 +694,58 @@ class Booster:
         return raw
 
     # ------------------------------------------------------------------
+    def trees_to_dataframe(self):
+        """Flatten the model into a pandas DataFrame, one row per node/leaf
+        (reference Booster.trees_to_dataframe, basic.py:3572): columns
+        tree_index, node_depth, node_index, left/right_child, parent_index,
+        split_feature, split_gain, threshold, decision_type, missing_type,
+        value, weight, count."""
+        import pandas as pd
+        names = self.feature_name()
+        rows = []
+
+        def walk(tree_index, node, parent, depth):
+            if "leaf_index" in node:
+                rows.append({
+                    "tree_index": tree_index, "node_depth": depth,
+                    "node_index": f"{tree_index}-L{node['leaf_index']}",
+                    "left_child": None, "right_child": None,
+                    "parent_index": parent, "split_feature": None,
+                    "split_gain": None, "threshold": None,
+                    "decision_type": None, "missing_type": None,
+                    "value": node["leaf_value"],
+                    "weight": node.get("leaf_weight"),
+                    "count": node.get("leaf_count")})
+                return f"{tree_index}-L{node['leaf_index']}"
+            idx = f"{tree_index}-S{node['split_index']}"
+            row = {
+                "tree_index": tree_index, "node_depth": depth,
+                "node_index": idx, "parent_index": parent,
+                "split_feature": names[node["split_feature"]],
+                "split_gain": node["split_gain"],
+                "threshold": node["threshold"],
+                "decision_type": node["decision_type"],
+                "missing_type": node.get("missing_type"),
+                "value": node["internal_value"],
+                "weight": node.get("internal_weight"),
+                "count": node.get("internal_count")}
+            pos = len(rows)
+            rows.append(row)
+            row["left_child"] = walk(tree_index, node["left_child"], idx,
+                                     depth + 1)
+            row["right_child"] = walk(tree_index, node["right_child"], idx,
+                                      depth + 1)
+            rows[pos] = row
+            return idx
+
+        for t in self.dump_model()["tree_info"]:
+            walk(t["tree_index"], t["tree_structure"], None, 1)
+        cols = ["tree_index", "node_depth", "node_index", "left_child",
+                "right_child", "parent_index", "split_feature",
+                "split_gain", "threshold", "decision_type", "missing_type",
+                "value", "weight", "count"]
+        return pd.DataFrame(rows, columns=cols)
+
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         models = (self._gbdt.models if self._gbdt else self._loaded_trees)

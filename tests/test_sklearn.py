@@ -168,3 +168,23 @@ def test_plot_metric(binary_data):
             eval_metric="binary_logloss")
     ax = lgb.plot_metric(clf)
     assert ax is not None
+
+
+def test_trees_to_dataframe():
+    """reference Booster.trees_to_dataframe (basic.py:3572)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, y), 3)
+    df = bst.trees_to_dataframe()
+    assert set(df["tree_index"]) == {0, 1, 2}
+    n_leaves = (df["split_feature"].isna()).sum()
+    n_splits = len(df) - n_leaves
+    assert n_leaves == n_splits + 3          # leaves = splits + num_trees
+    import pandas as pd
+    root = df[(df.tree_index == 0) & (df.node_depth == 1)].iloc[0]
+    assert pd.isna(root["parent_index"]) and root["count"] == 800
+    # children link back to their parent
+    lc = df[df.node_index == root["left_child"]].iloc[0]
+    assert lc["parent_index"] == root["node_index"]
