@@ -89,11 +89,22 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._handle = None          # TrainDataset or ValidDataset
         self._used_indices = None
-        self._feature_names: Optional[List[str]] = None
+        # user-supplied names win; DataFrame columns fill in during
+        # construct() when feature_name stays "auto" (reference
+        # _set_init_from_params feature_name handling)
+        self._feature_names: Optional[List[str]] = (
+            [str(n) for n in feature_name]
+            if isinstance(feature_name, (list, tuple)) else None)
         self._pandas_cats: List[int] = []
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
+        if self._handle is None:
+            self._construct_impl()
+            self._sync_feature_names()
+        return self
+
+    def _construct_impl(self) -> "Dataset":
         if self._handle is not None:
             return self
         if self.reference is not None:
@@ -189,7 +200,8 @@ class Dataset:
             if self.label is None:
                 self.label = label
         elif type(data).__name__ == "DataFrame":
-            self._feature_names = [str(c) for c in data.columns]
+            if self._feature_names is None:
+                self._feature_names = [str(c) for c in data.columns]
             arr, self._pandas_cats = _pandas_categorical(data)
         elif (self.reference is None and self._used_indices is None
               and (isinstance(data, Sequence)
@@ -248,6 +260,32 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _sync_feature_names(self) -> None:
+        """Attach user/DataFrame names to the live handle so the save path
+        reads them (reference Dataset::set_feature_name).  Called at the
+        end of construct() and again on later renames.  The model text
+        joins names with spaces, so whitespace is replaced (the reference
+        python package sanitizes the same way) and a length mismatch is a
+        hard error (reference: 'Length of feature_name error')."""
+        if self._handle is None or not self._feature_names:
+            return
+        nf = getattr(self._handle, "num_total_features", None)
+        if nf is None:            # valid datasets take the train set's names
+            return
+        if len(self._feature_names) != nf:
+            raise LightGBMError(
+                f"Length of feature_name ({len(self._feature_names)}) does "
+                f"not match the number of features ({nf})")
+        cleaned = []
+        for n in self._feature_names:
+            s = "_".join(str(n).split())
+            if s != str(n):
+                log_warning(f"feature name {n!r} contains whitespace; "
+                            f"saved as {s!r} (model text is space-joined)")
+            cleaned.append(s)
+        self._feature_names = cleaned
+        self._handle.user_feature_names = cleaned
 
     def _make_metadata(self, n: int) -> Metadata:
         """Metadata from the user-supplied label/weight/group/init_score

@@ -167,8 +167,10 @@ def dataset_push_rows(ds: Dataset, mat, nrow: int, ncol: int,
 
 
 def dataset_set_feature_names(ds: Dataset, names) -> None:
-    """reference LGBM_DatasetSetFeatureNames."""
+    """reference LGBM_DatasetSetFeatureNames (reaches the live handle, so
+    a later save sees the new names regardless of call order)."""
     ds._feature_names = [str(n) for n in names]
+    ds._sync_feature_names()
 
 
 def dataset_get_feature_names(ds: Dataset):

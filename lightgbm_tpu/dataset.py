@@ -639,6 +639,9 @@ class TrainDataset:
 
     @property
     def feature_names(self) -> List[str]:
+        user = getattr(self, "user_feature_names", None)
+        if user and len(user) == self.num_total_features:
+            return [str(n) for n in user]
         return [f"Column_{i}" for i in range(self.num_total_features)]
 
 

@@ -241,3 +241,35 @@ def test_add_features_from():
     from sklearn.metrics import roc_auc_score
     X_all = np.hstack([Xa, Xb])
     assert roc_auc_score(y, bst.predict(X_all)) > 0.9
+
+
+def test_user_feature_names_flow_into_model(tmp_path):
+    """feature_name= list reaches feature_name(), the model text, and the
+    JSON dump; whitespace is sanitized and length mismatches are fatal
+    (reference Dataset feature_name handling)."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 3)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, y, feature_name=["aa", "bb", "cc"])
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 4}, ds, 2)
+    assert bst.feature_name() == ["aa", "bb", "cc"]
+    line = [l for l in bst.model_to_string().splitlines()
+            if l.startswith("feature_names")][0]
+    assert line == "feature_names=aa bb cc"
+    d = bst.dump_model()
+    assert d["feature_names"] == ["aa", "bb", "cc"]
+    p = str(tmp_path / "named.txt")
+    bst.save_model(p)
+    assert lgb.Booster(model_file=p).feature_name() == ["aa", "bb", "cc"]
+    # whitespace sanitized (model text is space-joined)
+    ds2 = lgb.Dataset(X, y, feature_name=["my col", "b", "c"])
+    b2 = lgb.train({"objective": "binary", "verbosity": -1,
+                    "num_leaves": 4}, ds2, 1)
+    assert b2.feature_name() == ["my_col", "b", "c"]
+    p2 = str(tmp_path / "ws.txt")
+    b2.save_model(p2)
+    assert lgb.Booster(model_file=p2).feature_name() == ["my_col", "b", "c"]
+    # wrong length is a hard error, like the reference
+    with pytest.raises(Exception, match="feature_name"):
+        lgb.Dataset(X, y, feature_name=["a", "b"]).construct()
