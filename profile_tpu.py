@@ -7,13 +7,14 @@ Usage: python -u profile_tpu.py [stage...]   (default: 1 2 3 4)
 """
 
 import functools
+import os
 import sys
 import time
 
 import numpy as np
 
-N = 1_000_000
-F = 28
+N = int(os.environ.get("PROFILE_ROWS", 1_000_000))
+F = int(os.environ.get("PROFILE_FEATURES", 28))
 
 
 def log(msg):
