@@ -429,6 +429,76 @@ class Dataset:
     def get_group(self):
         return self.group
 
+    # -- reference Dataset conveniences ---------------------------------
+    def get_data(self):
+        """reference Dataset.get_data: the raw data if it was kept
+        (free_raw_data=False), else an error like the reference."""
+        if self.data is None:
+            raise LightGBMError("Cannot get data: set free_raw_data=False "
+                                "when constructing the Dataset")
+        return self.data
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_feature_name(self) -> List[str]:
+        return self.get_feature_names()
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """reference Dataset.set_feature_name."""
+        self._feature_names = [str(n) for n in feature_name]
+        self._sync_feature_names()
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """reference Dataset.set_categorical_feature (before construct)."""
+        if self._handle is not None and \
+                categorical_feature != self.categorical_feature:
+            raise LightGBMError(
+                "Cannot change categorical_feature after the Dataset was "
+                "constructed; create a new Dataset instead")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """reference Dataset.set_reference (before construct)."""
+        if self._handle is not None and reference is not self.reference:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset was constructed; "
+                "create a new Dataset instead")
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """reference Dataset.get_ref_chain: this dataset and its ancestry."""
+        chain, node = [], self
+        while node is not None and len(chain) < ref_limit:
+            chain.append(node)
+            node = node.reference
+        return set(chain)
+
+    def get_params(self) -> Dict[str, Any]:
+        return dict(self.params)
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """reference Dataset.set_field dispatch."""
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group,
+                  "init_score": self.set_init_score}.get(field_name)
+        if setter is None:
+            raise LightGBMError(f"unknown field {field_name!r}")
+        setter(data)
+        return self
+
+    def get_field(self, field_name: str):
+        """reference Dataset.get_field dispatch."""
+        getter = {"label": self.get_label, "weight": self.get_weight,
+                  "group": self.get_group,
+                  "init_score": self.get_init_score}.get(field_name)
+        if getter is None:
+            raise LightGBMError(f"unknown field {field_name!r}")
+        return getter()
+
     def num_data(self) -> int:
         self.construct()
         return self._handle.num_data
@@ -534,6 +604,8 @@ class Booster:
         self._train_set = train_set
         self._loaded_trees: Optional[List[Tree]] = None
         self._loaded_meta: Dict[str, str] = {}
+        self._valid_names: List[str] = []
+        self._valid_sets_refs: List[Dataset] = []
 
         if model_file is not None:
             with open(model_file) as fh:
@@ -552,7 +624,6 @@ class Booster:
         self._config = cfg
         self._objective = create_objective(cfg)
         self._gbdt = create_boosting(cfg, train_set._handle, self._objective)
-        self._valid_names: List[str] = []
 
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -561,6 +632,7 @@ class Booster:
         data.construct()
         self._gbdt.add_valid(data._handle, name)
         self._valid_names.append(name)
+        self._valid_sets_refs.append(data)
         return self
 
     def update(self, train_set=None, fobj=None) -> bool:
@@ -732,6 +804,129 @@ class Booster:
         return raw
 
     # ------------------------------------------------------------------
+    # -- reference Booster conveniences ---------------------------------
+    def attr(self, key: str):
+        """reference Booster.attr: stored model attribute or None."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """reference Booster.set_attr: set (str) or delete (None) model
+        attributes."""
+        store = getattr(self, "_attr", None)
+        if store is None:
+            store = self._attr = {}
+        for k, v in kwargs.items():
+            if v is None:
+                store.pop(k, None)
+            else:
+                store[k] = str(v)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """reference Booster.set_train_data_name."""
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """reference Booster.free_dataset: release train/valid data memory
+        (prediction keeps working through the retained bin mappers; no
+        further training)."""
+        self._train_set = None
+        if self._gbdt is not None:
+            self._gbdt.free_dataset()
+        return self
+
+    def free_network(self) -> "Booster":
+        """reference Booster.free_network (LGBM_NetworkFree)."""
+        from .parallel.mesh import shutdown_distributed
+        shutdown_distributed()
+        return self
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """reference Booster.model_from_string: replace this booster's
+        model with one parsed from text."""
+        with self._lock.write():
+            self._gbdt = None
+            self._load_from_string(model_str)
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """reference Booster.get_leaf_output (LGBM_BoosterGetLeafValue;
+        errors on out-of-range leaf ids rather than returning padding)."""
+        models = self._gbdt.models if self._gbdt else self._loaded_trees
+        tree = models[tree_id]
+        if not 0 <= leaf_id < tree.num_leaves:
+            raise LightGBMError(
+                f"leaf_id {leaf_id} out of range for tree {tree_id} "
+                f"({tree.num_leaves} leaves)")
+        return float(tree.leaf_value[leaf_id])
+
+    def lower_bound(self) -> float:
+        """reference Booster.lower_bound: smallest possible raw score
+        (sum over trees of each tree's minimum leaf value)."""
+        models = self._gbdt.models if self._gbdt else self._loaded_trees
+        return float(sum(float(np.min(t.leaf_value[:t.num_leaves]))
+                         for t in models))
+
+    def upper_bound(self) -> float:
+        """reference Booster.upper_bound."""
+        models = self._gbdt.models if self._gbdt else self._loaded_trees
+        return float(sum(float(np.max(t.leaf_value[:t.num_leaves]))
+                         for t in models))
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """reference Booster.shuffle_models (LGBM_BoosterShuffleModels):
+        randomly permute the tree order inside [start, end) iterations —
+        used to decorrelate prediction early-stopping."""
+        with self._lock.write():
+            models = self._gbdt.models if self._gbdt else self._loaded_trees
+            k = self.num_model_per_iteration()
+            n_iter = len(models) // k
+            end = n_iter if end_iteration < 0 else min(end_iteration, n_iter)
+            idx = np.arange(start_iteration, end)
+            np.random.shuffle(idx)
+            blocks = [models[i * k:(i + 1) * k] for i in range(n_iter)]
+            reordered = (blocks[:start_iteration]
+                         + [blocks[i] for i in idx] + blocks[end:])
+            flat = [t for b in reordered for t in b]
+            if self._gbdt:
+                self._gbdt.models = flat
+            else:
+                self._loaded_trees = flat
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None):
+        """reference Booster.get_split_value_histogram: histogram of the
+        thresholds this model splits `feature` at (default bin count =
+        number of distinct thresholds, like the reference)."""
+        from .plotting import split_value_counts
+        values = split_value_counts(self, feature)
+        if bins is None:
+            bins = max(len(np.unique(values)), 1)
+        return np.histogram(values, bins=bins)
+
+    def eval(self, data, name: str, feval=None):
+        """reference Booster.eval: evaluate the model's metrics on a
+        Dataset.  Matches tracked datasets by IDENTITY (the reference
+        compares `data is train_set` / the valid list); an unseen dataset
+        is registered as a new valid set under `name`."""
+        if self._gbdt is None:
+            raise LightGBMError(
+                "eval requires a trained Booster (predictor boosters "
+                "loaded from a model file have no metrics state)")
+        if data is self._train_set:
+            return self.eval_train(feval)
+        for i, vn in enumerate(self._valid_names):
+            if data is self._valid_sets_refs[i]:
+                return self._eval_set(vn, feval)
+        if name == "training" or name in self._valid_names:
+            raise LightGBMError(
+                f"name {name!r} already refers to a different dataset; "
+                "pick a fresh name for a new eval set")
+        self.add_valid(data, name)
+        return self._eval_set(name, feval)
+
     def trees_to_dataframe(self):
         """Flatten the model into a pandas DataFrame, one row per node/leaf
         (reference Booster.trees_to_dataframe, basic.py:3572): columns

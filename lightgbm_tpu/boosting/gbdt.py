@@ -69,6 +69,20 @@ class GBDT:
         self.eval_results: Dict[str, Dict[str, List[float]]] = {}
         self._L = self.tree_learner.grower_cfg.num_leaves
 
+    def free_dataset(self) -> None:
+        """Release the training/validation data memory while keeping the
+        model + bin mappers alive for prediction (reference
+        Booster::FreeDataset semantics: no further training)."""
+        self._flush_pending()
+        td = self.train_data
+        td.bins = None
+        td.device_bins = None
+        td.raw_device = None
+        td.label = td.weight = td.query_ids = None
+        self.valid_sets, self.valid_scores, self.valid_names = [], [], []
+        self.train_score = None
+        self.tree_learner = None       # holds the sharded device matrix
+
     def reset_config(self, config) -> None:
         """Re-resolve tunable training params mid-run (reference
         GBDT::ResetConfig, gbdt.cpp:676): rebuild the tree learner with the

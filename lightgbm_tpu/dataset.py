@@ -607,7 +607,9 @@ class TrainDataset:
                 f"input has {data.shape[1] if data.ndim == 2 else 'wrong'} "
                 f"features, but the model expects {self.num_total_features} "
                 "(reference: LGBM_BoosterPredictForMat shape check)")
-        out = np.empty((data.shape[0], self.num_features), self.bins.dtype)
+        dt = (self.bins.dtype if self.bins is not None
+              else (np.uint8 if self.max_num_bins <= 256 else np.int32))
+        out = np.empty((data.shape[0], self.num_features), dt)
         for j, real in enumerate(self.real_feature_index):
             out[:, j] = self.feature_mappers[j].value_to_bin(data[:, real])
         return out

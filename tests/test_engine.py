@@ -273,3 +273,53 @@ def test_user_feature_names_flow_into_model(tmp_path):
     # wrong length is a hard error, like the reference
     with pytest.raises(Exception, match="feature_name"):
         lgb.Dataset(X, y, feature_name=["a", "b"]).construct()
+
+
+def test_booster_dataset_convenience_api(tmp_path):
+    """The reference Booster/Dataset convenience surface: attrs,
+    bounds, shuffle_models, leaf output, eval-by-name, field dispatch,
+    ref chain (reference basic.py public methods)."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(600, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7, "metric": "auc"}, ds, 6)
+
+    bst.set_attr(note="hello", extra="1")
+    assert bst.attr("note") == "hello"
+    bst.set_attr(extra=None)
+    assert bst.attr("extra") is None
+
+    lo, hi = bst.lower_bound(), bst.upper_bound()
+    raw = bst.predict(X, raw_score=True)
+    assert lo <= raw.min() and raw.max() <= hi
+
+    assert isinstance(bst.get_leaf_output(0, 0), float)
+
+    p_before = bst.predict(X)
+    np.random.seed(0)
+    bst.shuffle_models()
+    # tree order changes f32 summation order, not the model
+    np.testing.assert_allclose(p_before, bst.predict(X), rtol=1e-4,
+                               atol=1e-5)
+
+    res = bst.eval(lgb.Dataset(X, y, reference=ds), "probe")
+    assert any(m[1] == "auc" for m in res)
+
+    hist, edges = bst.get_split_value_histogram(0, bins=5)
+    assert hist.sum() > 0 and len(edges) == 6
+
+    # Dataset dispatches
+    assert ds.get_field("label") is not None
+    ds.set_field("weight", np.ones(600, np.float32))
+    assert ds.get_field("weight") is not None
+    assert ds.get_data() is X
+    assert ds in ds.get_ref_chain()
+    s = bst.model_to_string()
+    b2 = lgb.Booster(model_file=None, model_str=s)
+    b2.model_from_string(s)
+    # loaded models traverse in f64 on host vs the live booster's f32
+    # device path
+    np.testing.assert_allclose(b2.predict(X[:20]), bst.predict(X[:20]),
+                               rtol=1e-5, atol=1e-6)
