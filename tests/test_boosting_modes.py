@@ -73,3 +73,39 @@ def test_bagging_changes_trees(binary_data):
     t1, t2 = b1._gbdt.models[1], b2._gbdt.models[1]
     assert (t1.leaf_count[:t1.num_leaves].sum() >
             t2.leaf_count[:t2.num_leaves].sum())
+
+
+def test_fused_path_defers_host_transfers():
+    """The fused training step's design claim: NO device->host transfer of
+    any kind happens during the iteration loop before the stall-check lag
+    kicks in (states flush lazily) — the property the TPU perf story rests
+    on, enforced with jax's transfer guard so even implicit pulls
+    (int()/np.asarray()) regress loudly without hardware."""
+    import jax
+    rng = np.random.RandomState(0)
+    X = rng.randn(5000, 8)
+    y = (X[:, 0] > 0).astype(np.float32)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+              "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.boosting import create_boosting
+    cfg = Config(params)
+    gb = create_boosting(cfg, ds._handle, create_objective(cfg))
+    assert gb._can_fuse()
+
+    # iterations 1-7: strictly zero device->host transfers (the stall
+    # check only starts once 8 states are pending)
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(7):
+            gb.train_one_iter()
+    # from iteration 8 the loop reads ONE stale scalar per iteration (the
+    # stall check inspects an iteration finished 8 steps ago, so it never
+    # stalls the pipeline head) — still no state flush
+    for _ in range(13):
+        gb.train_one_iter()
+    assert len(gb._pending) == 20        # nothing flushed during the loop
+    n = gb.num_trees                     # forces the lazy batched flush
+    assert n == 20 and len(gb._pending) == 0
