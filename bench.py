@@ -32,6 +32,13 @@ rc=124 because retry/backoff could run >4 h):
   shorter honest run beats a timeout with no number).
 - If the TPU attempt dies, a CPU fallback with a tiny workload emits an
   honest {"backend": "cpu"} line.
+
+Stages (BENCH_STAGE env var, same parent/budget machinery for both):
+- default        training wall-clock + held-out AUC (run_training)
+- serve          serving throughput/latency through lightgbm_tpu/serving/:
+                 sustained rows/s, p50/p99 latency, batch-fill ratio, and a
+                 steady-state compile count (run_serving).  Tuning knobs:
+                 BENCH_SERVE_{TREES,THREADS,MAX_REQ_ROWS,SECONDS,TRAIN_ROWS}.
 """
 
 import json
@@ -159,6 +166,104 @@ def run_training():
     }), flush=True)
 
 
+def run_serving():
+    """Child body for BENCH_STAGE=serve: train a small model, publish it as
+    a CompiledPredictor, drive mixed-size traffic from concurrent clients
+    through the MicroBatcher, and report sustained rows/s + tail latency.
+
+    vs_baseline here is batched throughput over UNBATCHED direct predicts
+    on the same compiled engine (>1.0 means the micro-batcher's coalescing
+    pays for its queueing) — the serving analogue of the training stage's
+    per-unit-work ratio."""
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import MicroBatcher, ServingMetrics
+
+    train_rows = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS", 50_000))
+    rounds = int(os.environ.get("BENCH_SERVE_TREES", 50))
+    n_threads = int(os.environ.get("BENCH_SERVE_THREADS", 8))
+    max_req = int(os.environ.get("BENCH_SERVE_MAX_REQ_ROWS", 64))
+
+    X, y = synth_binary(train_rows, seed=0)
+    params = {"objective": "binary", "num_leaves": 63, "learning_rate": 0.1,
+              "verbosity": -1, "max_bin": MAX_BIN, "min_data_in_leaf": 20}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+
+    pred = bst.to_compiled()
+    warmup_compiles = pred.warmup()
+    setup_s = time.time() - t_start
+
+    pool = np.random.RandomState(1).randn(8192, N_FEATURES).astype(np.float32)
+
+    # unbatched baseline: the same mixed request sizes, one device call each
+    rng = np.random.RandomState(2)
+    t0, base_rows = time.time(), 0
+    while time.time() - t0 < 2.0:
+        n = int(rng.randint(1, max_req + 1))
+        pred.predict(pool[:n])
+        base_rows += n
+    direct_rows_s = base_rows / (time.time() - t0)
+
+    metrics = ServingMetrics().model("bench")
+    duration = min(float(os.environ.get("BENCH_SERVE_SECONDS", 10.0)),
+                   max(deadline - time.time() - 15.0, 2.0))
+    sent = [0] * n_threads
+    errors = []
+    with MicroBatcher(pred, max_batch=4096, max_wait_ms=2.0,
+                      max_queue_rows=1 << 16, metrics=metrics) as mb:
+        stop_at = time.time() + duration
+
+        def client(i):
+            r = np.random.RandomState(100 + i)
+            try:
+                while time.time() < stop_at:
+                    n = int(r.randint(1, max_req + 1))
+                    lo = int(r.randint(0, pool.shape[0] - n))
+                    mb.predict(pool[lo:lo + n], timeout=60)
+                    sent[i] += n
+            except Exception as exc:
+                errors.append(repr(exc))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - t0
+
+    snap = metrics.snapshot(pred.compile_count)
+    rows_s = sum(sent) / max(elapsed, 1e-9)
+    print("BENCH_RESULT " + json.dumps({
+        "metric": f"serving_binary_{rounds}trees_{n_threads}threads_"
+                  f"max{max_req}rows",
+        "value": round(rows_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_s / max(direct_rows_s, 1e-9), 4),
+        "p50_ms": round(snap["p50_ms"], 3),
+        "p99_ms": round(snap["p99_ms"], 3),
+        "batch_fill_ratio": round(snap["batch_fill_ratio"], 2),
+        "direct_rows_s": round(direct_rows_s, 1),
+        "warmup_compiles": warmup_compiles,
+        "steady_compiles": pred.compile_count - warmup_compiles,
+        "requests": snap["requests"],
+        "errors": len(errors),
+        "setup_s": round(setup_s, 3),
+        "backend": backend,
+    }), flush=True)
+
+
 def _run_child(env, ready_timeout, total_timeout):
     """Run one child, streaming stdout. Returns (result_line|None, err)."""
     env = dict(env)
@@ -247,6 +352,9 @@ def main():
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        run_training()
+        if os.environ.get("BENCH_STAGE") == "serve":
+            run_serving()
+        else:
+            run_training()
     else:
         sys.exit(main())

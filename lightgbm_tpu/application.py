@@ -73,6 +73,8 @@ class Application:
             self._convert_model()
         elif task == "save_binary":
             self._save_binary()
+        elif task == "serve":
+            self._serve()
         else:
             raise ValueError(f"unknown task {task!r}")
 
@@ -183,6 +185,22 @@ class Application:
             out2d = out2d.T
         np.savetxt(path, out2d, delimiter="\t", fmt="%.10g")
         log_info(f"Finished prediction; results saved to {path}")
+
+    def _serve(self) -> None:
+        """task=serve: publish input_model into a registry and run the
+        HTTP inference front-end (lightgbm_tpu/serving/)."""
+        from .serving.server import ServingApp, serve
+        cfg = self.config
+        if not cfg.input_model:
+            raise ValueError("task=serve requires input_model=FILE")
+        app = ServingApp(max_batch=cfg.serving_max_batch,
+                         max_wait_ms=cfg.serving_max_wait_ms,
+                         max_queue_rows=cfg.serving_max_queue_rows)
+        version = app.registry.publish(cfg.serving_model_name,
+                                       model_file=cfg.input_model)
+        log_info(f"serving {cfg.input_model} as "
+                 f"{cfg.serving_model_name!r} v{version}")
+        serve(app, host=cfg.serving_host, port=cfg.serving_port)
 
     def _convert_model(self) -> None:
         from .basic import Booster

@@ -11,6 +11,7 @@ _init_from_ref_dataset), Booster.update with optional custom fobj.
 from __future__ import annotations
 
 import json
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -606,6 +607,21 @@ class Booster:
         self._loaded_meta: Dict[str, str] = {}
         self._valid_names: List[str] = []
         self._valid_sets_refs: List[Dataset] = []
+        # device-resident StackedTrees per (start, n_trees): stacking packs
+        # T trees into padded parallel arrays, which is pure overhead to
+        # repeat per predict call; any model mutation bumps the version and
+        # drops the cache (see _invalidate_stacked).  LRU-bounded: looping
+        # over num_iteration values would otherwise pin O(N^2) tree copies
+        # on device
+        self._stacked_cache: "OrderedDict" = OrderedDict()
+        self._stacked_cache_cap = 8
+        # dedicated mutex for the cache dict itself: stacked_trees runs
+        # under the shared READ lock (predict) or no lock (to_compiled),
+        # so LRU mutation must not race concurrent readers or a writer's
+        # _invalidate_stacked clear
+        import threading
+        self._stacked_lock = threading.Lock()
+        self._model_version = 0
 
         if model_file is not None:
             with open(model_file) as fh:
@@ -640,6 +656,7 @@ class Booster:
         (reference LGBM_BoosterUpdateOneIter / ...Custom, c_api.cpp:1677,1698;
         write-locked like the reference Booster's shared-mutex)."""
         with self._lock.write():
+            self._invalidate_stacked()
             if fobj is not None:
                 score = self._raw_train_score()
                 grad, hess = fobj(score, self._train_set)
@@ -654,6 +671,7 @@ class Booster:
 
     def rollback_one_iter(self) -> "Booster":
         with self._lock.write():
+            self._invalidate_stacked()
             self._gbdt.rollback_one_iter()
         return self
 
@@ -743,7 +761,9 @@ class Booster:
             if self._gbdt is not None:
                 if pred_leaf:
                     return self._gbdt.predict_leaf_index(
-                        data, start_iteration, num_iteration)
+                        data, start_iteration, num_iteration,
+                        stacked=self.stacked_trees(start_iteration,
+                                                   num_iteration))
                 if pred_contrib:
                     from .contrib import predict_contrib
                     return predict_contrib(self._trees_for_range(
@@ -753,6 +773,65 @@ class Booster:
                                           num_iteration)
             return self._predict_loaded(data, start_iteration, num_iteration,
                                         raw_score, pred_leaf, pred_contrib)
+
+    def _invalidate_stacked(self) -> None:
+        """Drop cached StackedTrees after any model mutation (train step,
+        rollback, shuffle, reload, refit): the packed device arrays would
+        silently keep predicting the old trees otherwise."""
+        with self._stacked_lock:
+            self._model_version += 1
+            self._stacked_cache.clear()
+
+    def stacked_trees(self, start_iteration: int = 0,
+                      num_iteration: int = -1):
+        """Cached device-resident StackedTrees for a tree range.
+
+        Stacking (ops/predict.py stack_trees) packs the range's trees into
+        padded parallel arrays once; repeated predict calls reuse the
+        arrays instead of re-packing per call.  The cache is invalidated
+        whenever trees are added, rolled back, reordered, reloaded, or
+        refit (_invalidate_stacked)."""
+        from .ops.predict import stack_trees
+        with self._stacked_lock:
+            version = self._model_version
+        trees = self._trees_for_range(start_iteration, num_iteration)
+        if not trees:
+            return None
+        key = (start_iteration, len(trees))
+        with self._stacked_lock:
+            if version == self._model_version:
+                hit = self._stacked_cache.get(key)
+                if hit is not None:
+                    self._stacked_cache.move_to_end(key)
+                    return hit
+        # stack outside the mutex (it's the expensive device packing); a
+        # rare duplicate stacking on a concurrent miss is harmless
+        hit = stack_trees(trees)
+        with self._stacked_lock:
+            if version != self._model_version:
+                # the model mutated while we were stacking: hand the caller
+                # its (consistent-at-read-time) snapshot but do NOT cache
+                # it — mutations that preserve tree count (shuffle, refit)
+                # would leave the stale pack under a colliding key forever
+                return hit
+            cur = self._stacked_cache.get(key)
+            if cur is not None:
+                self._stacked_cache.move_to_end(key)
+                return cur
+            self._stacked_cache[key] = hit
+            while len(self._stacked_cache) > self._stacked_cache_cap:
+                self._stacked_cache.popitem(last=False)
+        return hit
+
+    def to_compiled(self, buckets=None, dtype=None, **kwargs):
+        """Build a serving-grade CompiledPredictor from this model.
+
+        The predictor keeps the stacked trees on device and jit-caches one
+        program per (row bucket, feature count, iteration range, output
+        kind), so steady-state traffic causes zero recompiles after warmup
+        (see lightgbm_tpu/serving/compiled.py)."""
+        from .serving.compiled import CompiledPredictor
+        return CompiledPredictor(self, buckets=buckets, dtype=dtype, **kwargs)
 
     def _trees_for_range(self, start_iteration, num_iteration):
         k = self.num_model_per_iteration()
@@ -787,21 +866,11 @@ class Booster:
         return self._convert_loaded_output(out)
 
     def _convert_loaded_output(self, raw):
+        from .objectives import output_transform
         obj = self._loaded_meta.get("objective", "")
-        if obj.startswith("binary") or obj.startswith("cross_entropy"):
-            sigmoid = 1.0
-            for tok in obj.split():
-                if tok.startswith("sigmoid:"):
-                    sigmoid = float(tok.split(":")[1])
-            return 1.0 / (1.0 + np.exp(-sigmoid * raw))
-        if obj.startswith("multiclass ") or obj.startswith("multiclass"):
-            if "ova" not in obj:
-                e = np.exp(raw - raw.max(axis=1, keepdims=True))
-                return e / e.sum(axis=1, keepdims=True)
-            return 1.0 / (1.0 + np.exp(-raw))
-        if any(obj.startswith(p) for p in ("poisson", "gamma", "tweedie")):
-            return np.exp(raw)
-        return raw
+        # loaded-model layout is [N, K] -> class_axis=1; the serving path
+        # (serving/compiled.py) shares this exact transform on [K, N]
+        return output_transform(obj, xp=np, class_axis=1)(raw)
 
     # ------------------------------------------------------------------
     # -- reference Booster conveniences ---------------------------------
@@ -846,6 +915,7 @@ class Booster:
         """reference Booster.model_from_string: replace this booster's
         model with one parsed from text."""
         with self._lock.write():
+            self._invalidate_stacked()
             self._gbdt = None
             self._load_from_string(model_str)
         return self
@@ -880,6 +950,7 @@ class Booster:
         randomly permute the tree order inside [start, end) iterations —
         used to decorrelate prediction early-stopping."""
         with self._lock.write():
+            self._invalidate_stacked()
             models = self._gbdt.models if self._gbdt else self._loaded_trees
             k = self.num_model_per_iteration()
             n_iter = len(models) // k
@@ -1062,6 +1133,7 @@ class Booster:
                 tree.leaf_value[:nl] = (decay_rate * tree.leaf_value[:nl]
                                         + (1.0 - decay_rate) * new_out[:nl])
                 score[cls] += tree.leaf_value[leaf]
+        self._invalidate_stacked()
         return self
 
     # -- model io ---------------------------------------------------------

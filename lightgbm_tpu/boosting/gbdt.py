@@ -616,14 +616,21 @@ class GBDT:
             for i, tree in enumerate(trees):
                 out[i % k] += tree.predict(X)
             return out[0] if k == 1 else out.T
-        bins = jnp.asarray(self.train_data.to_device_space(
-            self.train_data.bin_external(X)))
-        score = jnp.zeros((k, n), jnp.float32)
+        # pad the batch to its row bucket so mixed predict sizes reuse a
+        # small set of traced programs instead of retracing per row count;
+        # traversal is row-independent, so the padded rows are sliced away
+        # below without affecting results
+        from ..ops.predict import pad_rows_to_bucket
+        bins_host = pad_rows_to_bucket(self.train_data.to_device_space(
+            self.train_data.bin_external(X)), exact_above=True)
+        bins = jnp.asarray(bins_host)
+        n_pad = bins.shape[0]
+        score = jnp.zeros((k, n_pad), jnp.float32)
         cfg = self.config
         early = bool(getattr(cfg, "pred_early_stop", False))
         freq = max(int(getattr(cfg, "pred_early_stop_freq", 10)), 1)
         margin = float(getattr(cfg, "pred_early_stop_margin", 10.0))
-        frozen = jnp.zeros((n,), bool) if early else None
+        frozen = jnp.zeros((n_pad,), bool) if early else None
         for it in range(len(trees) // k):
             for cls in range(k):
                 tree = trees[it * k + cls]
@@ -638,7 +645,7 @@ class GBDT:
                 else:
                     top2 = jax.lax.top_k(score.T, 2)[0]
                     frozen = frozen | ((top2[:, 0] - top2[:, 1]) > margin)
-        out = np.asarray(score, np.float64)
+        out = np.asarray(score, np.float64)[:, :n]
         return out[0] if k == 1 else out.T
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
@@ -652,8 +659,10 @@ class GBDT:
         return np.asarray(obj.convert_output(jnp.asarray(raw)))
 
     def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
-                           num_iteration: int = -1) -> np.ndarray:
-        from ..ops.predict import stack_trees, predict_leaf_indices
+                           num_iteration: int = -1,
+                           stacked=None) -> np.ndarray:
+        from ..ops.predict import (pad_rows_to_bucket, predict_leaf_indices,
+                                   stack_trees)
         k = self.num_class
         end = self.iter_ if num_iteration < 0 else min(
             start_iteration + num_iteration, self.iter_)
@@ -661,9 +670,13 @@ class GBDT:
         trees = self.models[start_iteration * k: end * k]
         if not trees:
             return np.zeros((X.shape[0], 0), np.int32)
-        stacked = stack_trees(trees)
-        leaves = predict_leaf_indices(stacked, jnp.asarray(X))
-        return np.asarray(leaves).T  # [N, T]
+        if stacked is None:
+            # callers holding a Booster pass its cached stack instead
+            stacked = stack_trees(trees)
+        n = X.shape[0]
+        Xp = pad_rows_to_bucket(X, exact_above=True)
+        leaves = predict_leaf_indices(stacked, jnp.asarray(Xp))
+        return np.asarray(leaves).T[:n]  # [N, T]
 
     # -- model serialization (reference gbdt_model_text.cpp) --------------
     def save_model_to_string(self, start_iteration: int = 0,

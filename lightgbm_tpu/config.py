@@ -35,7 +35,7 @@ _PARAMS: List[ParamSpec] = [
     # ---- Core ----
     _p("config", str, "", ("config_file",), desc="path to a config file (CLI)"),
     _p("task", str, "train", ("task_type",),
-       check="in:train|predict|convert_model|refit|save_binary"),
+       check="in:train|predict|convert_model|refit|save_binary|serve"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss"),
        desc="objective name, see objectives.py"),
@@ -156,6 +156,13 @@ _PARAMS: List[ParamSpec] = [
     _p("pred_early_stop_margin", float, 10.0),
     _p("output_result", str, "LightGBM_predict_result.txt",
        ("predict_result", "prediction_result", "predict_name", "pred_name", "name_pred")),
+    # ---- Serving (task=serve; lightgbm_tpu/serving/) ----
+    _p("serving_host", str, "127.0.0.1"),
+    _p("serving_port", int, 8080, (), ">=0"),
+    _p("serving_model_name", str, "default", ("model_name",)),
+    _p("serving_max_batch", int, 1024, ("max_batch",), ">0"),
+    _p("serving_max_wait_ms", float, 2.0, ("max_wait_ms",), ">=0"),
+    _p("serving_max_queue_rows", int, 16384, ("max_queue_rows",), ">0"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),

@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ObjectiveFunction", "create_objective"]
+__all__ = ["ObjectiveFunction", "create_objective", "output_transform"]
 
 
 def _wmean(x, w):
@@ -579,3 +579,41 @@ def create_objective(config) -> ObjectiveFunction:
     if cls is None:
         raise ValueError(f"unknown objective: {name!r}")
     return cls(config)
+
+
+def output_transform(objective: str, xp=np, class_axis: int = 0):
+    """Raw-score -> output link keyed by an objective STRING (the
+    ``to_string()`` / model-file form, e.g. ``"binary sigmoid:1"``), for
+    predict paths that don't hold a live ObjectiveFunction: loaded-model
+    ``Booster.predict`` (basic.py) and the serving ``CompiledPredictor``
+    (serving/compiled.py).  Keeping the string-keyed dispatch here, next to
+    each class's ``convert_output``, is what stops the links drifting apart.
+
+    ``xp`` selects the array namespace — ``numpy`` for host paths, or
+    ``jax.numpy`` for a jit-traceable device transform.  ``class_axis`` is
+    the multiclass class axis of ``raw`` (device layout [K, N] -> 0, host
+    layout [N, K] -> 1)."""
+    head = objective.split()[0] if objective else ""
+    sigmoid = 1.0
+    for tok in objective.split():
+        if tok.startswith("sigmoid:"):
+            sigmoid = float(tok.split(":", 1)[1])
+    # order matters: cross_entropy_lambda's link is log1p(exp), NOT the
+    # sigmoid the bare cross_entropy prefix below would apply
+    if head == "cross_entropy_lambda":
+        return lambda raw: xp.log1p(xp.exp(raw))
+    if head.startswith("binary") or head.startswith("cross_entropy"):
+        return lambda raw: 1.0 / (1.0 + xp.exp(-sigmoid * raw))
+    if head.startswith("multiclass"):
+        if "ova" in head:
+            return lambda raw: 1.0 / (1.0 + xp.exp(-sigmoid * raw))
+
+        def _softmax(raw):
+            e = xp.exp(raw - raw.max(axis=class_axis, keepdims=True))
+            return e / e.sum(axis=class_axis, keepdims=True)
+        return _softmax
+    if any(head.startswith(p) for p in ("poisson", "gamma", "tweedie")):
+        return xp.exp
+    if "sqrt" in objective.split():  # reg_sqrt regression: undo sqrt labels
+        return lambda raw: xp.sign(raw) * raw * raw
+    return lambda raw: raw

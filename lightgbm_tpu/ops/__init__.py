@@ -1,5 +1,7 @@
 from .histogram import build_histogram
+from .predict import (pad_rows, predict_trees, predict_trees_padded,
+                      row_bucket)
 from .split import find_best_split, leaf_output
-from .predict import predict_trees
 
-__all__ = ["build_histogram", "find_best_split", "leaf_output", "predict_trees"]
+__all__ = ["build_histogram", "find_best_split", "leaf_output",
+           "predict_trees", "predict_trees_padded", "row_bucket", "pad_rows"]

@@ -18,9 +18,48 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["StackedTrees", "stack_trees", "predict_trees", "predict_leaf_indices"]
+__all__ = ["StackedTrees", "stack_trees", "predict_trees",
+           "predict_leaf_indices", "row_bucket", "pad_rows",
+           "pad_rows_to_bucket", "predict_trees_padded",
+           "DEFAULT_BUCKET_LADDER"]
 
 _K_ZERO = 1e-35
+
+# Power-of-two row buckets: every batch is padded up to the next rung so a
+# steady mix of request sizes hits a small, finite set of XLA programs
+# instead of retracing per distinct row count (each new input shape costs a
+# full compile on TPU).  Above the top rung we keep doubling, so the ladder
+# only bounds the *enumerated* warmup set, not the supported batch size.
+DEFAULT_BUCKET_LADDER = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+def row_bucket(n: int, ladder=None) -> int:
+    """Smallest bucket >= n from `ladder` (default power-of-two rungs).
+
+    Row counts beyond the ladder's top rung round up to the next power of
+    two, so arbitrarily large batches still bucket deterministically."""
+    n = max(int(n), 1)
+    for b in (ladder or DEFAULT_BUCKET_LADDER):
+        if n <= b:
+            return int(b)
+    bucket = 1 << (n - 1).bit_length()
+    return int(bucket)
+
+
+def pad_rows(X: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad the leading (row) axis of a host array up to `bucket`.
+
+    Tree traversal is row-independent, so padded rows never affect the
+    first-n results; callers slice the output back to n rows."""
+    X = np.asarray(X)
+    n = X.shape[0]
+    if n == bucket:
+        return X
+    if n > bucket:
+        raise ValueError(f"bucket {bucket} smaller than batch {n}")
+    out = np.zeros((bucket,) + X.shape[1:], X.dtype)
+    out[:n] = X
+    return out
 
 
 class StackedTrees(NamedTuple):
@@ -138,6 +177,36 @@ def predict_trees(stacked: StackedTrees, X: jnp.ndarray,
     if output == "per_tree":
         return per_tree
     return total
+
+
+def pad_rows_to_bucket(X, ladder=None, exact_above: bool = False) -> np.ndarray:
+    """Pad the row axis up to its bucket (`row_bucket` + `pad_rows`).
+
+    With exact_above=True, row counts past the ladder's top rung keep
+    their exact shape instead of doubling — right for one-shot predicts
+    (a huge eval batch would pay up to 2x compute for padding it never
+    amortizes), wrong for serving (which needs finite shapes)."""
+    X = np.asarray(X)
+    n = X.shape[0]
+    if exact_above and n > (ladder or DEFAULT_BUCKET_LADDER)[-1]:
+        return X
+    return pad_rows(X, row_bucket(n, ladder))
+
+
+def predict_trees_padded(stacked: StackedTrees, X, output: str = "sum",
+                         ladder=None):
+    """Bucket-padded entry around `predict_trees`.
+
+    Pads the host batch up to its row bucket before the device call, so
+    mixed batch sizes reuse a small set of compiled programs, and slices
+    the result back to the true row count."""
+    X = np.asarray(X)
+    n = X.shape[0]
+    out = predict_trees(stacked, jnp.asarray(pad_rows_to_bucket(X, ladder)),
+                        output=output)
+    if output == "per_tree":
+        return out[:, :n]
+    return out[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("max_steps",))

@@ -1,0 +1,27 @@
+"""Serving subsystem: compiled predictors, micro-batching, model registry,
+metrics, and a stdlib HTTP front-end.
+
+The training stack ends at ``Booster``; this package turns a Booster into
+a production inference service:
+
+- ``CompiledPredictor`` (compiled.py) — device-resident stacked trees plus
+  a shape-bucketed AOT-compile cache: zero XLA recompiles after warmup.
+- ``MicroBatcher`` (batcher.py) — coalesces concurrent small requests into
+  padded device batches with bounded-queue backpressure.
+- ``ModelRegistry`` (registry.py) — name/version routing with atomic
+  hot-swap, refcounted retirement, and instant rollback.
+- ``ServingMetrics`` (metrics.py) — per-model counters + latency
+  percentiles as a plain dict snapshot.
+- ``ServingApp`` / ``serve`` (server.py) — the multi-model JSON front-end;
+  ``python -m lightgbm_tpu.serving model=path`` runs it end to end.
+"""
+
+from .batcher import MicroBatcher, QueueFullError
+from .compiled import CompiledPredictor
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+from .server import ServingApp, make_server, serve
+
+__all__ = ["CompiledPredictor", "MicroBatcher", "QueueFullError",
+           "ModelRegistry", "ServingMetrics", "ServingApp", "make_server",
+           "serve"]

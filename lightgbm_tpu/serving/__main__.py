@@ -1,0 +1,49 @@
+"""``python -m lightgbm_tpu.serving`` — run the inference front-end.
+
+Same key=value argument convention as the training CLI (application.py):
+
+    python -m lightgbm_tpu.serving model=LightGBM_model.txt \\
+        name=default port=8080 max_batch=1024 max_wait_ms=2
+
+Multiple models: model=a.txt,b.txt name=champion,challenger.  More models
+can be published later over HTTP (POST /v1/models/<name>:publish).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+
+def main(argv: List[str]) -> int:
+    args: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise SystemExit(
+                f"unrecognized argument {a!r} (expected key=value)")
+        k, v = a.split("=", 1)
+        args[k.strip()] = v.strip()
+
+    from .server import ServingApp, serve
+
+    app = ServingApp(
+        max_batch=int(args.get("max_batch", 1024)),
+        max_wait_ms=float(args.get("max_wait_ms", 2.0)),
+        max_queue_rows=int(args.get("max_queue_rows", 16384)),
+        batching=args.get("batching", "1") not in ("0", "false"))
+
+    models = [m for m in args.get("model", "").split(",") if m]
+    names = [n for n in args.get("name", "").split(",") if n]
+    names += ["default" if not names and len(models) == 1 else f"model{i}"
+              for i in range(len(names), len(models))]
+    for path, name in zip(models, names):
+        version = app.registry.publish(name, model_file=path)
+        print(f"published {path} as {name!r} v{version}", flush=True)
+
+    serve(app, host=args.get("host", "127.0.0.1"),
+          port=int(args.get("port", 8080)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,265 @@
+"""MicroBatcher: coalesce concurrent small requests into device batches.
+
+Accelerator tree inference is throughput-limited by batch size: a 1-row
+predict pays the same dispatch + kernel-launch cost as a 1024-row one (the
+GPU/accelerator GBDT literature's core observation — keep the device fed
+with large fixed-shape batches).  A serving front-end therefore must NOT
+forward each request to the device individually; it should ride-share.
+
+The batcher is a bounded queue plus one flush worker:
+
+- ``submit(rows)`` enqueues a request and returns a Future;
+- the worker coalesces whatever is queued into one padded device batch,
+  flushing when ``max_batch`` rows are ready or the oldest request has
+  waited ``max_wait_ms`` (latency cap), whichever comes first;
+- results are scattered back to the per-request futures by row slice;
+- admission control is a hard row bound: when ``max_queue_rows`` worth of
+  requests are already waiting, ``submit`` raises ``QueueFullError``
+  immediately instead of growing the queue without bound (backpressure the
+  caller can act on, rather than a latency collapse or OOM later).
+
+Because all requests in a flush go through ONE ``CompiledPredictor.predict``
+call and tree traversal is row-independent, coalescing is invisible in the
+numbers: each request's rows come back bit-identical to a direct predict.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from ..log import LightGBMError
+from ..timer import timed
+
+__all__ = ["MicroBatcher", "QueueFullError"]
+
+_NO_META = object()  # sentinel: predictor returned a bare array (no meta)
+
+
+class QueueFullError(LightGBMError):
+    """Raised by submit() when the bounded request queue is at capacity."""
+
+
+class _Request:
+    __slots__ = ("rows", "future", "t_enqueue")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatcher:
+    """Thread-safe request coalescer in front of a CompiledPredictor.
+
+    ``predictor`` only needs a ``predict(X, **predict_kwargs)`` method
+    returning an array — or an ``(array, meta)`` pair, in which case meta
+    is delivered with every request's result from that flush.  The
+    registry's per-model dispatch uses the pair form to report the exact
+    version that served a coalesced batch, which is how hot-swap composes
+    with batching (each flush resolves the current model version exactly
+    once, so one response can never mix versions).
+    """
+
+    def __init__(self, predictor, max_batch: int = 1024,
+                 max_wait_ms: float = 2.0, max_queue_rows: int = 16384,
+                 metrics=None, predict_kwargs: Optional[dict] = None,
+                 autostart: bool = True):
+        self.predictor = predictor
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self.metrics = metrics
+        self.predict_kwargs = dict(predict_kwargs or {})
+        self._q: deque = deque()
+        self._queued_rows = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._discard = False   # close(drain=False): worker stops flushing
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        """Start the flush worker (idempotent).  Construction with
+        autostart=False lets tests fill the queue deterministically."""
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="lgbm-tpu-microbatcher",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def submit(self, rows) -> Future:
+        """Enqueue one request; the Future resolves to its predictions.
+
+        Raises QueueFullError when the request won't fit behind what's
+        already waiting.  An EMPTY queue always admits, even a request
+        larger than max_queue_rows — otherwise an oversized request would
+        be rejected forever no matter how often the caller retries; this
+        way it degrades to a solo flush instead (the bound still caps
+        growth: at most one oversized request is ever queued)."""
+        rows = np.atleast_2d(np.asarray(rows))
+        n = rows.shape[0]
+        with self._lock:
+            if self._closed:
+                raise LightGBMError("MicroBatcher is closed")
+            if self._q and self._queued_rows + n > self.max_queue_rows:
+                if self.metrics is not None:
+                    self.metrics.record_rejection()
+                raise QueueFullError(
+                    f"serving queue full: {self._queued_rows} rows waiting, "
+                    f"request of {n} exceeds max_queue_rows="
+                    f"{self.max_queue_rows}")
+            req = _Request(rows)
+            self._q.append(req)
+            self._queued_rows += n
+            if self.metrics is not None:
+                self.metrics.record_queue(self._queued_rows)
+            self._wake.notify()
+        return req.future
+
+    def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(rows).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    # ------------------------------------------------------------------
+    def _take_batch(self):
+        """Block until requests are ready, then pop up to max_batch rows.
+
+        Flushes early when max_batch rows are queued; otherwise waits out
+        the remainder of the oldest request's max_wait_ms window so
+        near-simultaneous requests can ride along."""
+        with self._lock:
+            while not self._q and not self._closed:
+                self._wake.wait()
+            if self._discard:
+                return None  # close(drain=False): leave the backlog to close
+            if not self._q:
+                return None  # closed and drained
+            deadline = self._q[0].t_enqueue + self.max_wait_s
+            while (self._queued_rows < self.max_batch
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._wake.wait(timeout=remaining)
+            if self._discard:
+                # close(drain=False) landed while waiting out max_wait_ms:
+                # the backlog belongs to close()'s cancel loop, not us
+                return None
+            batch, rows = [], 0
+            while self._q and (not batch
+                               or rows + self._q[0].rows.shape[0]
+                               <= self.max_batch):
+                req = self._q.popleft()
+                rows += req.rows.shape[0]
+                batch.append(req)
+            self._queued_rows -= rows
+            if self.metrics is not None:
+                self.metrics.record_queue(self._queued_rows)
+            return batch
+
+    def _flush(self, batch) -> None:
+        t0 = time.perf_counter()
+        try:
+            # inside the try: mixed-width requests make concatenate raise,
+            # which must hit the per-request isolation below, not kill the
+            # worker thread
+            X = (batch[0].rows if len(batch) == 1
+                 else np.concatenate([r.rows for r in batch], axis=0))
+            with timed("serving::batch"):
+                out = self.predictor.predict(X, **self.predict_kwargs)
+        except BaseException as exc:
+            # a coalesced batch mixes unrelated clients, so a failure must
+            # not poison innocent requests (e.g. a hot-swap changed the
+            # model's feature count mid-queue): retry each request SOLO and
+            # let only the genuinely bad ones fail.  Depth is bounded — the
+            # single-request path below scatters the exception directly.
+            if len(batch) > 1:
+                for req in batch:
+                    self._flush([req])
+                return
+            for req in batch:
+                if not req.future.set_running_or_notify_cancel():
+                    continue
+                req.future.set_exception(exc)
+            if self.metrics is not None:
+                for req in batch:
+                    self.metrics.record_request(req.rows.shape[0],
+                                                error=True)
+            return
+        device_s = time.perf_counter() - t0
+        # a predictor may return (array, meta) — meta (e.g. the registry
+        # version that served this flush) is attached to every request's
+        # result, so callers learn exactly which model produced their rows
+        meta = _NO_META
+        if type(out) is tuple:
+            out, meta = out
+        lo = 0
+        t_done = time.perf_counter()
+        for req in batch:
+            hi = lo + req.rows.shape[0]
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(
+                    out[lo:hi] if meta is _NO_META else (out[lo:hi], meta))
+            lo = hi
+            if self.metrics is not None:
+                self.metrics.record_request(req.rows.shape[0],
+                                            latency_s=t_done - req.t_enqueue)
+        if self.metrics is not None:
+            self.metrics.record_batch(len(batch), X.shape[0], device_s)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._flush(batch)
+
+    # ------------------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting requests; by default flush what's queued.
+
+        With drain=False, still-queued requests are CANCELLED (their
+        futures raise CancelledError) rather than flushed or abandoned:
+        the worker stops picking up work (at most its in-flight device
+        call completes) and a waiter blocked in Future.result() must
+        never hang forever."""
+        with self._lock:
+            self._closed = True
+            self._discard = not drain
+            self._wake.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30.0)
+        # worker exited; resolve anything it never picked up
+        while True:
+            with self._lock:
+                if not self._q:
+                    break
+                req = self._q.popleft()
+                self._queued_rows -= req.rows.shape[0]
+            if drain:
+                self._flush([req])
+            else:
+                req.future.cancel()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

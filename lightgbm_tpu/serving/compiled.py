@@ -1,0 +1,239 @@
+"""CompiledPredictor: a Booster snapshot specialized for serving.
+
+``Booster.predict`` is built for correctness and API fidelity: it re-bins
+or re-walks trees per call and happily retraces XLA programs for every new
+row count.  A serving deployment has the opposite profile — one frozen
+model, millions of small requests, and a hard requirement that the device
+never recompiles in steady state (an XLA compile is tens of ms on CPU and
+seconds on TPU, i.e. an SLO-violating tail for whoever hits the new shape).
+
+This module freezes the model once and compiles on a grid:
+
+- trees are packed ONCE via ``stack_trees`` and the ``StackedTrees`` arrays
+  stay resident on device for the predictor's lifetime;
+- incoming batches are zero-padded up to a power-of-two row bucket
+  (``ops.predict.row_bucket``), so the space of input shapes is a small
+  ladder rather than the naturals;
+- executables are AOT-compiled (``jax.jit(...).lower(...).compile()``) and
+  cached under the key ``(batch_bucket, num_features, dtype,
+  start_iteration, num_iteration, output_kind)``;
+- ``compile_count`` increments only when a key misses, which is what the
+  zero-recompile-after-warmup tests assert on.
+
+Tree traversal is row-independent (each row's leaf sum never reads another
+row), so bucket padding cannot change the first-n results — the serving
+path returns the same numbers whether a row arrived alone or coalesced
+into a 4096-row batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..log import LightGBMError
+from ..objectives import output_transform
+from ..ops.predict import (DEFAULT_BUCKET_LADDER, StackedTrees, pad_rows,
+                           predict_trees, row_bucket)
+from ..timer import timed
+
+__all__ = ["CompiledPredictor"]
+
+
+class CompiledPredictor:
+    """Device-resident, shape-bucketed predictor for one model snapshot.
+
+    Thread-safe: concurrent ``predict`` calls share the executable cache
+    under a lock and run compiled programs without one (XLA executables are
+    reentrant), which is what lets the micro-batcher and direct callers hit
+    the same predictor.
+    """
+
+    def __init__(self, booster, buckets=None, dtype=None,
+                 metrics=None, max_programs: int = 256):
+        self.buckets: Tuple[int, ...] = tuple(buckets or DEFAULT_BUCKET_LADDER)
+        self.dtype = np.dtype(dtype or np.float32)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # LRU-bounded: client-controlled key parts (row bucket, iteration
+        # range, output kind) must not let request traffic grow the
+        # executable cache without bound.  The cap is far above what the
+        # bucket ladder warms, so steady traffic never evicts its programs.
+        self.max_programs = int(max_programs)
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self.compile_count = 0
+
+        # weakref only: a strong reference would pin the booster — and
+        # through it the full binned training Dataset — in memory for the
+        # predictor's lifetime, when all is_stale() needs is _model_version
+        self._booster_ref = weakref.ref(booster)
+        self.model_version = booster._model_version
+        self.num_class = booster.num_model_per_iteration()
+        self.num_feature = booster.num_feature()
+        self.best_iteration = booster.best_iteration
+        if booster._gbdt is not None:
+            self._objective = booster._gbdt.objective.to_string()
+            self._average_output = bool(
+                getattr(booster._gbdt, "average_output", False))
+            trees = booster._gbdt.models
+        else:
+            self._objective = booster._loaded_meta.get("objective", "")
+            self._average_output = bool(
+                booster._loaded_meta.get("average_output"))
+            trees = booster._loaded_trees
+        if any(t.is_linear for t in trees):
+            # stack_trees packs only constant leaf values; traversing a
+            # linear tree's leaves without its coefficients would return
+            # plausible-looking but WRONG numbers — fail loudly instead
+            # (Booster.predict handles linear trees via its host fallback)
+            raise LightGBMError(
+                "CompiledPredictor does not support linear_tree models; "
+                "use Booster.predict for linear-leaf inference")
+        n_trees = len(trees)
+        self.n_iterations = n_trees // max(self.num_class, 1)
+        # one stacking for the whole model; per-range programs slice the
+        # packed arrays statically inside jit (no re-pack per range)
+        self._stacked: Optional[StackedTrees] = booster.stacked_trees(0, -1)
+
+    # ------------------------------------------------------------------
+    def is_stale(self) -> bool:
+        """True when the source booster mutated after this snapshot was
+        taken (the predictor keeps serving the old trees by design —
+        publish a new predictor to pick up changes).  A garbage-collected
+        booster can no longer mutate, so the snapshot is not stale."""
+        booster = self._booster_ref()
+        return (booster is not None
+                and booster._model_version != self.model_version)
+
+    def _iter_range(self, start_iteration: int,
+                    num_iteration: int) -> Tuple[int, int]:
+        start_iteration = int(start_iteration)
+        if start_iteration < 0:
+            # a negative start would slice the packed arrays from the END
+            # under jit and return plausible-looking garbage
+            raise LightGBMError(
+                f"start_iteration must be >= 0, got {start_iteration}")
+        if num_iteration is None:
+            num_iteration = -1
+        num_iteration = int(num_iteration)
+        if num_iteration < 0 and self.best_iteration > 0:
+            num_iteration = self.best_iteration
+        start_iteration = min(start_iteration, self.n_iterations)
+        end = self.n_iterations if num_iteration < 0 else min(
+            start_iteration + num_iteration, self.n_iterations)
+        return start_iteration, max(end, start_iteration)
+
+    # ------------------------------------------------------------------
+    def _build(self, key):
+        bucket, nfeat, dtype_str, s, e, kind = key
+        k = self.num_class
+        lo, hi = s * k, e * k
+        n_used = e - s
+        # raw is [N] single-class / [K, N] multiclass -> class_axis=0
+        transform = output_transform(self._objective, xp=jnp, class_axis=0)
+        average = self._average_output
+
+        def fn(st: StackedTrees, X):
+            sub = StackedTrees(*[a[lo:hi] for a in st[:9]], st.max_depth)
+            if k == 1:
+                raw = predict_trees(sub, X, output="sum")          # [N]
+            else:
+                per_tree = predict_trees(sub, X, output="per_tree")
+                raw = per_tree.reshape(n_used, k, -1).sum(axis=0)  # [K, N]
+            if average:
+                raw = raw / n_used
+            if kind == "prob":
+                raw = transform(raw)
+            return raw
+
+        x_spec = jax.ShapeDtypeStruct((bucket, nfeat), np.dtype(dtype_str))
+        return jax.jit(fn).lower(self._stacked, x_spec).compile()
+
+    def _get_compiled(self, key):
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)  # LRU touch
+                return fn
+        # build OUTSIDE the lock: an XLA compile can take seconds and must
+        # not stall concurrent cache-hit traffic; a rare duplicate build on
+        # a concurrent first hit of the same key is harmless (one wins, and
+        # compile_count counts only the inserted one)
+        with timed("serving::compile"):
+            fn = self._build(key)
+        with self._lock:
+            cur = self._cache.get(key)
+            if cur is not None:
+                self._cache.move_to_end(key)
+                return cur
+            self._cache[key] = fn
+            self.compile_count += 1
+            while len(self._cache) > self.max_programs:
+                self._cache.popitem(last=False)
+        return fn
+
+    # ------------------------------------------------------------------
+    def warmup(self, kinds=("prob",), start_iteration: int = 0,
+               num_iteration: int = -1, buckets=None) -> int:
+        """Pre-compile the bucket ladder for the given output kinds.
+
+        Returns the number of executables compiled; after this, steady
+        traffic of any row count <= max(bucket ladder) with the same
+        iteration range runs with zero new compiles."""
+        s, e = self._iter_range(start_iteration, num_iteration)
+        if e <= s:
+            return 0
+        before = self.compile_count
+        for bucket in (buckets or self.buckets):
+            for kind in kinds:
+                self._get_compiled((int(bucket), self.num_feature,
+                                    str(self.dtype), s, e, kind))
+        return self.compile_count - before
+
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: int = -1, raw_score: bool = False) -> np.ndarray:
+        """Bucket-padded device predict; same signature subset and output
+        conventions as Booster.predict."""
+        X = np.atleast_2d(np.asarray(data))
+        # too-narrow input would silently traverse clamped feature indices
+        # under jit and return plausible-looking garbage — reject it here.
+        # Wider input is sliced down (extra columns are never indexed),
+        # matching Booster.predict's tolerance AND keeping the cache keyed
+        # on one width — otherwise every distinct client width would
+        # compile its own program ladder.
+        if X.shape[1] < self.num_feature:
+            raise LightGBMError(
+                f"predict called with {X.shape[1]} features; model expects "
+                f"{self.num_feature}")
+        X = np.ascontiguousarray(X[:, :self.num_feature], dtype=self.dtype)
+        n = X.shape[0]
+        k = self.num_class
+        s, e = self._iter_range(start_iteration, num_iteration)
+        kind = "raw" if raw_score else "prob"
+        if e <= s or n == 0:
+            raw = np.zeros((k, n)) if k > 1 else np.zeros((n,))
+            if kind == "prob":
+                # zero trees in range must still apply the link, matching
+                # Booster.predict
+                raw = output_transform(self._objective, xp=np,
+                                       class_axis=0)(raw)
+            return raw if k == 1 else raw.T
+        bucket = row_bucket(n, self.buckets)
+        key = (bucket, X.shape[1], str(self.dtype), s, e, kind)
+        fn = self._get_compiled(key)
+        with timed("serving::predict"):
+            out = fn(self._stacked, jnp.asarray(pad_rows(X, bucket)))
+            out = np.asarray(out, np.float64)
+        if self.metrics is not None:
+            self.metrics.record_device(n)
+        if k > 1:
+            return out[:, :n].T
+        return out[:n]
+
+    __call__ = predict

@@ -1,0 +1,160 @@
+"""Per-model serving counters and latency histograms.
+
+Every observable the serving stack exposes funnels through one
+``ServingMetrics`` instance: request/row/batch counters, batch-fill ratio
+(how much the micro-batcher actually coalesces), queue depth, XLA compile
+count, and request-latency percentiles.  ``snapshot()`` renders the whole
+thing as a plain dict so the HTTP front-end can serve it as JSON and tests
+can assert on it without scraping.
+
+Wall-clock attribution additionally follows the package-wide phase-timer
+convention (timer.py, ``LIGHTGBM_TPU_TIMETAG=1``): the hot serving phases
+are accumulated under ``serving::*`` labels in the same global_timer the
+training engine uses, so one flag profiles both halves of the system.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..timer import global_timer, timers_enabled
+
+__all__ = ["LatencyWindow", "ModelMetrics", "ServingMetrics"]
+
+_PCTS = (50.0, 95.0, 99.0)
+
+
+class LatencyWindow:
+    """Bounded ring of recent latencies (seconds) with percentile reads.
+
+    A fixed window keeps memory constant under sustained traffic while
+    still tracking the current latency distribution; serving dashboards
+    care about "now", not the all-time distribution."""
+
+    def __init__(self, capacity: int = 4096):
+        self._cap = int(capacity)
+        self._buf = [0.0] * self._cap
+        self._n = 0          # total observations ever
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._n % self._cap] = float(seconds)
+            self._n += 1
+
+    def percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            live = sorted(self._buf[:min(self._n, self._cap)])
+        if not live:
+            return {f"p{int(p)}_ms": 0.0 for p in _PCTS}
+        out = {}
+        for p in _PCTS:
+            idx = min(int(len(live) * p / 100.0), len(live) - 1)
+            out[f"p{int(p)}_ms"] = live[idx] * 1e3
+        return out
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+
+class ModelMetrics:
+    """Counters for one served model (all versions pooled)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batched_requests = 0
+        self.batched_rows = 0
+        self.errors = 0
+        self.device_calls = 0       # compiled-program executions
+        self.device_rows = 0        # rows actually sent to the device
+        self.queue_depth = 0        # gauge, set by the batcher
+        self.queue_rejections = 0
+        self.latency = LatencyWindow()
+
+    def record_request(self, rows: int, latency_s: Optional[float] = None,
+                       error: bool = False) -> None:
+        """One USER-FACING request (batcher scatter or app direct path).
+        The predictor's own device call is recorded separately via
+        record_device, so coalesced traffic isn't double-counted."""
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+            if error:
+                self.errors += 1
+        if latency_s is not None:
+            self.latency.observe(latency_s)
+
+    def record_device(self, rows: int) -> None:
+        """One compiled-program execution of `rows` real (pre-pad) rows."""
+        with self._lock:
+            self.device_calls += 1
+            self.device_rows += int(rows)
+
+    def record_batch(self, n_requests: int, n_rows: int,
+                     device_s: float) -> None:
+        """One coalesced device call serving `n_requests` requests."""
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += int(n_requests)
+            self.batched_rows += int(n_rows)
+        if timers_enabled():
+            global_timer.add("serving::batch_predict", device_s)
+
+    def record_queue(self, depth: int) -> None:
+        self.queue_depth = int(depth)
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self.queue_rejections += 1
+
+    def snapshot(self, compile_count: Optional[int] = None) -> Dict:
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "errors": self.errors,
+                "device_calls": self.device_calls,
+                "device_rows": self.device_rows,
+                "queue_depth": self.queue_depth,
+                "queue_rejections": self.queue_rejections,
+                # >1 means the micro-batcher is actually coalescing:
+                # device calls are amortized over multiple requests
+                "batch_fill_ratio": (self.batched_requests / self.batches
+                                     if self.batches else 0.0),
+                # batched rows only: direct-path requests bump self.rows
+                # but never ride a flush, and would inflate this
+                "rows_per_batch": (self.batched_rows / self.batches
+                                   if self.batches else 0.0),
+            }
+        out.update(self.latency.percentiles())
+        if compile_count is not None:
+            out["compile_count"] = int(compile_count)
+        return out
+
+
+class ServingMetrics:
+    """name -> ModelMetrics, created on first touch."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, ModelMetrics] = {}
+
+    def model(self, name: str) -> ModelMetrics:
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                m = self._models[name] = ModelMetrics()
+            return m
+
+    def snapshot(self, compile_counts: Optional[Dict[str, int]] = None) -> Dict:
+        compile_counts = compile_counts or {}
+        with self._lock:
+            names = list(self._models.items())
+        return {name: m.snapshot(compile_counts.get(name))
+                for name, m in names}

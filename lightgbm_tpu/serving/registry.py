@@ -1,0 +1,194 @@
+"""ModelRegistry: name -> version -> CompiledPredictor with atomic hot-swap.
+
+A serving deployment never gets to stop the world to roll a model: new
+versions are published while requests are in flight, bad versions are
+rolled back, and whatever an in-flight request resolved must keep working
+until it finishes.  The registry provides exactly that contract:
+
+- ``publish`` installs a new version and atomically repoints the name's
+  "current" — requests that already resolved a version finish on it,
+  requests that resolve after the swap get the new one, and nothing in
+  between can observe a half-installed model;
+- every resolution goes through a refcount (``acquire`` context manager),
+  so a superseded version is retired (dropped, device arrays freed) only
+  after its last in-flight request releases it;
+- the previous version is intentionally kept resident for instant
+  ``rollback`` (the operational "undo" for a bad push);
+- models load from a live Booster, a model string, or a model file —
+  reusing ``Booster(model_str=...)`` so the registry accepts exactly what
+  ``save_model`` produces.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from ..log import LightGBMError
+from .compiled import CompiledPredictor
+
+__all__ = ["ModelRegistry"]
+
+
+class _Entry:
+    """One published version: predictor + refcount + retirement flag."""
+
+    __slots__ = ("predictor", "version", "refs", "retired")
+
+    def __init__(self, predictor: CompiledPredictor, version: int):
+        self.predictor = predictor
+        self.version = version
+        self.refs = 0
+        self.retired = False
+
+
+class _Model:
+    __slots__ = ("versions", "current", "previous", "next_version")
+
+    def __init__(self):
+        self.versions: Dict[int, _Entry] = {}
+        self.current: Optional[int] = None
+        self.previous: Optional[int] = None
+        self.next_version = 1
+
+
+class ModelRegistry:
+    def __init__(self, metrics=None, buckets=None, dtype=None):
+        self._lock = threading.Lock()
+        self._models: Dict[str, _Model] = {}
+        self._metrics = metrics
+        self._buckets = buckets
+        self._dtype = dtype
+
+    # ------------------------------------------------------------------
+    def publish(self, name: str, booster=None, predictor=None,
+                model_str: Optional[str] = None,
+                model_file: Optional[str] = None,
+                warmup: bool = True) -> int:
+        """Install a new version of `name` and make it current.
+
+        Exactly one model source must be given.  With warmup=True (the
+        default) the bucket ladder is pre-compiled BEFORE the swap, so the
+        first requests on the new version don't eat its compile latency.
+        Returns the published version number."""
+        sources = [s for s in (booster, predictor, model_str, model_file)
+                   if s is not None]
+        if len(sources) != 1:
+            raise LightGBMError(
+                "publish needs exactly one of booster/predictor/"
+                f"model_str/model_file (got {len(sources)})")
+        if predictor is None:
+            if booster is None:
+                from ..basic import Booster
+                booster = Booster(model_str=model_str, model_file=model_file)
+            metrics = (self._metrics.model(name)
+                       if self._metrics is not None else None)
+            predictor = CompiledPredictor(booster, buckets=self._buckets,
+                                          dtype=self._dtype, metrics=metrics)
+        if warmup:
+            predictor.warmup()
+        with self._lock:
+            model = self._models.get(name)
+            if model is None:
+                model = self._models[name] = _Model()
+            version = model.next_version
+            model.next_version += 1
+            model.versions[version] = _Entry(predictor, version)
+            # retire the old "previous"; keep the old "current" for rollback
+            if model.previous is not None:
+                self._retire_locked(model, model.previous)
+            model.previous = model.current
+            model.current = version
+            return version
+
+    def rollback(self, name: str) -> int:
+        """Swap current back to the previous version (and keep the rolled-
+        back one as the new previous, so rollback is itself undoable)."""
+        with self._lock:
+            model = self._must_get(name)
+            if model.previous is None:
+                raise LightGBMError(
+                    f"model {name!r} has no previous version to roll back to")
+            model.current, model.previous = model.previous, model.current
+            return model.current
+
+    def unpublish(self, name: str) -> None:
+        """Remove `name` entirely; versions free once their refs drain."""
+        with self._lock:
+            model = self._models.pop(name, None)
+        if model is not None:
+            for v in list(model.versions):
+                model.versions[v].retired = True
+
+    # ------------------------------------------------------------------
+    def _must_get(self, name: str) -> _Model:
+        model = self._models.get(name)
+        if model is None or model.current is None:
+            raise LightGBMError(f"no model published under name {name!r}")
+        return model
+
+    def _retire_locked(self, model: _Model, version: int) -> None:
+        entry = model.versions.get(version)
+        if entry is None:
+            return
+        entry.retired = True
+        if entry.refs == 0:
+            del model.versions[version]
+
+    @contextmanager
+    def acquire(self, name: str, version: Optional[int] = None):
+        """Resolve (predictor, version) and hold a reference for the
+        duration of the block: a publish/rollback during the block cannot
+        retire the predictor out from under the caller."""
+        with self._lock:
+            model = self._must_get(name)
+            v = model.current if version is None else version
+            entry = model.versions.get(v)
+            if entry is None:
+                raise LightGBMError(
+                    f"model {name!r} has no version {v} (available: "
+                    f"{sorted(model.versions)})")
+            entry.refs += 1
+        try:
+            yield entry.predictor, entry.version
+        finally:
+            with self._lock:
+                entry.refs -= 1
+                if entry.retired and entry.refs == 0:
+                    model.versions.pop(entry.version, None)
+
+    # ------------------------------------------------------------------
+    def predict(self, name: str, data, version: Optional[int] = None,
+                **predict_kwargs):
+        """One-shot predict against the current (or pinned) version."""
+        with self.acquire(name, version) as (predictor, _):
+            return predictor.predict(data, **predict_kwargs)
+
+    def current_version(self, name: str) -> int:
+        with self._lock:
+            return self._must_get(name).current
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._must_get(name).versions)
+
+    def models(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                name: {
+                    "current": m.current,
+                    "previous": m.previous,
+                    "versions": sorted(m.versions),
+                }
+                for name, m in self._models.items() if m.current is not None
+            }
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Total XLA compiles per model name, summed over live versions."""
+        with self._lock:
+            return {
+                name: sum(e.predictor.compile_count
+                          for e in m.versions.values())
+                for name, m in self._models.items()
+            }

@@ -1,0 +1,248 @@
+"""Minimal multi-model inference front-end (stdlib only).
+
+Two layers, deliberately separable:
+
+- ``ServingApp`` — the transport-free request handler.  Every route takes
+  and returns plain dicts via ``handle(method, path, body)``, so tests and
+  embedders drive the full serving path (registry resolution, micro-batch
+  coalescing, metrics) in-process without opening a socket.
+- ``serve()`` / ``_Handler`` — a ``ThreadingHTTPServer`` wrapper that does
+  nothing but JSON <-> ``handle`` plumbing.  ``python -m
+  lightgbm_tpu.serving`` starts it (see __main__.py).
+
+Routes (JSON bodies):
+
+- ``GET  /healthz``                     liveness
+- ``GET  /v1/models``                   registry listing
+- ``GET  /v1/metrics``                  ServingMetrics snapshot
+- ``POST /v1/models/<name>:publish``    {"model_file"|"model_str": ...}
+- ``POST /v1/models/<name>:rollback``
+- ``POST /v1/models/<name>:predict``    {"rows": [[...]...],
+                                         "start_iteration"?, "num_iteration"?,
+                                         "raw_score"?, "version"?}
+
+Default-parameter predicts are coalesced per model by a MicroBatcher whose
+"predictor" is the registry dispatch itself — each flush resolves the
+current version exactly once, so hot-swaps never mix versions inside one
+response.  Non-default predicts (pinned version, iteration slices, raw
+scores) bypass batching and go straight through the registry.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..log import LightGBMError
+from .batcher import MicroBatcher, QueueFullError
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+
+__all__ = ["ServingApp", "make_server", "serve"]
+
+
+class _RegistryDispatch:
+    """Adapter giving the MicroBatcher a predict() that resolves the
+    model's CURRENT version per call (i.e. per coalesced flush).
+
+    Returns ``(predictions, version)`` from ONE acquire, so the version
+    attached to each scattered result is exactly the one that served the
+    flush — reading current_version afterwards could report a concurrent
+    publish's version (or 404 after an unpublish) for predictions that
+    were in fact computed successfully."""
+
+    def __init__(self, registry: ModelRegistry, name: str):
+        self._registry = registry
+        self._name = name
+        # advisory width for the server's pre-coalesce check, refreshed at
+        # every flush so the hot path never takes the registry lock just
+        # to read it; staleness across a hot-swap is safe — a genuinely
+        # mismatched batch falls back to per-request isolation
+        with registry.acquire(name) as (pred, _):
+            self.num_feature = pred.num_feature
+
+    def predict(self, X):
+        with self._registry.acquire(self._name) as (pred, version):
+            self.num_feature = pred.num_feature
+            return pred.predict(X), version
+
+
+class ServingApp:
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_batch: int = 1024, max_wait_ms: float = 2.0,
+                 max_queue_rows: int = 16384, batching: bool = True):
+        self.metrics = metrics or ServingMetrics()
+        self.registry = registry or ModelRegistry(metrics=self.metrics)
+        self.batching = batching
+        self._batch_cfg = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                               max_queue_rows=max_queue_rows)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def _batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            b = self._batchers.get(name)
+            if b is None:
+                # a batcher owns a worker thread and is kept for the app's
+                # lifetime, so unknown/typo'd names must 404 HERE — before
+                # allocation — or sustained bad traffic leaks a thread per
+                # distinct name (_RegistryDispatch's constructor acquire
+                # raises for unpublished names)
+                b = self._batchers[name] = MicroBatcher(
+                    _RegistryDispatch(self.registry, name),
+                    metrics=self.metrics.model(name), **self._batch_cfg)
+            return b
+
+    def close(self) -> None:
+        with self._lock:
+            batchers, self._batchers = dict(self._batchers), {}
+        for b in batchers.values():
+            b.close()
+
+    # ------------------------------------------------------------------
+    def handle(self, method: str, path: str,
+               body: Optional[dict] = None) -> Tuple[int, dict]:
+        """Pure request handler: (status_code, response_dict)."""
+        try:
+            return self._route(method.upper(), path.rstrip("/") or "/",
+                               body or {})
+        except QueueFullError as exc:
+            return 429, {"error": str(exc)}
+        except LightGBMError as exc:
+            return 404 if "no model published" in str(exc) else 400, \
+                {"error": str(exc)}
+        except (KeyError, ValueError, TypeError, OSError) as exc:
+            # OSError: e.g. publish with a nonexistent model_file must be
+            # the client's 400, not an escaped FileNotFoundError
+            return 400, {"error": f"{type(exc).__name__}: {exc}"}
+
+    def _route(self, method: str, path: str, body: dict) -> Tuple[int, dict]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"status": "ok"}
+        if method == "GET" and path == "/v1/models":
+            return 200, {"models": self.registry.models()}
+        if method == "GET" and path == "/v1/metrics":
+            return 200, self.metrics.snapshot(self.registry.compile_counts())
+        if path.startswith("/v1/models/") and ":" in path:
+            rest = path[len("/v1/models/"):]
+            name, _, verb = rest.rpartition(":")
+            if method == "POST" and name:
+                if verb == "predict":
+                    return self._predict(name, body)
+                if verb == "publish":
+                    return self._publish(name, body)
+                if verb == "rollback":
+                    version = self.registry.rollback(name)
+                    return 200, {"name": name, "version": version}
+        return 404, {"error": f"no route for {method} {path}"}
+
+    # ------------------------------------------------------------------
+    def _publish(self, name: str, body: dict) -> Tuple[int, dict]:
+        version = self.registry.publish(
+            name,
+            model_str=body.get("model_str"),
+            model_file=body.get("model_file"),
+            warmup=bool(body.get("warmup", True)))
+        return 200, {"name": name, "version": version}
+
+    def _predict(self, name: str, body: dict) -> Tuple[int, dict]:
+        rows = np.asarray(body["rows"], dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
+        t0 = time.perf_counter()
+        kwargs = {}
+        for key in ("start_iteration", "num_iteration"):
+            if key in body:
+                kwargs[key] = int(body[key])  # non-numeric -> 400
+        if "raw_score" in body:
+            kwargs["raw_score"] = bool(body["raw_score"])
+        version = body.get("version")
+        default_call = not kwargs and version is None
+        if default_call and self.batching:
+            # reject too-narrow bodies BEFORE coalescing so the error is
+            # this request's own 400, not a poisoned flush.  Full-width
+            # rows stay in the queue (the predictor slices extra columns
+            # itself), so a hot-swap to a wider model mid-queue can still
+            # serve clients that sent enough columns; a genuinely
+            # mixed-width batch falls back to per-request isolation in
+            # MicroBatcher._flush.
+            batcher = self._batcher(name)
+            nfeat = batcher.predictor.num_feature
+            if rows.shape[1] < nfeat:
+                raise LightGBMError(
+                    f"predict called with {rows.shape[1]} features; model "
+                    f"{name!r} expects {nfeat}")
+            out, served_version = batcher.predict(rows)
+        else:
+            with self.registry.acquire(name, version) as (pred, v):
+                out = pred.predict(rows, **kwargs)
+                served_version = v
+            self.metrics.model(name).record_request(
+                rows.shape[0], latency_s=time.perf_counter() - t0)
+        return 200, {"name": name, "version": served_version,
+                     "predictions": np.asarray(out).tolist()}
+
+
+# ---------------------------------------------------------------------------
+def make_server(app: ServingApp, host: str = "127.0.0.1", port: int = 8080):
+    """Bind a ThreadingHTTPServer wrapping `app` without starting it.
+
+    Returned server is a plain http.server instance: call serve_forever()
+    to run, shutdown() from another thread to stop (which is how the slow
+    socket test drives it on an ephemeral port)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def _respond(self, method):
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                except json.JSONDecodeError as exc:
+                    self._send(400, {"error": f"bad JSON body: {exc}"})
+                    return
+            status, payload = app.handle(method, self.path, body)
+            self._send(status, payload)
+
+        def _send(self, status, payload):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._respond("GET")
+
+        def do_POST(self):
+            self._respond("POST")
+
+        def log_message(self, fmt, *args):  # route logs through our logger
+            from ..log import log_info
+            log_info("serving: " + fmt % args)
+
+    return ThreadingHTTPServer((host, port), _Handler)
+
+
+def serve(app: ServingApp, host: str = "127.0.0.1", port: int = 8080):
+    """Blocking stdlib HTTP server around `app` (ThreadingHTTPServer, so
+    concurrent requests exercise the micro-batcher)."""
+    httpd = make_server(app, host, port)
+    from ..log import log_info
+    log_info(f"lightgbm_tpu serving on http://{host}:{httpd.server_port}")
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        app.close()
+    return httpd

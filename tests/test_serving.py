@@ -1,0 +1,686 @@
+"""Serving subsystem tests (lightgbm_tpu/serving/).
+
+Everything runs in-process on the CPU backend: the HTTP front-end is
+exercised through ServingApp.handle (the transport-free layer), so no
+sockets are opened and the file is tier-1 safe.
+
+The bit-identity assertions lean on a structural property: tree traversal
+is row-independent, so bucket padding and micro-batch coalescing cannot
+change the first-n results of the SAME compiled engine.  Cross-engine
+comparisons (compiled f32 device path vs Booster.predict's f64 host /
+bin-space paths) use tight allclose instead.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.predict import (DEFAULT_BUCKET_LADDER, pad_rows,
+                                      predict_trees_padded, row_bucket,
+                                      stack_trees, predict_trees)
+from lightgbm_tpu.serving import (CompiledPredictor, MicroBatcher,
+                                  ModelRegistry, QueueFullError, ServingApp,
+                                  ServingMetrics)
+
+RNG = np.random.RandomState(7)
+
+
+def _train(objective="binary", num_class=1, n=400, nfeat=6, rounds=6):
+    X = RNG.randn(n, nfeat).astype(np.float32)
+    if num_class > 1:
+        y = (np.abs(X[:, 0] + X[:, 1]) * 1.5).astype(int) % num_class
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0)
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    if num_class > 1:
+        params["num_class"] = num_class
+    return lgb.train(params, lgb.Dataset(X, y.astype(np.float32)),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    return _train()
+
+
+@pytest.fixture(scope="module")
+def multiclass_booster():
+    return _train(objective="multiclass", num_class=3)
+
+
+# ---------------------------------------------------------------------------
+# ops/predict.py bucket helpers (satellite: pad-to-bucket shared helper)
+# ---------------------------------------------------------------------------
+def test_row_bucket_ladder():
+    assert row_bucket(1) == DEFAULT_BUCKET_LADDER[0]
+    assert row_bucket(8) == 8
+    assert row_bucket(9) == 16
+    assert row_bucket(4096) == 4096
+    # beyond the ladder: next power of two, not an error
+    assert row_bucket(5000) == 8192
+    assert row_bucket(3, ladder=(4, 20)) == 4
+    assert row_bucket(5, ladder=(4, 20)) == 20
+
+
+def test_pad_rows_roundtrip():
+    X = RNG.randn(5, 3).astype(np.float32)
+    P = pad_rows(X, 8)
+    assert P.shape == (8, 3) and P.dtype == X.dtype
+    np.testing.assert_array_equal(P[:5], X)
+    np.testing.assert_array_equal(P[5:], 0.0)
+    assert pad_rows(X, 5) is X
+    with pytest.raises(ValueError):
+        pad_rows(X, 4)
+
+
+def test_pad_rows_to_bucket_exact_above_ladder():
+    from lightgbm_tpu.ops.predict import pad_rows_to_bucket
+    X = RNG.randn(5, 3).astype(np.float32)
+    assert pad_rows_to_bucket(X).shape == (8, 3)
+    big = np.zeros((DEFAULT_BUCKET_LADDER[-1] + 1, 2), np.float32)
+    # serving keeps doubling; one-shot predicts keep the exact shape
+    assert pad_rows_to_bucket(big).shape[0] == 2 * DEFAULT_BUCKET_LADDER[-1]
+    assert pad_rows_to_bucket(big, exact_above=True) is big
+
+
+def test_predict_trees_padded_matches_unpadded(binary_booster):
+    trees = binary_booster._gbdt.models
+    stacked = stack_trees(trees)
+    X = RNG.randn(13, 6).astype(np.float32)
+    import jax.numpy as jnp
+    np.testing.assert_array_equal(
+        np.asarray(predict_trees_padded(stacked, X)),
+        np.asarray(predict_trees(stacked, jnp.asarray(X))))
+
+
+# ---------------------------------------------------------------------------
+# Booster-side caching (satellite: no per-call re-stacking)
+# ---------------------------------------------------------------------------
+def test_stacked_trees_cached_and_invalidated(binary_booster):
+    bst = _train(rounds=3)
+    s1 = bst.stacked_trees()
+    assert bst.stacked_trees() is s1  # cache hit, no re-pack
+    bst.update()
+    s2 = bst.stacked_trees()
+    assert s2 is not s1 and s2.left_child.shape[0] == s1.left_child.shape[0] + 1
+    np.random.seed(0)
+    bst.shuffle_models()
+    assert bst.stacked_trees() is not s2
+    # loaded boosters: model_from_string drops the cache too
+    loaded = lgb.Booster(model_str=binary_booster.model_to_string())
+    l1 = loaded.stacked_trees()
+    assert loaded.stacked_trees() is l1
+    loaded.model_from_string(binary_booster.model_to_string())
+    assert loaded.stacked_trees() is not l1
+
+
+def test_pred_leaf_bucket_padding_consistent(binary_booster):
+    X = RNG.randn(11, 6).astype(np.float32)
+    leaves = binary_booster.predict(X, pred_leaf=True)
+    assert leaves.shape == (11, binary_booster.num_trees())
+    # same rows inside a larger (differently-bucketed) batch: same leaves
+    X2 = np.concatenate([X, RNG.randn(40, 6).astype(np.float32)])
+    np.testing.assert_array_equal(
+        binary_booster.predict(X2, pred_leaf=True)[:11], leaves)
+
+
+# ---------------------------------------------------------------------------
+# CompiledPredictor (tentpole core)
+# ---------------------------------------------------------------------------
+def test_compiled_matches_booster_predict(binary_booster):
+    pred = binary_booster.to_compiled()
+    X = RNG.randn(61, 6).astype(np.float32)
+    np.testing.assert_allclose(pred.predict(X), binary_booster.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        pred.predict(X, raw_score=True),
+        binary_booster.predict(X, raw_score=True), rtol=1e-6, atol=1e-7)
+    # num_iteration / start_iteration slicing
+    for s, n in ((0, 3), (2, 2), (1, -1)):
+        np.testing.assert_allclose(
+            pred.predict(X, start_iteration=s, num_iteration=n),
+            binary_booster.predict(X, start_iteration=s, num_iteration=n),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_compiled_matches_booster_multiclass(multiclass_booster):
+    pred = multiclass_booster.to_compiled()
+    X = RNG.randn(33, 6).astype(np.float32)
+    out = pred.predict(X)
+    ref = multiclass_booster.predict(X)
+    assert out.shape == ref.shape == (33, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        pred.predict(X, num_iteration=2, raw_score=True),
+        multiclass_booster.predict(X, num_iteration=2, raw_score=True),
+        rtol=1e-5, atol=1e-7)
+
+
+def test_compiled_matches_loaded_booster(binary_booster):
+    """Registry-style load path: model string -> Booster -> predictor."""
+    loaded = lgb.Booster(model_str=binary_booster.model_to_string())
+    pred = loaded.to_compiled()
+    X = RNG.randn(29, 6).astype(np.float32)
+    np.testing.assert_allclose(pred.predict(X), loaded.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_bucket_padding_is_row_invariant(binary_booster):
+    """The same rows give bit-identical predictions regardless of which
+    bucket/batch they ride in — the property the whole serving path's
+    numerical story rests on."""
+    pred = binary_booster.to_compiled()
+    X = RNG.randn(300, 6).astype(np.float32)
+    single = pred.predict(X[:5])           # bucket 8
+    inside = pred.predict(X)[:5]           # bucket 512
+    np.testing.assert_array_equal(single, inside)
+
+
+def test_zero_recompiles_after_warmup(binary_booster):
+    """Acceptance: after warming the bucket ladder, 100 mixed-size requests
+    trigger 0 new XLA compiles (counted by the predictor's own cache).
+    A short 3-rung ladder keeps warmup cheap; the bucketing logic is
+    ladder-size independent."""
+    pred = binary_booster.to_compiled(buckets=(8, 64, 512))
+    compiled = pred.warmup()
+    assert compiled == len(pred.buckets)
+    before = pred.compile_count
+    rng = np.random.RandomState(3)
+    for size in rng.randint(1, 513, size=100):
+        pred.predict(rng.randn(size, 6).astype(np.float32))
+    assert pred.compile_count == before
+    # a new output kind is a genuine new program, and is counted
+    pred.predict(RNG.randn(4, 6).astype(np.float32), raw_score=True)
+    assert pred.compile_count == before + 1
+
+
+def test_compiled_empty_range_applies_link(binary_booster):
+    pred = binary_booster.to_compiled()
+    X = RNG.randn(3, 6).astype(np.float32)
+    np.testing.assert_allclose(pred.predict(X, num_iteration=0),
+                               binary_booster.predict(X, num_iteration=0))
+    np.testing.assert_array_equal(
+        pred.predict(X, num_iteration=0, raw_score=True), np.zeros(3))
+
+
+def test_compiled_rejects_bad_inputs(binary_booster):
+    pred = binary_booster.to_compiled(buckets=(8,))
+    with pytest.raises(lgb.LightGBMError, match="features"):
+        pred.predict(np.zeros((2, 4), np.float32))  # too narrow
+    with pytest.raises(lgb.LightGBMError, match="start_iteration"):
+        pred.predict(np.zeros((2, 6), np.float32), start_iteration=-1)
+
+
+def test_compiled_program_cache_bounded(binary_booster):
+    """Client-controlled cache-key parts (iteration range) must not grow
+    the executable cache without bound: LRU-evicted at max_programs."""
+    pred = binary_booster.to_compiled(buckets=(8,), max_programs=3)
+    X = np.zeros((2, 6), np.float32)
+    for s in range(5):
+        pred.predict(X, start_iteration=s, num_iteration=1)
+    assert pred.compile_count == 5
+    assert len(pred._cache) == 3
+
+
+def test_compiled_sqrt_regression_link(binary_booster):
+    """reg_sqrt's sign(s)*s^2 link must survive the serving/loaded paths."""
+    X = RNG.randn(200, 6).astype(np.float32)
+    y = (X[:, 0] * 3 + RNG.randn(200) * 0.1).astype(np.float32)
+    bst = lgb.train({"objective": "regression", "reg_sqrt": True,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, y), 4)
+    live = bst.predict(X)
+    np.testing.assert_allclose(bst.to_compiled(buckets=(256,)).predict(X),
+                               live, rtol=1e-5, atol=1e-6)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), live,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compiled_rejects_linear_trees():
+    """stack_trees drops linear-leaf coefficients, so serving a
+    linear_tree model must fail loudly, not return wrong numbers."""
+    X = RNG.randn(200, 4).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1]).astype(np.float32)
+    bst = lgb.train({"objective": "regression", "linear_tree": True,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, y), 2)
+    with pytest.raises(lgb.LightGBMError, match="linear_tree"):
+        bst.to_compiled()
+
+
+def test_compiled_staleness_flag():
+    bst = _train(rounds=2)
+    pred = bst.to_compiled()
+    assert not pred.is_stale()
+    bst.update()
+    assert pred.is_stale()
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher
+# ---------------------------------------------------------------------------
+def test_microbatcher_concurrent_bit_identical(binary_booster):
+    """Acceptance: 8 threads x mixed batch sizes through the batcher ->
+    results bit-identical to a direct predictor call on the same engine
+    (and allclose to Booster.predict), with real coalescing (fill > 1)."""
+    # short bucket ladder: requests are 1-8 rows and flushes cap at 512,
+    # so warming the full default ladder would just burn suite time
+    pred = binary_booster.to_compiled(buckets=(8, 64, 512))
+    pred.warmup()
+    metrics = ServingMetrics().model("m")
+    errors = []
+    with MicroBatcher(pred, max_batch=512, max_wait_ms=20,
+                      metrics=metrics) as mb:
+        def worker(seed):
+            rng = np.random.RandomState(seed)
+            try:
+                for _ in range(8):
+                    rows = rng.randn(rng.randint(1, 9), 6).astype(np.float32)
+                    got = mb.predict(rows, timeout=30)
+                    np.testing.assert_array_equal(got, pred.predict(rows))
+                    np.testing.assert_allclose(
+                        got, binary_booster.predict(rows),
+                        rtol=1e-6, atol=1e-7)
+            except Exception as exc:  # surface into the main thread
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+    assert not errors, errors
+    snap = metrics.snapshot(pred.compile_count)
+    assert snap["requests"] == 64
+    assert snap["batch_fill_ratio"] > 1.0, snap
+    assert snap["p99_ms"] > 0.0
+    assert snap["compile_count"] == pred.compile_count
+
+
+def test_microbatcher_bounded_queue_raises(binary_booster):
+    """Acceptance: overflow raises QueueFullError instead of deadlocking;
+    the queued work still completes once the worker starts."""
+    pred = binary_booster.to_compiled()
+    mb = MicroBatcher(pred, max_queue_rows=10, autostart=False)
+    futs = [mb.submit(np.zeros((5, 6), np.float32)) for _ in range(2)]
+    with pytest.raises(QueueFullError):
+        mb.submit(np.zeros((1, 6), np.float32))
+    assert mb.queue_depth == 10
+    mb.start()
+    for f in futs:
+        assert f.result(timeout=30).shape == (5,)
+    mb.close()
+    with pytest.raises(lgb.LightGBMError):
+        mb.submit(np.zeros((1, 6), np.float32))
+
+
+def test_microbatcher_oversized_request_admitted_when_idle(binary_booster):
+    """A request larger than max_queue_rows must not be unservable: an
+    empty queue admits it and it flushes alone, instead of the caller
+    getting 429s forever no matter how often it retries."""
+    pred = binary_booster.to_compiled(buckets=(8, 64))
+    with MicroBatcher(pred, max_queue_rows=16, max_wait_ms=1) as mb:
+        out = mb.predict(np.zeros((40, 6), np.float32), timeout=30)
+        assert out.shape == (40,)
+
+
+def test_microbatcher_scatters_flush_meta(binary_booster):
+    """(array, meta) predictor returns deliver meta with every request's
+    result — the mechanism the server uses to report served versions."""
+    pred = binary_booster.to_compiled(buckets=(8, 64))
+
+    class Tagged:
+        def predict(self, X):
+            return pred.predict(X), "v-tag"
+
+    with MicroBatcher(Tagged(), max_wait_ms=1) as mb:
+        rows = RNG.randn(3, 6).astype(np.float32)
+        out, meta = mb.predict(rows, timeout=30)
+        assert meta == "v-tag"
+        np.testing.assert_array_equal(out, pred.predict(rows))
+
+
+def test_microbatcher_propagates_predict_errors(binary_booster):
+    class Boom:
+        def predict(self, X):
+            raise RuntimeError("kaboom")
+
+    with MicroBatcher(Boom(), max_wait_ms=1) as mb:
+        fut = mb.submit(np.zeros((2, 6), np.float32))
+        with pytest.raises(RuntimeError, match="kaboom"):
+            fut.result(timeout=30)
+
+
+def test_microbatcher_isolates_failures_per_request():
+    """A failing coalesced flush retries each request solo, so one poison
+    request cannot 400 the innocent ones that rode the same batch."""
+    class SoloOnly:
+        def predict(self, X):
+            if X.shape[0] > 1 and np.isinf(X).any():
+                raise RuntimeError("poisoned batch")
+            if X.shape[0] == 1 and np.isinf(X).any():
+                raise RuntimeError("bad request")
+            return X[:, 0]
+
+    mb = MicroBatcher(SoloOnly(), max_wait_ms=50, autostart=False)
+    good = [mb.submit(np.full((1, 4), float(i))) for i in range(3)]
+    bad = mb.submit(np.full((1, 4), np.inf))
+    mb.start()
+    for i, f in enumerate(good):
+        np.testing.assert_array_equal(f.result(timeout=30), [float(i)])
+    with pytest.raises(RuntimeError, match="bad request"):
+        bad.result(timeout=30)
+    mb.close()
+
+
+def test_microbatcher_close_without_drain_cancels():
+    """close(drain=False) cancels the backlog instead of predicting it."""
+    calls = []
+
+    class Recorder:
+        def predict(self, X):
+            calls.append(X.shape[0])
+            return X[:, 0]
+
+    mb = MicroBatcher(Recorder(), autostart=False)
+    futs = [mb.submit(np.zeros((2, 4))) for _ in range(3)]
+    mb.close(drain=False)
+    assert calls == []  # nothing was flushed
+    for f in futs:
+        assert f.cancelled()
+    # same while the worker is ALIVE, parked in its max_wait window: the
+    # discard flag must stop it from popping one last batch
+    mb2 = MicroBatcher(Recorder(), max_wait_ms=10_000)
+    fut = mb2.submit(np.zeros((2, 4)))
+    time.sleep(0.05)
+    mb2.close(drain=False)
+    assert fut.cancelled() and calls == []
+
+
+def test_stacked_trees_cache_bounded():
+    """Looping over num_iteration values must not pin O(N^2) device tree
+    copies: the per-range stack cache is LRU-bounded."""
+    bst = _train(rounds=5)
+    bst._stacked_cache_cap = 3
+    X = np.zeros((3, 6), np.float32)
+    for i in range(1, 6):
+        bst.predict(X, pred_leaf=True, num_iteration=i)
+    assert len(bst._stacked_cache) <= 3
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry
+# ---------------------------------------------------------------------------
+def test_registry_publish_predict_rollback(binary_booster):
+    reg = ModelRegistry()
+    v1 = reg.publish("m", model_str=binary_booster.model_to_string(),
+                     warmup=False)
+    assert v1 == 1 and reg.current_version("m") == 1
+    X = RNG.randn(9, 6).astype(np.float32)
+    np.testing.assert_allclose(reg.predict("m", X),
+                               binary_booster.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    b2 = _train(rounds=2)
+    v2 = reg.publish("m", booster=b2, warmup=False)
+    assert reg.current_version("m") == v2
+    assert reg.rollback("m") == v1
+    assert reg.rollback("m") == v2  # rollback is undoable
+    with pytest.raises(lgb.LightGBMError):
+        reg.predict("nope", X)
+
+
+def test_registry_refcounted_retirement(binary_booster):
+    reg = ModelRegistry()
+    ms = binary_booster.model_to_string()
+    v1 = reg.publish("m", model_str=ms, warmup=False)
+    X = RNG.randn(4, 6).astype(np.float32)
+    with reg.acquire("m") as (pred_v1, got_v):
+        assert got_v == v1
+        v2 = reg.publish("m", model_str=ms, warmup=False)
+        v3 = reg.publish("m", model_str=ms, warmup=False)
+        # v1 is retired (superseded twice) but pinned by this acquire
+        assert reg.versions("m") == [v1, v2, v3]
+        assert pred_v1.predict(X).shape == (4,)  # still serves
+    # last ref released -> v1 dropped; v2 stays resident for rollback
+    assert reg.versions("m") == [v2, v3]
+
+
+def test_registry_hot_swap_mid_traffic():
+    """Acceptance: publish v2 mid-traffic -> no dropped requests and no
+    mixed-version responses (every response matches exactly one version's
+    full output for its rows)."""
+    b1 = _train(rounds=3)
+    b2 = _train(rounds=5)
+    reg = ModelRegistry(buckets=(8, 32, 128))  # requests stay under 32 rows
+    reg.publish("m", booster=b1)
+    X = RNG.randn(64, 6).astype(np.float32)
+    exp1 = reg.predict("m", X)
+
+    dispatch = type("D", (), {"predict": lambda self, rows:
+                              reg.predict("m", rows)})()
+    errors, responses = [], []
+    stop = threading.Event()
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        with MicroBatcher(dispatch, max_wait_ms=5) as mb:
+            while not stop.is_set():
+                lo = rng.randint(0, 32)
+                hi = lo + rng.randint(1, 32)
+                try:
+                    responses.append((lo, hi, mb.predict(X[lo:hi],
+                                                         timeout=30)))
+                except Exception as exc:
+                    errors.append(exc)
+                    return
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    reg.publish("m", booster=b2)
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    exp2 = reg.predict("m", X)
+    assert not np.allclose(exp1, exp2)  # versions are distinguishable
+    n_v1 = n_v2 = 0
+    for lo, hi, got in responses:
+        match1 = np.array_equal(got, exp1[lo:hi])
+        match2 = np.array_equal(got, exp2[lo:hi])
+        assert match1 or match2, "mixed-version or corrupted response"
+        n_v1 += match1
+        n_v2 += match2
+    assert n_v2 > 0  # the swap actually happened mid-traffic
+
+
+# ---------------------------------------------------------------------------
+# ServingApp (in-process transport; no sockets in tier-1)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def app(binary_booster):
+    app = ServingApp(max_wait_ms=1)
+    app.registry.publish("m", booster=binary_booster, warmup=False)
+    yield app
+    app.close()
+
+
+def test_app_health_models_metrics(app):
+    assert app.handle("GET", "/healthz") == (200, {"status": "ok"})
+    status, body = app.handle("GET", "/v1/models")
+    assert status == 200 and body["models"]["m"]["current"] == 1
+    status, body = app.handle("GET", "/v1/metrics")
+    assert status == 200 and "m" in body
+
+
+def test_app_metrics_count_once(app):
+    """Requests/rows are user-facing counts; the device call underneath is
+    tracked separately (no double counting through the batcher)."""
+    X = RNG.randn(5, 6)
+    for _ in range(3):
+        status, _ = app.handle("POST", "/v1/models/m:predict",
+                               {"rows": X.tolist()})
+        assert status == 200
+    snap = app.metrics.model("m").snapshot()
+    assert snap["requests"] == 3
+    assert snap["rows"] == 15
+    assert snap["device_rows"] == 15
+    assert 1 <= snap["device_calls"] <= 3
+
+
+def test_app_predict_routes(app, binary_booster):
+    X = RNG.randn(7, 6)
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X.tolist()})
+    assert status == 200 and body["version"] == 1
+    np.testing.assert_allclose(
+        body["predictions"],
+        binary_booster.predict(X.astype(np.float32)), rtol=1e-6, atol=1e-7)
+    # pinned-version + kwargs path bypasses batching but must agree
+    status, body2 = app.handle("POST", "/v1/models/m:predict",
+                               {"rows": X.tolist(), "version": 1})
+    assert status == 200
+    np.testing.assert_array_equal(body2["predictions"], body["predictions"])
+    status, raw = app.handle("POST", "/v1/models/m:predict",
+                             {"rows": X[:1].tolist(), "raw_score": True,
+                              "num_iteration": 2})
+    assert status == 200
+    np.testing.assert_allclose(
+        raw["predictions"],
+        binary_booster.predict(X[:1].astype(np.float32), raw_score=True,
+                               num_iteration=2), rtol=1e-6, atol=1e-7)
+
+
+def test_app_publish_rollback_routes(app, binary_booster, tmp_path):
+    path = str(tmp_path / "m.txt")
+    binary_booster.save_model(path)
+    status, body = app.handle("POST", "/v1/models/m2:publish",
+                              {"model_file": path, "warmup": False})
+    assert (status, body["version"]) == (200, 1)
+    status, body = app.handle("POST", "/v1/models/m2:publish",
+                              {"model_str": binary_booster.model_to_string(),
+                               "warmup": False})
+    assert (status, body["version"]) == (200, 2)
+    status, body = app.handle("POST", "/v1/models/m2:rollback", {})
+    assert (status, body["version"]) == (200, 1)
+
+
+def test_app_error_statuses(app):
+    status, body = app.handle("GET", "/nope")
+    assert status == 404 and "error" in body
+    status, body = app.handle("POST", "/v1/models/ghost:predict",
+                              {"rows": [[0.0] * 6]})
+    assert status == 404 and "no model published" in body["error"]
+    status, body = app.handle("POST", "/v1/models/m:predict", {})
+    assert status == 400  # missing "rows"
+    status, body = app.handle("POST", "/v1/models/m:publish", {})
+    assert status == 400  # no model source
+    status, body = app.handle("POST", "/v1/models/m:publish",
+                              {"model_file": "/no/such/model.txt"})
+    assert status == 400 and "error" in body  # OSError -> 400, not a crash
+
+
+def test_app_unknown_name_does_not_leak_batcher(app):
+    """Unknown names 404 BEFORE a batcher (and its worker thread) is
+    allocated — sustained bad traffic must not grow threads per typo."""
+    for name in ("ghost", "typo1", "typo2"):
+        status, _ = app.handle("POST", f"/v1/models/{name}:predict",
+                               {"rows": [[0.0] * 6]})
+        assert status == 404
+    assert not app._batchers
+    # a published name still gets its batcher lazily
+    status, _ = app.handle("POST", "/v1/models/m:predict",
+                           {"rows": [[0.0] * 6]})
+    assert status == 200 and set(app._batchers) == {"m"}
+
+
+def test_app_wrong_width_is_per_request(app):
+    """A wrong-width body is ITS OWN 400 — it must never reach the shared
+    flush where it would fail every coalesced request; wider rows are
+    sliced down (extra columns are never indexed)."""
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": [[0.0] * 4]})
+    assert status == 400 and "features" in body["error"]
+    status, wide = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": [[0.0] * 9]})
+    assert status == 200
+    status, exact = app.handle("POST", "/v1/models/m:predict",
+                               {"rows": [[0.0] * 6]})
+    assert status == 200
+    np.testing.assert_array_equal(wide["predictions"], exact["predictions"])
+
+
+def test_app_batched_version_tracks_publish(app):
+    """The version in a batched response is the one that served the flush
+    (resolved inside the registry acquire), so it tracks hot-swaps."""
+    X = RNG.randn(3, 6)
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X.tolist()})
+    assert (status, body["version"]) == (200, 1)
+    app.registry.publish("m", booster=_train(rounds=2), warmup=False)
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X.tolist()})
+    assert (status, body["version"]) == (200, 2)
+
+
+def test_cli_serve_task_validates(tmp_path):
+    from lightgbm_tpu.application import Application
+    app = Application(["task=serve"])
+    with pytest.raises(ValueError, match="input_model"):
+        app.run()
+
+
+# ---------------------------------------------------------------------------
+# Real HTTP transport (sockets): slow tier only.  Tier-1 covers the same
+# routes in-process through ServingApp.handle above.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_http_server_over_socket(binary_booster):
+    import http.client
+
+    from lightgbm_tpu.serving import make_server
+
+    app = ServingApp(max_wait_ms=1)
+    app.registry.publish("m", booster=binary_booster, warmup=False)
+    httpd = make_server(app, host="127.0.0.1", port=0)  # ephemeral port
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", httpd.server_port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read()) == {"status": "ok"}
+
+        X = RNG.randn(6, 6)
+        body = json.dumps({"rows": X.tolist()}).encode()
+        conn.request("POST", "/v1/models/m:predict", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        out = json.loads(resp.read())
+        np.testing.assert_allclose(
+            out["predictions"],
+            binary_booster.predict(X.astype(np.float32)),
+            rtol=1e-6, atol=1e-7)
+
+        conn.request("POST", "/v1/models/ghost:predict",
+                     json.dumps({"rows": [[0.0] * 6]}).encode())
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(30)
+        app.close()
