@@ -33,12 +33,21 @@ rc=124 because retry/backoff could run >4 h):
 - If the TPU attempt dies, a CPU fallback with a tiny workload emits an
   honest {"backend": "cpu"} line.
 
-Stages (BENCH_STAGE env var, same parent/budget machinery for both):
-- default        training wall-clock + held-out AUC (run_training)
+Stages (BENCH_STAGE env var, same parent/budget machinery for all):
+- default        training wall-clock + held-out AUC (run_training).  The
+                 result line carries `setup_breakdown` (binning_s /
+                 construct_s / compile_s) so setup regressions are
+                 attributable to a stage, not just a total.
 - serve          serving throughput/latency through lightgbm_tpu/serving/:
                  sustained rows/s, p50/p99 latency, batch-fill ratio, and a
                  steady-state compile count (run_serving).  Tuning knobs:
                  BENCH_SERVE_{TREES,THREADS,MAX_REQ_ROWS,SECONDS,TRAIN_ROWS}.
+- hist           histogram microbenchmark (run_hist): rows*features/s per
+                 impl x bin-width class x contraction dtype, one JSON line
+                 per combo, each with `speedup_vs_256` = the width-matched
+                 contraction over the same impl's global-256 contraction on
+                 identical data.  Proves the width-class engine without the
+                 chip.  Knobs: BENCH_HIST_{ROWS,FEATURES,REPS,PALLAS}.
 """
 
 import json
@@ -114,12 +123,26 @@ def run_training():
         # the result line guards quality.  Override: BENCH_PRECISION=float32
         params["tpu_precision"] = os.environ.get("BENCH_PRECISION",
                                                  "bfloat16")
+    if os.environ.get("BENCH_COMPILE_CACHE"):
+        # opt-in persistent compilation cache: warm-cache runs skip the XLA
+        # compiles entirely (cold runs still pay them — the honest default)
+        params["compilation_cache_dir"] = os.environ["BENCH_COMPILE_CACHE"]
     train_set = lgb.Dataset(X, y)
+    t_construct = time.time()
     train_set.construct()
+    construct_total = time.time() - t_construct
+    ds_timings = dict(getattr(train_set._handle, "setup_timings", {}) or {})
     # warmup: compile the full fused step (excluded from train time, like the
     # reference excludes data loading/binning), then time 3 hot iterations to
     # size the measured run.
+    t_compile = time.time()
     lgb.train(params, train_set, num_boost_round=1)
+    compile_s = time.time() - t_compile
+    setup_breakdown = {
+        "binning_s": round(ds_timings.get("binning_s", construct_total), 3),
+        "construct_s": round(ds_timings.get("construct_s", 0.0), 3),
+        "compile_s": round(compile_s, 3),
+    }
     t_probe = time.time()
     bst_probe = lgb.train(params, train_set, num_boost_round=3)
     bst_probe.num_trees()              # forces the lazy flush -> full sync
@@ -160,6 +183,7 @@ def run_training():
         "vs_baseline": round(vs_baseline, 4),
         "held_out_auc": round(test_auc, 6),
         "setup_s": round(setup_s, 3),
+        "setup_breakdown": setup_breakdown,
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,
@@ -264,8 +288,100 @@ def run_serving():
     }), flush=True)
 
 
+def run_hist():
+    """Child body for BENCH_STAGE=hist: prove the bin-width-class histogram
+    engine without the chip.
+
+    For each (impl, width class, contraction dtype) combo, times the
+    width-MATCHED contraction (the engine's per-class path, including its
+    permute + scatter-back overhead) against the same impl's global-256
+    contraction on identical data, and prints one JSON line with
+    rows*features/s and the speedup.  The acceptance bar (ISSUE 2): >=2x for
+    the 16- and 64-bin classes on the onehot path, CPU-measurable."""
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    from lightgbm_tpu.ops.histogram import build_histogram, plan_width_classes
+
+    rows = int(os.environ.get("BENCH_HIST_ROWS", 100_000))
+    feats = int(os.environ.get("BENCH_HIST_FEATURES", 32))
+    reps = int(os.environ.get("BENCH_HIST_REPS", 3))
+    chans = 3            # (grad, hess, count), the grower's root layout
+    global_b = 256       # the unspecialized contraction every combo races
+
+    impls = ["segment", "onehot"]
+    if backend != "cpu" or os.environ.get("BENCH_HIST_PALLAS"):
+        # interpret-mode pallas on CPU is orders slower than the op it
+        # emulates; include it only on request or on real hardware
+        impls.append("pallas")
+    dtypes = ["float32", "bfloat16"]
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(rows, chans).astype(np.float32))
+
+    def timeit(fn):
+        fn().block_until_ready()          # compile outside the clock
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn().block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for width in (16, 64, 256):
+        bins_np = rng.randint(0, width, size=(rows, feats)).astype(np.uint8)
+        bins = jnp.asarray(bins_np)
+        # all columns land in one class of `width`; at width == global_b the
+        # plan degenerates to the plain contraction (speedup ~1.0 by design,
+        # the no-regression control row)
+        layout, widths = plan_width_classes(np.full(feats, width), global_b)
+        for impl in impls:
+            for dtype in dtypes:
+                if impl == "segment" and dtype == "bfloat16":
+                    continue  # scatter-add has no MXU dtype knob
+                if time.time() > deadline - 10:
+                    print("BENCH_DONE", flush=True)
+                    return
+
+                def full():
+                    return build_histogram(bins, w, global_b, impl=impl,
+                                           hist_dtype=dtype)
+
+                def classed():
+                    return build_histogram(bins, w, global_b, impl=impl,
+                                           hist_dtype=dtype, layout=layout,
+                                           widths=widths)
+
+                t_full = timeit(full)
+                t_cls = timeit(classed)
+                rate = rows * feats / t_cls
+                print("BENCH_RESULT " + json.dumps({
+                    "metric": f"hist_{impl}_{width}bin_{dtype}",
+                    "value": round(rate, 1),
+                    "unit": "rows*features/s",
+                    "vs_baseline": round(t_full / t_cls, 4),
+                    "speedup_vs_256": round(t_full / t_cls, 4),
+                    "width_class_s": round(t_cls, 5),
+                    "global_256_s": round(t_full, 5),
+                    "rows": rows,
+                    "features": feats,
+                    "backend": backend,
+                }), flush=True)
+    print("BENCH_DONE", flush=True)
+
+
 def _run_child(env, ready_timeout, total_timeout):
-    """Run one child, streaming stdout. Returns (result_line|None, err)."""
+    """Run one child, streaming stdout. Returns (result_lines|None, err).
+
+    A child may emit SEVERAL "BENCH_RESULT {json}" lines (the hist stage
+    prints one per impl x width x dtype combo); they are collected until the
+    child exits and returned newline-joined.  A final "BENCH_DONE" marker
+    short-circuits the wait."""
     env = dict(env)
     env["BENCH_CHILD"] = "1"
     env["BENCH_CHILD_DEADLINE"] = str(time.time() + total_timeout)
@@ -274,7 +390,8 @@ def _run_child(env, ready_timeout, total_timeout):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     t0 = time.time()
     ready = False
-    result = None
+    timed_out = False
+    results = []
     try:
         import selectors
         sel = selectors.DefaultSelector()
@@ -284,7 +401,9 @@ def _run_child(env, ready_timeout, total_timeout):
             if not ready and now - t0 > ready_timeout:
                 return None, f"no READY within {ready_timeout:.0f}s"
             if now - t0 > total_timeout:
-                return None, f"child exceeded {total_timeout:.0f}s"
+                # keep whatever combos completed before the deadline
+                timed_out = True
+                break
             if not sel.select(timeout=5.0):
                 if proc.poll() is not None:
                     break
@@ -299,8 +418,13 @@ def _run_child(env, ready_timeout, total_timeout):
             elif line.startswith("BENCH_PLAN"):
                 print(line, file=sys.stderr)
             elif line.startswith("BENCH_RESULT "):
-                result = line[len("BENCH_RESULT "):]
-                return result, ""
+                results.append(line[len("BENCH_RESULT "):])
+            elif line == "BENCH_DONE":
+                break
+        if results:
+            return "\n".join(results), ""
+        if timed_out:
+            return None, f"child exceeded {total_timeout:.0f}s"
         return None, f"child exited rc={proc.poll()} without result"
     finally:
         if proc.poll() is None:
@@ -352,8 +476,11 @@ def main():
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        if os.environ.get("BENCH_STAGE") == "serve":
+        stage = os.environ.get("BENCH_STAGE")
+        if stage == "serve":
             run_serving()
+        elif stage == "hist":
+            run_hist()
         else:
             run_training()
     else:

@@ -36,28 +36,14 @@ _K_ZERO_LOW = -1e-35
 _K_ZERO_HIGH = 1e-35  # reference kZeroThreshold band: values in (-1e-35,1e-35) are "zero"
 
 
-def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
-                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
-    """Find numerical bin upper bounds from distinct sample values.
-
-    Same strategy as reference GreedyFindBin (src/io/bin.cpp): if the number of
-    distinct values fits, one bin per value with midpoint boundaries; otherwise
-    distribute by count as evenly as possible while respecting min_data_in_bin.
-    Returns upper bounds; last is +inf.
-    """
-    bin_upper_bound: List[float] = []
+def _greedy_find_bin_loop(distinct_values: np.ndarray, counts: np.ndarray,
+                          max_bin: int, total_cnt: int,
+                          min_data_in_bin: int) -> List[float]:
+    """Literal transcription of reference GreedyFindBin's many-distinct
+    branch (src/io/bin.cpp): one Python step per distinct value.  O(n) in
+    the sample size — kept as the semantic reference for the O(max_bin log n)
+    jump rewrite below (tests assert exact agreement)."""
     num_distinct = len(distinct_values)
-    if num_distinct <= max_bin:
-        cur_cnt = 0
-        for i in range(num_distinct - 1):
-            cur_cnt += counts[i]
-            if cur_cnt >= min_data_in_bin or counts[i + 1] >= min_data_in_bin:
-                # midpoint boundary, same as reference (bin.cpp GreedyFindBin)
-                bin_upper_bound.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
-                cur_cnt = 0
-        bin_upper_bound.append(np.inf)
-        return bin_upper_bound
-
     max_bin = max(1, max_bin)
     mean_bin_size = total_cnt / max_bin
     # values whose count alone exceeds mean bin size get their own bin
@@ -82,6 +68,90 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                 mean_rest = rest_cnt / max(rest_bins, 1)
         if len(upper) >= max_bin - 1:
             break
+    upper.append(np.inf)
+    return upper
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Find numerical bin upper bounds from distinct sample values.
+
+    Same strategy as reference GreedyFindBin (src/io/bin.cpp): if the number of
+    distinct values fits, one bin per value with midpoint boundaries; otherwise
+    distribute by count as evenly as possible while respecting min_data_in_bin.
+    Returns upper bounds; last is +inf.
+
+    The many-distinct branch is a jump rewrite of ``_greedy_find_bin_loop``
+    (exact same boundaries): instead of visiting every distinct value, each
+    boundary is located with a searchsorted over the count cumsum, so the
+    cost is O(max_bin log n) per feature instead of O(n).  On a 200k-sample
+    all-distinct column this is the difference between ~0.25s and ~5ms —
+    the dominant term of BENCH_r05's 17.3s setup_s was exactly this loop.
+    """
+    bin_upper_bound: List[float] = []
+    num_distinct = len(distinct_values)
+    if num_distinct <= max_bin:
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += counts[i]
+            if cur_cnt >= min_data_in_bin or counts[i + 1] >= min_data_in_bin:
+                # midpoint boundary, same as reference (bin.cpp GreedyFindBin)
+                bin_upper_bound.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur_cnt = 0
+        bin_upper_bound.append(np.inf)
+        return bin_upper_bound
+
+    max_bin = max(1, max_bin)
+    counts = np.asarray(counts, np.int64)
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest0 = total_cnt - counts[is_big].sum()
+    rest_bins = max_bin - int(is_big.sum())
+    mean_rest = rest0 / max(rest_bins, 1)
+
+    cum = np.cumsum(counts)                      # cum[i] = counts[0..i]
+    cnb = np.cumsum(np.where(is_big, 0, counts))  # not-big prefix sums
+    # positions where the reference's boundary flag is forced by bigness:
+    # is_big[i] or is_big[i+1]
+    big_flag = is_big.copy()
+    big_flag[:-1] |= is_big[1:]
+    big_trigger = np.nonzero(big_flag)[0]
+
+    upper: List[float] = []
+    base = 0          # cum[] consumed by already-closed bins
+    start = 0         # next index to consider
+    while len(upper) < max_bin - 1 and start < num_distinct:
+        # earliest index where the boundary condition can hold: either the
+        # running count reaches mean_rest, or a big value forces a cut
+        i_mean = int(np.searchsorted(cum, base + mean_rest, side="left"))
+        j = int(np.searchsorted(big_trigger, start, side="left"))
+        i_big = int(big_trigger[j]) if j < len(big_trigger) else num_distinct
+        t = max(start, min(i_mean, i_big))
+        if t >= num_distinct:
+            break
+        if cum[t] - base < min_data_in_bin:
+            if t >= i_mean:
+                # the mean condition holds from t onward (cum is
+                # nondecreasing), so jump straight to where the bin also
+                # satisfies min_data_in_bin
+                t = max(t, int(np.searchsorted(cum, base + min_data_in_bin,
+                                               side="left")))
+                if t >= num_distinct:
+                    break
+            else:
+                # big-forced cut with too little mass: the reference skips
+                # it and re-evaluates from the next value
+                start = t + 1
+                continue
+        if t + 1 >= num_distinct:
+            # boundary needs a right neighbor for the midpoint; none left
+            break
+        upper.append((distinct_values[t] + distinct_values[t + 1]) / 2.0)
+        if not is_big[t] and rest_bins > 1:
+            rest_bins -= 1
+            mean_rest = (rest0 - cnb[t]) / max(rest_bins, 1)
+        base = int(cum[t])
+        start = t + 1
     upper.append(np.inf)
     return upper
 

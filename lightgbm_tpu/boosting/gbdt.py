@@ -31,6 +31,8 @@ class GBDT:
     """Gradient Boosting Decision Tree trainer (reference gbdt.h/gbdt.cpp)."""
 
     def __init__(self, config, train_data: TrainDataset, objective):
+        from ..compile_cache import maybe_enable_compilation_cache
+        maybe_enable_compilation_cache(config)  # before the first jit compile
         self.config = config
         self.train_data = train_data
         self.objective = objective

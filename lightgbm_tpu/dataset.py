@@ -21,8 +21,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import time
+
 from .binning import BinMapper, BinType, find_bin_mappers
 from .config import Config
+from .timer import timed
 
 __all__ = ["Metadata", "TrainDataset", "ValidDataset"]
 
@@ -92,43 +95,47 @@ class TrainDataset:
             raise ValueError(f"label length {metadata.num_data} != rows {n}")
 
         cats = sorted(set(categorical_features or ()))
-        if bin_mappers is None:
-            sample_n = min(n, sample_cnt or config.bin_construct_sample_cnt)
-            if sample_n < n:
-                rng = np.random.RandomState(config.data_random_seed)
-                idx = rng.choice(n, size=sample_n, replace=False)
-                sample = data[np.sort(idx)]
-            else:
-                sample = data
-            min_split = (config.min_data_in_leaf
-                         if config.feature_pre_filter else 0)
-            bin_mappers = find_bin_mappers(
-                sample, max_bin=config.max_bin,
-                min_data_in_bin=config.min_data_in_bin,
-                categorical_features=cats,
-                use_missing=config.use_missing,
-                zero_as_missing=config.zero_as_missing,
-                min_split_data=min_split,
-                max_bin_by_feature=config.max_bin_by_feature,
-                feature_pre_filter=config.feature_pre_filter,
-                forced_bins_path=config.forcedbins_filename)
-        self.all_bin_mappers = bin_mappers
+        t_bin = time.perf_counter()
+        with timed("setup::binning"):
+            if bin_mappers is None:
+                sample_n = min(n, sample_cnt or config.bin_construct_sample_cnt)
+                if sample_n < n:
+                    rng = np.random.RandomState(config.data_random_seed)
+                    idx = rng.choice(n, size=sample_n, replace=False)
+                    sample = data[np.sort(idx)]
+                else:
+                    sample = data
+                min_split = (config.min_data_in_leaf
+                             if config.feature_pre_filter else 0)
+                bin_mappers = find_bin_mappers(
+                    sample, max_bin=config.max_bin,
+                    min_data_in_bin=config.min_data_in_bin,
+                    categorical_features=cats,
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing,
+                    min_split_data=min_split,
+                    max_bin_by_feature=config.max_bin_by_feature,
+                    feature_pre_filter=config.feature_pre_filter,
+                    forced_bins_path=config.forcedbins_filename)
+            self.all_bin_mappers = bin_mappers
 
-        # filter trivial features (reference used_feature map, dataset.cpp)
-        real_feature_index = [i for i, m in enumerate(bin_mappers)
-                              if not m.is_trivial]
-        feature_mappers = [bin_mappers[i] for i in real_feature_index]
-        if not feature_mappers:
-            raise ValueError("no usable (non-trivial) features in data")
+            # filter trivial features (reference used_feature map, dataset.cpp)
+            real_feature_index = [i for i, m in enumerate(bin_mappers)
+                                  if not m.is_trivial]
+            feature_mappers = [bin_mappers[i] for i in real_feature_index]
+            if not feature_mappers:
+                raise ValueError("no usable (non-trivial) features in data")
 
-        max_nb = max(m.num_bin for m in feature_mappers)
-        bins = np.empty((n, len(feature_mappers)),
-                        np.uint8 if max_nb <= 256 else np.int32)
-        for j, (real, mapper) in enumerate(
-                zip(real_feature_index, feature_mappers)):
-            bins[:, j] = mapper.value_to_bin(data[:, real])
+            max_nb = max(m.num_bin for m in feature_mappers)
+            bins = np.empty((n, len(feature_mappers)),
+                            np.uint8 if max_nb <= 256 else np.int32)
+            for j, (real, mapper) in enumerate(
+                    zip(real_feature_index, feature_mappers)):
+                bins[:, j] = mapper.value_to_bin(data[:, real])
+        binning_s = time.perf_counter() - t_bin
         self._finish_init(bins, bin_mappers, real_feature_index,
                           data.shape[1], metadata)
+        self.setup_timings["binning_s"] = binning_s
         # linear leaves regress on RAW values (reference LinearTreeLearner
         # keeps the Dataset's raw_data_ alive via linear_tree)
         if getattr(config, "linear_tree", False):
@@ -542,6 +549,11 @@ class TrainDataset:
                      num_total_features, metadata,
                      enable_efb: bool = True,
                      place_on_device: bool = True) -> None:
+        # setup-stage attribution (bench setup_breakdown): binning_s is set
+        # by constructors that bin here; construct_s covers EFB + device
+        # placement below
+        t_construct = time.perf_counter()
+        self.setup_timings = {"binning_s": 0.0}
         self.real_feature_index = real_feature_index
         self.feature_mappers = [bin_mappers[i] for i in real_feature_index]
         self.num_features = len(real_feature_index)
@@ -563,6 +575,9 @@ class TrainDataset:
         # dataset.cpp:100,239)
         self.bundle_map = None
         self.bundles = None
+        # per-DEVICE-column bin counts (== per-feature sans EFB; per-bundle
+        # widths under EFB) — the histogram width-class planner's input
+        self.device_col_num_bins = nbins
         if not place_on_device:
             self.device_bins = None   # the parallel learner shards it
             self.label = jnp.asarray(metadata.label)
@@ -570,6 +585,8 @@ class TrainDataset:
                            if metadata.weight is not None else None)
             self.query_ids = (jnp.asarray(metadata.query_ids)
                               if metadata.query_ids is not None else None)
+            self.setup_timings["construct_s"] = (time.perf_counter()
+                                                 - t_construct)
             return
         cfg = self.config
         if (enable_efb and getattr(cfg, "enable_bundle", True)
@@ -578,12 +595,15 @@ class TrainDataset:
             bundles = find_bundles(bins, self.feature_mappers,
                                    self.is_categorical, max_bin=cfg.max_bin)
             if len(bundles) <= self.num_features * 3 // 4:
+                from .efb import bundle_widths
                 bmap, n_bundles, max_bb = make_bundle_map(
                     bundles, self.feature_mappers, self.num_features)
                 self.bundles = bundles
                 self.bundle_map = bmap
                 self.max_num_bins = max(self.max_num_bins, max_bb)
                 self.num_bundles = n_bundles
+                self.device_col_num_bins = np.asarray(
+                    bundle_widths(bundles, self.feature_mappers), np.int32)
                 bundled = bundle_rows(bins, bundles, self.feature_mappers)
                 self.device_bins = jnp.asarray(bundled)
         if self.bundle_map is None:
@@ -594,6 +614,7 @@ class TrainDataset:
                        if metadata.weight is not None else None)
         self.query_ids = (jnp.asarray(metadata.query_ids)
                           if metadata.query_ids is not None else None)
+        self.setup_timings["construct_s"] = time.perf_counter() - t_construct
 
     # ------------------------------------------------------------------
     def bin_external(self, data: np.ndarray) -> np.ndarray:

@@ -35,8 +35,8 @@ from typing import List, NamedTuple
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["BundleMap", "find_bundles", "bundle_rows", "make_bundle_map",
-           "expand_bundle_hist"]
+__all__ = ["BundleMap", "find_bundles", "bundle_rows", "bundle_widths",
+           "make_bundle_map", "expand_bundle_hist"]
 
 
 class BundleMap(NamedTuple):
@@ -151,6 +151,19 @@ def make_bundle_map(bundles: List[List[int]], mappers,
     return bmap, len(bundles), int(max_bins)
 
 
+def bundle_widths(bundles: List[List[int]], mappers) -> List[int]:
+    """Per-bundle device-column bin count: a singleton keeps its member's
+    num_bin; a shared bundle packs each member's nonzero range after bin 0
+    (the histogram width-class planner keys off these widths)."""
+    widths = []
+    for members in bundles:
+        if len(members) == 1:
+            widths.append(mappers[members[0]].num_bin)
+        else:
+            widths.append(1 + sum(mappers[fi].num_bin - 1 for fi in members))
+    return widths
+
+
 def bundle_rows(bins: np.ndarray, bundles: List[List[int]], mappers,
                 out_dtype=None) -> np.ndarray:
     """Re-encode a per-feature bin matrix [N, F] into bundle space [N, G].
@@ -160,12 +173,7 @@ def bundle_rows(bins: np.ndarray, bundles: List[List[int]], mappers,
     (FeatureGroup::PushData)."""
     n = bins.shape[0]
     g = len(bundles)
-    widths = []
-    for members in bundles:
-        if len(members) == 1:
-            widths.append(mappers[members[0]].num_bin)
-        else:
-            widths.append(1 + sum(mappers[fi].num_bin - 1 for fi in members))
+    widths = bundle_widths(bundles, mappers)
     if out_dtype is None:
         out_dtype = np.uint8 if max(widths) <= 256 else np.int32
     out = np.zeros((n, g), out_dtype)

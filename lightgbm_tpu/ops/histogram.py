@@ -12,6 +12,18 @@ reformulated as one-hot matmuls that run on the MXU: for a chunk of rows,
 formulation is kept for CPU test meshes, and a Pallas kernel provides the tuned
 TPU path.  All three produce identical results (modulo f32 summation order).
 
+Bin-width classes: contracting every feature against the GLOBAL ``num_bins``
+does B/B_w times the useful work for narrow features — exactly why the
+reference ships 16/64/256-specialized kernels
+(src/treelearner/ocl/histogram{16,64,256}.cl, kernels/histogram_16_64_256.cu)
+and why arxiv 1706.08359 keys its GPU speedups to bin-width-matched
+histograms.  ``plan_width_classes`` groups device columns into
+{16, 64, 256}-wide classes and ``build_histogram`` runs one specialized
+contraction per class — ``[N, F_w] x [N, C] -> [F_w, B_w, C]`` — scattering
+the class results back into the ``[F, B, C]`` pool layout, for all three
+impls (segment: fewer segments; onehot: narrower iota-compare operand;
+pallas: per-width static kernel variants).
+
 The multi-channel weight design subsumes the reference's separate
 (grad, hess, count) buffers *and* the two-children-in-one-pass trick that
 replaces the histogram-subtraction cache: callers pass
@@ -22,11 +34,61 @@ yields both children's histograms (see tree_learner.py).
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["build_histogram"]
+__all__ = ["build_histogram", "HistLayout", "plan_width_classes",
+           "resolve_impl", "WIDTH_CLASS_LADDER"]
+
+# Specialized contraction widths, mirroring the reference's 16/64/256 GPU
+# kernel variants (histogram_16_64_256.cu).
+WIDTH_CLASS_LADDER = (16, 64, 256)
+
+
+class HistLayout(NamedTuple):
+    """Device-side column permutation grouping same-width-class columns.
+
+    ``perm`` reorders the bin matrix's columns so each width class is one
+    contiguous block (class sizes live in the STATIC ``widths`` tuple held
+    by the caller — e.g. GrowerConfig.hist_widths — so per-class shapes stay
+    compile-time constants); ``inv_perm`` scatters per-class histograms back
+    into storage-column order.  Only device arrays live here so the tuple
+    rides through jit/shard_map as a pytree.
+    """
+    perm: jnp.ndarray       # [F] int32: storage column of permuted slot i
+    inv_perm: jnp.ndarray   # [F] int32: permuted slot of storage column j
+
+
+def plan_width_classes(col_num_bins, num_bins: int,
+                       ladder: Tuple[int, ...] = WIDTH_CLASS_LADDER):
+    """Host-side planning: (HistLayout | None, static widths tuple).
+
+    Each device column lands in the smallest ladder class that holds its bin
+    count (columns wider than the ladder top share a ``num_bins`` class).
+    Returns ``(None, ())`` when the plan degenerates to one class of
+    ``num_bins`` width — the plain global contraction is already
+    width-matched then.  (A single class NARROWER than ``num_bins`` still
+    gets a plan: the caller wants the [F, num_bins, C] pool layout but the
+    contraction itself can run at the narrow width.)
+    """
+    col_num_bins = np.asarray(col_num_bins, np.int64)
+    classes = [w for w in ladder if w < num_bins] + [num_bins]
+    bounds = np.asarray(classes, np.int64)
+    cls_idx = np.searchsorted(bounds, col_num_bins, side="left")
+    uniq = np.unique(cls_idx)
+    if len(uniq) <= 1 and (len(uniq) == 0
+                           or classes[int(uniq[0])] == num_bins):
+        return None, ()
+    perm = np.argsort(cls_idx, kind="stable").astype(np.int32)
+    inv_perm = np.argsort(perm, kind="stable").astype(np.int32)
+    widths = tuple((int(classes[c]), int((cls_idx == c).sum()))
+                   for c in np.unique(cls_idx))
+    layout = HistLayout(perm=jnp.asarray(perm), inv_perm=jnp.asarray(inv_perm))
+    return layout, widths
 
 
 def _segment_impl(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int) -> jnp.ndarray:
@@ -92,11 +154,40 @@ def _pick_impl(impl: str) -> str:
     return "pallas"
 
 
+def resolve_impl(impl: str) -> str:
+    """Public view of the impl dispatch (``auto`` -> backend choice).
+
+    Callers use it to key impl-dependent planning: the width-class planner
+    is skipped for ``segment`` because scatter-add cost is O(N*F) regardless
+    of bin count — BENCH_STAGE=hist measures the permute overhead at
+    0.6-0.9x there, vs 3-8x gains on the one-hot/MXU paths whose FLOPs
+    scale with B.
+    """
+    return _pick_impl(impl)
+
+
+def _build_one_class(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
+                     impl: str, chunk: int, hist_dtype: str) -> jnp.ndarray:
+    """One width-matched contraction: [N, F] x [N, C] -> [F, num_bins, C]."""
+    if impl == "pallas":
+        from . import pallas_histogram
+        return pallas_histogram.build_histogram_pallas(
+            bins, weights, num_bins, hist_dtype=hist_dtype)
+    if impl == "onehot":
+        acc = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
+        return _onehot_impl(bins, weights, num_bins, chunk=chunk,
+                            acc_dtype=acc)
+    return _segment_impl(bins, weights, num_bins)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "impl", "chunk", "hist_dtype"))
+                   static_argnames=("num_bins", "impl", "chunk", "hist_dtype",
+                                    "widths"))
 def build_histogram(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
                     impl: str = "auto", chunk: int = 4096,
-                    hist_dtype: str = "float32") -> jnp.ndarray:
+                    hist_dtype: str = "float32",
+                    layout: Optional[HistLayout] = None,
+                    widths: Tuple[Tuple[int, int], ...] = ()) -> jnp.ndarray:
     """Accumulate per-feature histograms.
 
     Args:
@@ -108,16 +199,29 @@ def build_histogram(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
       hist_dtype: MXU contraction input dtype ("float32" | "bfloat16");
         accumulation is always f32 (reference GPU single-precision trade-off,
         docs/GPU-Performance.rst:88; bf16 doubles the MXU rate).
+      layout / widths: bin-width-class plan from ``plan_width_classes``.
+        ``widths`` is a STATIC tuple of (class_width, column_count) pairs in
+        permuted-column order; each class runs its own width-matched
+        contraction and the results scatter back into the [F, B, C] pool
+        layout, zero-padded above the class width.  Omit both (or pass the
+        plan's None/()) for the single global-B contraction.
     Returns:
       [F, B, C] float32 histogram.
     """
     impl = _pick_impl(impl)
-    if impl == "pallas":
-        from . import pallas_histogram
-        return pallas_histogram.build_histogram_pallas(
-            bins, weights, num_bins, hist_dtype=hist_dtype)
-    if impl == "onehot":
-        acc = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
-        return _onehot_impl(bins, weights, num_bins, chunk=chunk,
-                            acc_dtype=acc)
-    return _segment_impl(bins, weights, num_bins)
+    if layout is None or not widths:
+        return _build_one_class(bins, weights, num_bins, impl, chunk,
+                                hist_dtype)
+    c = weights.shape[1]
+    parts = []
+    off = 0
+    for w, cnt in widths:
+        cols = jax.lax.slice_in_dim(layout.perm, off, off + cnt)
+        sub = jnp.take(bins, cols, axis=1)
+        h = _build_one_class(sub, weights, w, impl, chunk, hist_dtype)
+        if w < num_bins:
+            h = jnp.pad(h, ((0, 0), (0, num_bins - w), (0, 0)))
+        parts.append(h)
+        off += cnt
+    hist = jnp.concatenate(parts, axis=0)            # permuted-column order
+    return jnp.take(hist, layout.inv_perm, axis=0)   # storage-column order

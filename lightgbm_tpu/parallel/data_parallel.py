@@ -144,18 +144,20 @@ class DataParallelTreeLearner(SerialTreeLearner):
             shard_map,
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
-                      P(), P(), P(), P(), P(), P(), P(), P(), P(), P()),
+                      P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                      P()),                              # hist_layout
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
             )._replace(row_leaf=P() if mp else P(ax)),
             check_vma=False)
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
-                    bmap, igroups, gscale, gpen):
+                    bmap, igroups, gscale, gpen, hlayout):
             from ..tree_learner import grow_tree_compact
             grow = (grow_tree_compact
                     if self.config.grow_strategy == "compact" else grow_tree)
             state = grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
-                         mono, key, icf, bmap, igroups, gscale, gpen)
+                         mono, key, icf, bmap, igroups, gscale, gpen,
+                         hist_layout=hlayout)
             if mp:
                 # multi-host: replicate row_leaf so every process can read
                 # its full copy for the score update (one [N] allgather per
@@ -205,7 +207,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             (None if self.gain_scale is None
              else jax.device_put(self.gain_scale, self._rep_sharding)),
             (None if gain_penalty is None
-             else jax.device_put(gain_penalty, self._rep_sharding)))
+             else jax.device_put(gain_penalty, self._rep_sharding)),
+            (None if self.hist_layout is None
+             else jax.device_put(self.hist_layout, self._rep_sharding)))
         if self.multiprocess:
             # pull everything process-local so the booster can mix state
             # with its (non-mesh) score arrays

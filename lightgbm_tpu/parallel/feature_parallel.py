@@ -48,10 +48,14 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         self.mesh = build_mesh(config, self.AXIS)
         self.n_dev = self.mesh.devices.size
         # feature-parallel scans per-feature histograms directly; EFB's
-        # bundle decode would couple shards, so run unbundled here
+        # bundle decode would couple shards, so run unbundled here.  The
+        # histogram width-class plan is also cleared: it permutes GLOBAL
+        # storage columns, but each shard's bins matrix is a local slice.
         self.bmap = None
+        self.hist_layout = None
         self.grower_cfg = self.grower_cfg._replace(
-            axis_name=self.AXIS, parallel_mode="feature", use_efb=False)
+            axis_name=self.AXIS, parallel_mode="feature", use_efb=False,
+            hist_widths=())
 
         f = dataset.num_features
         self.fpad = (-f) % self.n_dev

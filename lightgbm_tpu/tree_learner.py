@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .efb import BundleMap, expand_bundle_hist
-from .ops.histogram import build_histogram
+from .ops.histogram import (HistLayout, build_histogram, plan_width_classes,
+                            resolve_impl)
 from .ops.split import (SplitResult, find_best_split, leaf_output, leaf_gain,
                         K_EPSILON)
 from .tree import Tree
@@ -53,6 +54,11 @@ class GrowerConfig(NamedTuple):
     max_delta_step: float = 0.0
     hist_impl: str = "auto"
     hist_dtype: str = "float32"   # MXU contraction dtype (config tpu_precision)
+    # bin-width classes (ops/histogram.plan_width_classes): static
+    # (class_width, column_count) pairs in permuted-column order; () runs the
+    # single global-num_bins contraction.  The matching HistLayout rides as a
+    # traced grower argument (device arrays can't live in the static config).
+    hist_widths: tuple = ()
     # distributed mode under shard_map (reference 4-mode learner factory,
     # src/treelearner/tree_learner.cpp):
     #   "none"    serial single-device
@@ -542,6 +548,7 @@ def grow_tree(cfg: GrowerConfig,
               igroups: Optional[jnp.ndarray] = None,  # [G, F] interaction sets
               gain_scale_f: Optional[jnp.ndarray] = None,   # feature_contri
               gain_penalty_f: Optional[jnp.ndarray] = None,  # CEGB
+              hist_layout: Optional[HistLayout] = None,  # width-class perm
               ) -> TreeState:
     """Grow one tree; returns the final TreeState (all device arrays)."""
     n = bins.shape[0]
@@ -555,7 +562,8 @@ def grow_tree(cfg: GrowerConfig,
 
     def hist_of(weights):
         h = build_histogram(bins, weights, B, impl=cfg.hist_impl,
-                            hist_dtype=cfg.hist_dtype)
+                            hist_dtype=cfg.hist_dtype,
+                            layout=hist_layout, widths=cfg.hist_widths)
         if ax is not None:
             h = jax.lax.psum(h, ax)  # reference: Network::ReduceScatter of
             # histograms (data_parallel_tree_learner.cpp:184); psum over ICI
@@ -699,19 +707,25 @@ def grow_tree(cfg: GrowerConfig,
 # dense masked grower to O(N * avg_depth / 2).
 
 
-def _bucket_sizes(n: int, min_bucket: int = 32768):
-    """Power-of-two padded gather sizes up to >= n.
+def _bucket_sizes(n: int, min_bucket: int = 32768, growth: int = 4):
+    """Geometric padded gather sizes up to >= n.
 
     min_bucket bounds the lax.switch branch count (each branch compiles its
     own partition + histogram program — VERDICT r3 flagged the compile-time
     blowup at min_bucket=1024); below ~32k rows the per-split cost is fixed
-    overhead anyway, so finer buckets buy nothing.
+    overhead anyway, so finer buckets buy nothing.  growth=4 (was 2)
+    flattens the ladder further: every bucket dropped removes one compiled
+    partition program AND one histogram program from the per-split switches,
+    which is where the grower's compile time lives (BENCH_r05 setup_s=17.3s
+    vs 7.2s train); the price — up to 4x instead of 2x padded rows on the
+    smaller child's histogram — is bounded by the subtraction trick already
+    halving histogram row-work per split.
     """
     sizes = []
     s = min(min_bucket, max(1024, n))
     while s < n:
         sizes.append(s)
-        s *= 2
+        s *= growth
     sizes.append(s)  # >= n
     return sizes
 
@@ -760,6 +774,7 @@ def grow_tree_compact(cfg: GrowerConfig,
                       mono_global: Optional[jnp.ndarray] = None,
                       lazy_pen_f: Optional[jnp.ndarray] = None,
                       used_init: Optional[jnp.ndarray] = None,
+                      hist_layout: Optional[HistLayout] = None,
                       ) -> TreeState:
     """Grow one tree with the partition-order strategy; same TreeState out.
 
@@ -919,9 +934,11 @@ def grow_tree_compact(cfg: GrowerConfig,
             return base + lazy_pen_f * nu
 
     # ---- root ----------------------------------------------------------
-    root_hist = psum_(build_histogram(
-        bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
-        impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype))
+    with jax.named_scope("grow::hist"):
+        root_hist = psum_(build_histogram(
+            bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
+            impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
+            layout=hist_layout, widths=cfg.hist_widths))
     root_sums = root_hist[0].sum(axis=0)
     if mode == "voting":
         root_sums = jax.lax.psum(root_sums, ax)
@@ -1066,14 +1083,16 @@ def grow_tree_compact(cfg: GrowerConfig,
                 return gl
 
             # -- partition the segment (bucketed static window)
-            pidx = jnp.searchsorted(bucket_arr, k, side="left")
-            order, n_left = jax.lax.switch(
-                pidx,
-                [functools.partial(
-                    lambda o, kp: _partition_segment(o, s, k, go_left_of_rows,
-                                                     kp), kp=kp)
-                 for kp in buckets],
-                order)
+            with jax.named_scope("grow::partition"):
+                pidx = jnp.searchsorted(bucket_arr, k, side="left")
+                order, n_left = jax.lax.switch(
+                    pidx,
+                    [functools.partial(
+                        lambda o, kp: _partition_segment(o, s, k,
+                                                         go_left_of_rows,
+                                                         kp), kp=kp)
+                     for kp in buckets],
+                    order)
 
             n_right = k - n_left
             leaf_start = leaf_start.at[best_leaf].set(s).at[new_leaf].set(
@@ -1121,22 +1140,29 @@ def grow_tree_compact(cfg: GrowerConfig,
             k_h = jnp.where(left_smaller, n_left, n_right)
 
             def hist_child(kp: int):
-                rows = jax.lax.dynamic_slice(order, (s_h,), (kp,))
-                validh = (jnp.arange(kp, dtype=jnp.int32) < k_h).astype(fdt)
-                w = jnp.stack([grad_m[rows], hess_m[rows],
-                               sample_mask[rows]], axis=1) * validh[:, None]
-                return build_histogram(bins[rows], w, B, impl=cfg.hist_impl,
-                                       hist_dtype=cfg.hist_dtype)
+                with jax.named_scope("grow::gather"):
+                    rows = jax.lax.dynamic_slice(order, (s_h,), (kp,))
+                    validh = (jnp.arange(kp, dtype=jnp.int32) < k_h).astype(fdt)
+                    w = jnp.stack([grad_m[rows], hess_m[rows],
+                                   sample_mask[rows]], axis=1) * validh[:, None]
+                    child_bins = bins[rows]
+                with jax.named_scope("grow::hist"):
+                    return build_histogram(child_bins, w, B,
+                                           impl=cfg.hist_impl,
+                                           hist_dtype=cfg.hist_dtype,
+                                           layout=hist_layout,
+                                           widths=cfg.hist_widths)
 
             hidx = jnp.searchsorted(bucket_arr, k_h, side="left")
             hist_small = psum_(jax.lax.switch(
                 hidx, [functools.partial(hist_child, kp) for kp in buckets]))
 
-            parent_hist = pool[best_leaf]
-            hist_other = parent_hist - hist_small
-            hist_l = jnp.where(left_smaller, hist_small, hist_other)
-            hist_r = jnp.where(left_smaller, hist_other, hist_small)
-            pool = pool.at[best_leaf].set(hist_l).at[new_leaf].set(hist_r)
+            with jax.named_scope("grow::subtract"):
+                parent_hist = pool[best_leaf]
+                hist_other = parent_hist - hist_small
+                hist_l = jnp.where(left_smaller, hist_small, hist_other)
+                hist_r = jnp.where(left_smaller, hist_other, hist_small)
+                pool = pool.at[best_leaf].set(hist_l).at[new_leaf].set(hist_r)
 
             depth = state.leaf_depth[best_leaf] + 1
             new_state = _apply_split_bookkeeping(
@@ -1195,14 +1221,17 @@ def grow_tree_compact(cfg: GrowerConfig,
             if use_lazy:
                 kw_l["pen_f"] = pen_plus(nu_l)
                 kw_r["pen_f"] = pen_plus(nu_r)
-            res_l = scan_dispatch(hist_l, new_state.leaf_sum[best_leaf],
-                                  depth, fmask,
-                                  (new_state.leaf_lo[best_leaf],
-                                   new_state.leaf_hi[best_leaf]), rb, **kw_l)
-            res_r = scan_dispatch(hist_r, new_state.leaf_sum[new_leaf],
-                                  depth, fmask,
-                                  (new_state.leaf_lo[new_leaf],
-                                   new_state.leaf_hi[new_leaf]), rb, **kw_r)
+            with jax.named_scope("grow::scan"):
+                res_l = scan_dispatch(hist_l, new_state.leaf_sum[best_leaf],
+                                      depth, fmask,
+                                      (new_state.leaf_lo[best_leaf],
+                                       new_state.leaf_hi[best_leaf]), rb,
+                                      **kw_l)
+                res_r = scan_dispatch(hist_r, new_state.leaf_sum[new_leaf],
+                                      depth, fmask,
+                                      (new_state.leaf_lo[new_leaf],
+                                       new_state.leaf_hi[new_leaf]), rb,
+                                      **kw_r)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
             return (new_state, order, leaf_start, leaf_count, pool, f_aborted,
@@ -1352,6 +1381,19 @@ class SerialTreeLearner:
         )
         self.is_cat_f = jnp.asarray(dataset.is_categorical.astype(bool))
         self.bmap = dataset.bundle_map
+        # bin-width classes (reference 16/64/256 kernel specialization): the
+        # plan lives on the learner — feature-parallel shards clear it (their
+        # bins columns are shard-local slices the global plan doesn't match).
+        # Skipped when the impl resolves to segment: scatter-add cost doesn't
+        # scale with bin count, so classes only add permute overhead there
+        # (BENCH_STAGE=hist quantifies both directions).
+        self.hist_layout = None
+        if (getattr(config, "histogram_width_classes", True)
+                and resolve_impl(config.histogram_impl) != "segment"
+                and getattr(dataset, "device_col_num_bins", None) is not None):
+            self.hist_layout, widths = plan_width_classes(
+                dataset.device_col_num_bins, dataset.max_num_bins)
+            self.grower_cfg = self.grower_cfg._replace(hist_widths=widths)
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         mono = np.zeros(dataset.num_features, np.int8)
         if config.monotone_constraints:
@@ -1501,7 +1543,8 @@ class SerialTreeLearner:
                     sample_mask, ds.num_bins_per_feature,
                     ds.has_missing_per_feature, feature_mask,
                     self.monotone, key, self.is_cat_f, self.bmap,
-                    self.igroups, self.gain_scale, None, **kw)
+                    self.igroups, self.gain_scale, None,
+                    hist_layout=self.hist_layout, **kw)
 
     def train(self, grad, hess, sample_mask, iteration: int,
               gain_penalty=None):
@@ -1519,7 +1562,8 @@ class SerialTreeLearner:
                      sample_mask, ds.num_bins_per_feature,
                      ds.has_missing_per_feature, self.feature_mask(),
                      self.monotone, key, self.is_cat_f, self.bmap,
-                     self.igroups, self.gain_scale, gain_penalty, **kw)
+                     self.igroups, self.gain_scale, gain_penalty,
+                     hist_layout=self.hist_layout, **kw)
         if self.cegb_lazy_pen is not None:
             # carry the used-rows matrix to the next tree (reference
             # feature_used_in_data_ persists across iterations)

@@ -256,3 +256,25 @@ def test_forced_splits_categorical(tmp_path):
         assert root["decision_type"] == "=="
         # the left branch holds exactly category 3
         assert str(root["threshold"]).split("||") == ["3"]
+
+
+def test_monotone_advanced_warns_of_fallback():
+    """monotone_constraints_method=advanced is not implemented — config
+    validation must NAME the intermediate fallback instead of silently
+    aliasing it (ISSUE 2 satellite / VERDICT weak #7)."""
+    from lightgbm_tpu import log as lgb_log
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.log import register_log_callback, set_verbosity
+
+    lines = []
+    register_log_callback(lines.append)
+    prev_verbosity = lgb_log._VERBOSITY
+    set_verbosity(1)   # earlier tests may have trained with verbosity=-1
+    try:
+        Config({"monotone_constraints": [1, -1, 0],
+                "monotone_constraints_method": "advanced"})
+    finally:
+        register_log_callback(None)
+        set_verbosity(prev_verbosity)
+    joined = "".join(lines)
+    assert "advanced" in joined and "intermediate" in joined

@@ -1,0 +1,193 @@
+"""Bin-width-class histogram engine: cross-impl parity + end-to-end checks.
+
+ISSUE 2 satellite: segment vs onehot vs pallas(interpret-mode) histograms
+must be BIT-identical across the {16, 64, 256} width classes, with and
+without a width plan; EFB-bundled training must produce identical models
+with the plan on and off.  Weights are chosen as multiples of 1/256 with
+bounded magnitude so every partial sum is exactly representable in f32 —
+bit-identity is then a meaningful assertion, not a tolerance.
+
+bf16 note (documented tolerance): with ``hist_dtype="bfloat16"`` the one-hot
+operand and weights are ROUNDED to bf16 before the contraction (accumulation
+stays f32, reference gpu_use_dp trade-off) — histograms then match the f32
+path only to bf16's ~3 decimal digits; the suite asserts rtol=2e-2 plus
+exact count-channel equality (counts are small integers, exact in bf16).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import (HistLayout, build_histogram,
+                                        plan_width_classes)
+
+WIDTHS = (16, 64, 256)
+IMPLS = ("segment", "onehot", "pallas")
+
+
+def _exact_weights(rng, n, c=3):
+    # multiples of 1/256 in [-2, 2]: sums of <=4096 of these stay exact in f32
+    return (rng.randint(-512, 512, size=(n, c)) / 256.0).astype(np.float32)
+
+
+def _mixed_bins(rng, n, col_nb):
+    return np.stack([rng.randint(0, nb, size=n) for nb in col_nb],
+                    axis=1).astype(np.uint8)
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+@pytest.mark.parametrize("impl", IMPLS)
+def test_single_class_matches_global(impl, width):
+    """Width-matched contraction == global-B contraction, bit for bit."""
+    rng = np.random.RandomState(width)
+    n, f, B = 700, 6, 256
+    bins = jnp.asarray(rng.randint(0, width, size=(n, f)).astype(np.uint8))
+    w = jnp.asarray(_exact_weights(rng, n))
+    layout, widths = plan_width_classes(np.full(f, width), B)
+    ref = np.asarray(build_histogram(bins, w, B, impl="segment"))
+    got = np.asarray(build_histogram(bins, w, B, impl=impl,
+                                     layout=layout, widths=widths))
+    assert np.array_equal(got, ref)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_mixed_classes_cross_impl_bit_identical(impl):
+    """Columns spanning all three classes: every impl, planned or not,
+    produces the identical [F, B, C] pool-layout histogram."""
+    rng = np.random.RandomState(0)
+    n, B = 900, 256
+    col_nb = np.array([3, 16, 17, 64, 65, 200, 256, 30, 5])
+    bins = jnp.asarray(_mixed_bins(rng, n, col_nb))
+    w = jnp.asarray(_exact_weights(rng, n))
+    layout, widths = plan_width_classes(col_nb, B)
+    assert [wd for wd, _ in widths] == [16, 64, 256]
+    assert sum(cnt for _, cnt in widths) == len(col_nb)
+    ref = np.asarray(build_histogram(bins, w, B, impl="segment"))
+    got = np.asarray(build_histogram(bins, w, B, impl=impl,
+                                     layout=layout, widths=widths))
+    assert np.array_equal(got, ref)
+
+
+def test_plan_degenerates_to_global():
+    # one class at the global width: no plan, plain contraction
+    layout, widths = plan_width_classes(np.full(5, 64), 64)
+    assert layout is None and widths == ()
+    # single class narrower than the pool is still planned
+    layout, widths = plan_width_classes(np.full(5, 16), 256)
+    assert layout is not None and widths == ((16, 5),)
+
+
+def test_plan_width_covers_every_column():
+    rng = np.random.RandomState(1)
+    col_nb = rng.randint(2, 257, size=40)
+    layout, widths = plan_width_classes(col_nb, 256)
+    perm = np.asarray(layout.perm)
+    inv = np.asarray(layout.inv_perm)
+    assert sorted(perm.tolist()) == list(range(40))
+    assert np.array_equal(perm[inv], np.arange(40))
+    # every column's class holds its bin count
+    off = 0
+    for wd, cnt in widths:
+        assert (col_nb[perm[off:off + cnt]] <= wd).all()
+        off += cnt
+    assert off == 40
+
+
+def test_bf16_tolerance_documented():
+    """bf16 contraction: value channels within rtol=2e-2 of f32, count
+    channel exact (small integers are representable in bf16)."""
+    rng = np.random.RandomState(2)
+    n, f, B = 2000, 8, 64
+    col_nb = np.array([16, 16, 64, 64, 9, 33, 64, 12])
+    bins = jnp.asarray(_mixed_bins(rng, n, col_nb))
+    w = np.concatenate([rng.randn(n, 2).astype(np.float32),
+                        np.ones((n, 1), np.float32)], axis=1)
+    layout, widths = plan_width_classes(col_nb, B)
+    f32 = np.asarray(build_histogram(bins, jnp.asarray(w), B, impl="onehot",
+                                     layout=layout, widths=widths))
+    bf16 = np.asarray(build_histogram(bins, jnp.asarray(w), B, impl="onehot",
+                                      hist_dtype="bfloat16",
+                                      layout=layout, widths=widths))
+    np.testing.assert_allclose(bf16[..., :2], f32[..., :2],
+                               rtol=2e-2, atol=2e-1)
+    np.testing.assert_array_equal(bf16[..., 2], f32[..., 2])
+
+
+def _efb_dataset(n=600, seed=3):
+    """Small dataset whose one-hot block actually bundles under EFB."""
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(n, 3)
+    onehot = np.zeros((n, 12))
+    onehot[np.arange(n), rng.randint(0, 12, n)] = 1.0
+    narrow = rng.randint(0, 4, size=(n, 2)).astype(float)
+    X = np.concatenate([dense, onehot, narrow], axis=1)
+    y = ((dense[:, 0] + onehot[:, 3] + 0.5 * narrow[:, 0]
+          + 0.1 * rng.randn(n)) > 0.5).astype(np.float32)
+    return X, y
+
+
+def test_efb_bundle_histogram_parity():
+    """With EFB bundle columns: the dataset's own width plan produces
+    bit-identical histograms across all three impls on the device (bundle)
+    matrix — the op-level face of the end-to-end (slow) training parity."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Metadata, TrainDataset
+
+    X, y = _efb_dataset()
+    ds = TrainDataset(X, Metadata(y), Config({"min_data_in_leaf": 5,
+                                              "verbosity": -1}))
+    assert ds.bundle_map is not None, "EFB did not bundle the one-hot block"
+    B = ds.max_num_bins
+    layout, widths = plan_width_classes(ds.device_col_num_bins, B)
+    rng = np.random.RandomState(9)
+    n = ds.device_bins.shape[0]
+    w = jnp.asarray(_exact_weights(rng, n))
+    ref = np.asarray(build_histogram(ds.device_bins, w, B, impl="segment"))
+    for impl in IMPLS:
+        got = np.asarray(build_histogram(ds.device_bins, w, B, impl=impl,
+                                         layout=layout, widths=widths))
+        assert np.array_equal(got, ref), impl
+
+
+@pytest.mark.slow
+def test_efb_training_parity_with_width_classes():
+    """End to end through Dataset/EFB/grower: models trained with the width
+    plan on and off are textually identical (same splits, same outputs).
+    slow: the on/off configs are distinct static grower programs, so the
+    test pays two full XLA compiles (~7s on the CPU mesh)."""
+    import lightgbm_tpu as lgb
+
+    X, y = _efb_dataset()
+
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5, "max_bin": 255, "histogram_impl": "onehot",
+            "seed": 7}
+    m_on = lgb.train({**base, "histogram_width_classes": True},
+                     lgb.Dataset(X, y), num_boost_round=3)
+    m_off = lgb.train({**base, "histogram_width_classes": False},
+                      lgb.Dataset(X, y), num_boost_round=3)
+    assert m_on.model_to_string() == m_off.model_to_string()
+
+
+def test_grower_width_plan_wired():
+    """The serial learner attaches a plan for onehot/pallas impls and skips
+    it for segment (scatter-add cost is B-independent)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Metadata, TrainDataset
+    from lightgbm_tpu.tree_learner import SerialTreeLearner
+
+    rng = np.random.RandomState(4)
+    X = np.concatenate([rng.randn(300, 2),
+                        rng.randint(0, 3, (300, 2)).astype(float)], axis=1)
+    y = rng.rand(300).astype(np.float32)
+    cfg = Config({"histogram_impl": "onehot", "min_data_in_leaf": 5,
+                  "verbosity": -1})
+    ds = TrainDataset(X, Metadata(y), cfg)
+    learner = SerialTreeLearner(cfg, ds)
+    assert learner.hist_layout is not None
+    assert len(learner.grower_cfg.hist_widths) >= 1
+
+    seg = SerialTreeLearner(Config({"histogram_impl": "segment",
+                                    "min_data_in_leaf": 5,
+                                    "verbosity": -1}), ds)
+    assert seg.hist_layout is None and seg.grower_cfg.hist_widths == ()
