@@ -37,7 +37,11 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
 - default        training wall-clock + held-out AUC (run_training).  The
                  result line carries `setup_breakdown` (binning_s /
                  construct_s / compile_s) so setup regressions are
-                 attributable to a stage, not just a total.
+                 attributable to a stage, not just a total, plus
+                 `checkpoint_s`/`checkpoint_frac` — wall overhead of a
+                 3-iter checkpoint_freq=1 run vs the plain hot probe
+                 (fault-tolerance subsystem cost, measured outside the
+                 headline).
 - serve          serving throughput/latency through lightgbm_tpu/serving/:
                  sustained rows/s, p50/p99 latency, batch-fill ratio, and a
                  steady-state compile count (run_serving).  Tuning knobs:
@@ -171,6 +175,27 @@ def run_training():
     from sklearn.metrics import roc_auc_score
     test_auc = float(roc_auc_score(yt, bst.predict(Xt)))
 
+    # checkpoint overhead probe (fault-tolerance subsystem): rerun the
+    # 3-iter hot probe with checkpoint_freq=1 and report the WALL delta
+    # against the plain probe above.  (The raw in-save time would
+    # overstate it: blocking in save absorbs fused-pipeline compute that
+    # otherwise overlaps.)
+    import shutil
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp(prefix="lgbm_bench_ckpt_")
+    try:
+        t_ck = time.time()
+        bst_ck = lgb.train(dict(params), train_set, num_boost_round=3,
+                           checkpoint_dir=ckpt_dir, checkpoint_freq=1)
+        bst_ck.num_trees()             # same sync the plain probe paid
+        ck_wall = max(time.time() - t_ck, 1e-9)
+        checkpoint_s = max(ck_wall - probe_s, 0.0)
+        checkpoint_frac = checkpoint_s / probe_s
+    except Exception:
+        checkpoint_s, checkpoint_frac = -1.0, -1.0   # honest failure marker
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
     ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
     our_work = rows * iters
     ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
@@ -184,6 +209,8 @@ def run_training():
         "held_out_auc": round(test_auc, 6),
         "setup_s": round(setup_s, 3),
         "setup_breakdown": setup_breakdown,
+        "checkpoint_s": round(checkpoint_s, 4),
+        "checkpoint_frac": round(checkpoint_frac, 4),
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,

@@ -27,8 +27,8 @@ if _os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "1") != "0":
         pass
 
 from .basic import Booster, Dataset, Sequence
-from .callback import (early_stopping, log_evaluation, print_evaluation,
-                       record_evaluation, reset_parameter)
+from .callback import (checkpoint_callback, early_stopping, log_evaluation,
+                       print_evaluation, record_evaluation, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .log import LightGBMError, register_log_callback
@@ -38,7 +38,8 @@ __version__ = "0.1.0"
 __all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
            "Config", "LightGBMError", "register_log_callback",
            "early_stopping", "log_evaluation", "print_evaluation",
-           "record_evaluation", "reset_parameter", "__version__"]
+           "record_evaluation", "reset_parameter", "checkpoint_callback",
+           "__version__"]
 
 
 def __getattr__(name):

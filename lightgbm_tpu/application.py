@@ -153,9 +153,12 @@ class Application:
         callbacks = []
         if self.config.metric_freq > 0 and self.config.verbosity >= 0:
             callbacks.append(cb.log_evaluation(self.config.metric_freq))
-        if self.config.snapshot_freq > 0:
-            callbacks.append(_snapshot_callback(self.config.snapshot_freq,
-                                                out_model))
+        if self.config.checkpoint_freq > 0 and not self.config.checkpoint_dir:
+            # model-only snapshots (reference snapshot_freq); with a
+            # checkpoint_dir the engine's full checkpoint/restore
+            # subsystem takes over (resume=auto by default)
+            callbacks.append(cb.checkpoint_callback(
+                self.config.checkpoint_freq, out_model))
         init_model = self.config.input_model or None
         booster = train(self.raw_params, train_set,
                         num_boost_round=self.config.num_iterations,
@@ -221,17 +224,6 @@ class Application:
         out = self.config.data + ".bin"
         ds.save_binary(out)
         log_info(f"Finished saving binary dataset to {out}")
-
-
-def _snapshot_callback(freq: int, out_model: str):
-    """Periodic model snapshots (reference GBDT::Train snapshot_freq,
-    gbdt.cpp:277-281)."""
-    def _cb(env):
-        it = env.iteration + 1
-        if it % freq == 0:
-            env.model.save_model(f"{out_model}.snapshot_iter_{it}")
-    _cb.order = 100
-    return _cb
 
 
 def main(argv: Optional[List[str]] = None) -> int:

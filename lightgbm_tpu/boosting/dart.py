@@ -21,10 +21,24 @@ class DART(GBDT):
     _fusable = False  # per-iteration host logic (drop-set selection/normalize)
     def __init__(self, config, train_data, objective):
         super().__init__(config, train_data, objective)
+        # reseeded per iteration in _dropping_trees; see the note there
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self.drop_index: List[int] = []
+
+    # -- checkpoint/restore hooks --------------------------------------
+    def training_state_extra(self):
+        out = super().training_state_extra()
+        out["dart_tree_weight"] = [float(w) for w in self.tree_weight]
+        out["dart_sum_weight"] = float(self.sum_weight)
+        return out
+
+    def load_training_state_extra(self, extra) -> None:
+        super().load_training_state_extra(extra)
+        self.tree_weight = [float(w)
+                            for w in extra.get("dart_tree_weight", [])]
+        self.sum_weight = float(extra.get("dart_sum_weight", 0.0))
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._dropping_trees()
@@ -58,6 +72,15 @@ class DART(GBDT):
     def _dropping_trees(self) -> None:
         """reference DART::DroppingTrees (dart.hpp:97-148)."""
         cfg = self.config
+        # iteration-derived drop stream (like bagging's bagging_seed +
+        # iteration, gbdt.py _bagging_mask): the reference keeps ONE
+        # RandomState advanced a variable number of draws per iteration,
+        # which cannot be reproduced after a restart without serializing
+        # raw MT19937 state — reseeding per iteration makes the drop set a
+        # pure function of (drop_seed, iteration), so resumed runs
+        # (checkpoint/) redraw it bit-identically
+        self._drop_rng = np.random.RandomState(
+            (cfg.drop_seed + self.iter_) % (2 ** 32))
         self.drop_index = []
         is_skip = self._drop_rng.rand() < cfg.skip_drop
         if not is_skip and self.iter_ > 0:

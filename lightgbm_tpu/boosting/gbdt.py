@@ -67,7 +67,6 @@ class GBDT:
         self.valid_scores: List[jnp.ndarray] = []
         self.train_metrics = create_metrics(config, objective)
         self._boosted_from_average = [False] * k
-        self._bag_rng = np.random.RandomState(config.bagging_seed)
         self.eval_results: Dict[str, Dict[str, List[float]]] = {}
         self._L = self.tree_learner.grower_cfg.num_leaves
 
@@ -171,9 +170,15 @@ class GBDT:
             if not hasattr(self, "_ones_mask"):
                 self._ones_mask = jnp.ones((n,), jnp.float32)
             return self._ones_mask
-        if iteration % cfg.bagging_freq != 0 and hasattr(self, "_last_mask"):
+        # the mask refreshes every bagging_freq iterations and is derived
+        # from bagging_seed + the REFRESH iteration (not the current one):
+        # the stream is a pure function of the iteration counter, so a
+        # resumed run (checkpoint/) regenerates a mid-cycle mask
+        # bit-identically instead of depending on a cached value
+        base_iter = iteration - iteration % cfg.bagging_freq
+        if getattr(self, "_last_mask_iter", None) == base_iter:
             return self._last_mask
-        rng = np.random.RandomState(cfg.bagging_seed + iteration)
+        rng = np.random.RandomState(cfg.bagging_seed + base_iter)
         if use_pos_neg:
             label = np.asarray(self.train_data.metadata.label)
             mask = np.zeros(n, np.float32)
@@ -185,6 +190,7 @@ class GBDT:
         else:
             mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
         self._last_mask = jnp.asarray(mask)
+        self._last_mask_iter = base_iter
         return self._last_mask
 
     def _get_gradients(self):
@@ -723,6 +729,29 @@ class GBDT:
     def restore_snapshot(self, trees: List[Tree]):
         self.models = list(trees)
         self.iter_ = len(trees) // self.num_class
+
+    # -- checkpoint/restore hooks (lightgbm_tpu/checkpoint/state.py) ----
+    def training_state_extra(self) -> Dict:
+        """Boosting-mode state beyond trees/score/iteration that a resumed
+        run needs.  Every sampler here is iteration-derived (bagging:
+        bagging_seed + refresh iteration; GOSS: bagging_seed*65537 + iter),
+        so no RNG positions appear — subclasses with genuinely extra state
+        extend this dict (DART adds its tree-weight bookkeeping)."""
+        out = {"saw_stump": bool(getattr(self, "_saw_stump", False)),
+               "boosted_from_average": [bool(b) for b in
+                                        self._boosted_from_average]}
+        if hasattr(self, "_cegb_used"):
+            out["cegb_used"] = np.asarray(self._cegb_used, bool)
+        return out
+
+    def load_training_state_extra(self, extra: Dict) -> None:
+        if extra.get("saw_stump"):
+            self._saw_stump = True
+        bfa = extra.get("boosted_from_average")
+        if bfa is not None:
+            self._boosted_from_average = [bool(b) for b in bfa]
+        if "cegb_used" in extra:
+            self._cegb_used = np.asarray(extra["cegb_used"], bool)
 
 
 def _padded(arr, size):

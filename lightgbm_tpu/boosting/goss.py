@@ -9,6 +9,11 @@ Device-native: threshold selection is a ``jax.lax.top_k`` and the
 without-replacement rest-sample uses the random-priority trick, so the whole
 adjustment stays on device (no np.partition host round-trip — VERDICT r3
 weak #9) and composes with the fused training step.
+
+Checkpoint-safe by construction: the sampling key is iteration-derived
+(``bagging_seed * 65537 + iter_``, _goss_key) and ``_goss_active`` depends
+only on the iteration counter, so a resumed run (lightgbm_tpu/checkpoint/)
+draws the same sample sequence with no RNG state to serialize.
 """
 
 from __future__ import annotations

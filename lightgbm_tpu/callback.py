@@ -9,7 +9,7 @@ from .log import log_info, log_warning
 
 __all__ = ["EarlyStopException", "CallbackEnv", "print_evaluation",
            "log_evaluation", "record_evaluation", "reset_parameter",
-           "early_stopping"]
+           "early_stopping", "checkpoint_callback"]
 
 
 class EarlyStopException(Exception):
@@ -67,6 +67,9 @@ def record_evaluation(eval_result: Dict) -> Callable:
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(value)
     _callback.order = 20
+    # pure closure-state rebuild: safe (and necessary) to re-drive from the
+    # recorded eval history when training resumes from a checkpoint
+    _callback.replay_on_resume = True
     return _callback
 
 
@@ -89,6 +92,30 @@ def reset_parameter(**kwargs) -> Callable:
             env.params.update(new_params)
     _callback.before_iteration = True
     _callback.order = 10
+    return _callback
+
+
+def checkpoint_callback(period: int, out_model: str) -> Callable:
+    """Periodic model snapshots, usable from ``engine.train`` (reference
+    GBDT::Train snapshot_freq, gbdt.cpp:277-281 — previously a CLI-only
+    hook in application.py).
+
+    Every ``period`` iterations writes the model text to
+    ``<out_model>.snapshot_iter_<N>`` ATOMICALLY (tmp + rename through the
+    io/file_io scheme registry), so a crash mid-write never leaves a
+    truncated model where a monitor or warm-start consumer might read it.
+
+    This is the lightweight, model-only sibling of the full
+    checkpoint/restore subsystem (``train(checkpoint_dir=...)``), which
+    additionally captures the resumable training state.
+    """
+    def _callback(env: CallbackEnv) -> None:
+        it = env.iteration + 1
+        if period > 0 and it % period == 0:
+            from .checkpoint import atomic_write_text
+            atomic_write_text(f"{out_model}.snapshot_iter_{it}",
+                              env.model.model_to_string())
+    _callback.order = 100
     return _callback
 
 
@@ -153,4 +180,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                                  _fmt(x) for x in best_score_list[i]))
                 raise EarlyStopException(best_iter[i], best_score_list[i])
     _callback.order = 30
+    # pure closure-state rebuild: safe (and necessary) to re-drive from the
+    # recorded eval history when training resumes from a checkpoint
+    _callback.replay_on_resume = True
     return _callback

@@ -1,0 +1,40 @@
+"""Fault-tolerant training: checkpoint/restore subsystem.
+
+cluster.py declares the failure model — synchronous SPMD, a dead worker
+fails the job, recovery is checkpoint-restart (SURVEY §5) — and this
+package implements the restart half:
+
+- ``TrainState`` / ``capture_train_state`` / ``restore_train_state``
+  (state.py): the full resumable state — trees (exact), running score,
+  iteration, per-mode extras, eval history, early-stopping bests — plus
+  a dataset fingerprint verified on restore.
+- ``CheckpointManager`` (manager.py): atomic tmp+rename writes through
+  the io/file_io scheme registry, MANIFEST.json, ``latest()`` discovery,
+  keep-last-N retention, rank-0-only writes, ``restore_barrier`` for
+  distributed restores.
+- fault injection (fault.py): ``LGBM_TPU_FAULT_ITER`` kills a chosen
+  rank at a chosen iteration so the whole recovery path is testable.
+
+Wiring: ``engine.train(..., checkpoint_dir=...)`` (or the config params
+``checkpoint_dir``/``checkpoint_freq``/``keep_checkpoints``/``resume``)
+saves every ``checkpoint_freq`` iterations and auto-resumes from the
+latest checkpoint; ``cluster.train_distributed`` supervises workers and
+relaunches the job from the latest checkpoint on worker death.
+"""
+
+from .fault import (DEFAULT_FAULT_EXIT_CODE, FAULT_ENV_VARS,
+                    InjectedWorkerFault, fault_spec, maybe_inject_fault)
+from .manager import (CHECKPOINT_SUFFIX, CheckpointManager,
+                      atomic_write_text, restore_barrier)
+from .state import (FORMAT_VERSION, TrainState, capture_train_state,
+                    dataset_fingerprint, restore_train_state,
+                    verify_fingerprint)
+
+__all__ = [
+    "TrainState", "capture_train_state", "restore_train_state",
+    "dataset_fingerprint", "verify_fingerprint", "FORMAT_VERSION",
+    "CheckpointManager", "restore_barrier", "atomic_write_text",
+    "CHECKPOINT_SUFFIX",
+    "InjectedWorkerFault", "fault_spec", "maybe_inject_fault",
+    "FAULT_ENV_VARS", "DEFAULT_FAULT_EXIT_CODE",
+]

@@ -1,0 +1,76 @@
+"""Env-driven fault injection: kill a chosen rank at a chosen iteration.
+
+The synchronous-SPMD failure model (cluster.py / SURVEY §5) is only
+testable if worker death is reproducible on demand.  These hooks let a
+test (or a chaos-engineering harness) schedule one fault:
+
+    LGBM_TPU_FAULT_ITER=<k>     fire when training reaches iteration k
+                                (0-based, BEFORE the iteration runs)
+    LGBM_TPU_FAULT_RANK=<r>     only on this rank (default 0)
+    LGBM_TPU_FAULT_MODE=exit    die like a preempted worker: os._exit,
+                                no cleanup, no atexit (default)
+    LGBM_TPU_FAULT_MODE=raise   raise InjectedWorkerFault instead — the
+                                in-process variant for fast tier-1 tests
+    LGBM_TPU_FAULT_EXIT_CODE    exit status for mode=exit (default 43)
+
+The engine's training loop calls ``maybe_inject_fault(it)`` each
+iteration; with no LGBM_TPU_FAULT_ITER set this is a single dict lookup.
+The cluster supervisor (cluster.train_distributed) strips LGBM_TPU_FAULT_*
+from worker environments on restart attempts, modelling a TRANSIENT fault
+(a preemption that does not recur) so the relaunched job can finish.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+__all__ = ["InjectedWorkerFault", "fault_spec", "maybe_inject_fault",
+           "FAULT_ENV_VARS", "DEFAULT_FAULT_EXIT_CODE"]
+
+FAULT_ITER_ENV = "LGBM_TPU_FAULT_ITER"
+FAULT_RANK_ENV = "LGBM_TPU_FAULT_RANK"
+FAULT_MODE_ENV = "LGBM_TPU_FAULT_MODE"
+FAULT_EXIT_CODE_ENV = "LGBM_TPU_FAULT_EXIT_CODE"
+FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_RANK_ENV, FAULT_MODE_ENV,
+                  FAULT_EXIT_CODE_ENV)
+DEFAULT_FAULT_EXIT_CODE = 43
+
+
+class InjectedWorkerFault(RuntimeError):
+    """Raised in place of process death when LGBM_TPU_FAULT_MODE=raise."""
+
+
+def fault_spec() -> Optional[dict]:
+    """Parse the fault env vars; None when no fault is scheduled."""
+    raw = os.environ.get(FAULT_ITER_ENV)
+    if raw is None or raw == "":
+        return None
+    return {
+        "iteration": int(raw),
+        "rank": int(os.environ.get(FAULT_RANK_ENV, "0") or 0),
+        "mode": os.environ.get(FAULT_MODE_ENV, "exit") or "exit",
+        "exit_code": int(os.environ.get(FAULT_EXIT_CODE_ENV,
+                                        str(DEFAULT_FAULT_EXIT_CODE))),
+    }
+
+
+def maybe_inject_fault(iteration: int) -> None:
+    """Die (or raise) if a fault is scheduled for this rank+iteration."""
+    spec = fault_spec()
+    if spec is None or iteration != spec["iteration"]:
+        return
+    from ..parallel.mesh import comm_rank
+    if comm_rank() != spec["rank"]:
+        return
+    if spec["mode"] == "raise":
+        raise InjectedWorkerFault(
+            f"injected fault at iteration {iteration} "
+            f"(rank {spec['rank']})")
+    sys.stderr.write(f"LGBM_TPU_FAULT: killing rank {spec['rank']} at "
+                     f"iteration {iteration}\n")
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # a preempted TPU worker gets no goodbye: skip atexit, GC, flushes
+    os._exit(spec["exit_code"])

@@ -1,0 +1,227 @@
+"""CheckpointManager: atomic, discoverable, retained checkpoint files.
+
+Write protocol (crash-safe at every step):
+1. serialize the TrainState to ``<dir>/ckpt_<iter>.lgbckpt.tmp``
+2. rename it over ``<dir>/ckpt_<iter>.lgbckpt`` (os.replace locally; a
+   registered io/file_io scheme supplies its own atomic rename)
+3. rewrite ``<dir>/MANIFEST.json`` the same tmp+rename way
+4. prune to the newest ``keep`` checkpoints
+
+A reader therefore never observes a partial checkpoint: either the rename
+happened (file is complete) or it didn't (file is absent).  ``latest()``
+unions the manifest with a directory scan so a crash between steps 2 and
+3 still finds the newly committed file.
+
+Distributed policy (reference SURVEY §5 checkpoint-restart):
+- WRITES are rank-0-only (``save`` is a silent no-op elsewhere): every
+  rank trains the same global model under synchronous SPMD, so one copy
+  suffices and concurrent writers would race the manifest.
+- RESTORES happen on every rank, followed by ``restore_barrier`` — an
+  allgather of the restored iteration that both synchronizes the ranks
+  and hard-fails if any rank loaded a different checkpoint (possible
+  when checkpoint_dir is not actually shared storage).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import file_io
+from ..log import LightGBMError, log_info, log_warning
+from ..timer import timed
+from .state import TrainState
+
+__all__ = ["CheckpointManager", "restore_barrier", "atomic_write_text",
+           "CHECKPOINT_SUFFIX"]
+
+CHECKPOINT_SUFFIX = ".lgbckpt"
+_NAME_RE = re.compile(r"^(?P<prefix>.+)_(?P<iter>\d{8})" +
+                      re.escape(CHECKPOINT_SUFFIX) + "$")
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """tmp + rename text write through the file_io scheme registry — the
+    shared primitive for model snapshots and the manifest."""
+    tmp = path + ".tmp"
+    with file_io.open_writable(tmp) as fh:
+        fh.write(text)
+    file_io.rename(tmp, path)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with file_io.open_writable(tmp, binary=True) as fh:
+        fh.write(data)
+    file_io.rename(tmp, path)
+
+
+def restore_barrier(iteration: int, timeout_s: float = 600.0) -> None:
+    """Mesh barrier after a distributed restore: all ranks rendezvous and
+    must have restored the SAME iteration.
+
+    Prefers the jax.distributed coordination-service barrier (works on
+    every backend — device collectives are unavailable on multi-process
+    CPU meshes) with the restored iteration baked into the barrier id, so
+    ranks that loaded different checkpoints time out instead of training
+    on diverged state.  Falls back to a host allgather for externally
+    injected collectives (LGBM_NetworkInitWithFunctions)."""
+    from ..parallel.mesh import (comm_size, external_collectives,
+                                 host_allgather)
+    if comm_size() <= 1:
+        return
+    if external_collectives() is None:
+        try:
+            from jax._src import distributed as _jd
+            client = getattr(_jd.global_state, "client", None)
+        except ImportError:
+            client = None
+        if client is not None:
+            try:
+                client.wait_at_barrier(
+                    f"lgbm_tpu_checkpoint_restore_{iteration}",
+                    timeout_in_ms=int(timeout_s * 1000))
+                return
+            except Exception as e:
+                raise LightGBMError(
+                    "distributed restore barrier failed — a rank restored "
+                    f"a different iteration than {iteration}, or died "
+                    "before the rendezvous. checkpoint_dir must be shared "
+                    f"storage visible to every worker ({e})") from e
+    its = host_allgather(np.asarray([iteration], np.int64)).reshape(-1)
+    if not (its == its[0]).all():
+        raise LightGBMError(
+            f"distributed restore diverged: ranks restored iterations "
+            f"{its.tolist()} — checkpoint_dir must be shared storage "
+            "visible to every worker")
+
+
+class CheckpointManager:
+    """Save/discover/load TrainState checkpoints under one directory."""
+
+    def __init__(self, directory: str, keep: int = 3,
+                 prefix: str = "ckpt"):
+        if not directory:
+            raise ValueError("CheckpointManager requires a directory")
+        self.directory = directory.rstrip("/")
+        self.keep = max(int(keep), 1)
+        self.prefix = prefix
+        self.total_save_s = 0.0           # accumulated write overhead
+        self.saves = 0
+        file_io.makedirs(self.directory)
+
+    # -- paths ---------------------------------------------------------
+    def _path(self, iteration: int) -> str:
+        return (f"{self.directory}/{self.prefix}_{iteration:08d}"
+                f"{CHECKPOINT_SUFFIX}")
+
+    @property
+    def manifest_path(self) -> str:
+        return f"{self.directory}/MANIFEST.json"
+
+    # -- write side ----------------------------------------------------
+    def is_writer(self) -> bool:
+        """Rank-0-only writes (module docstring; reference SURVEY §5)."""
+        from ..parallel.mesh import comm_rank
+        return comm_rank() == 0
+
+    def save(self, state: TrainState,
+             iteration: Optional[int] = None) -> Optional[str]:
+        """Atomically persist ``state``; returns the committed path, or
+        None on non-writer ranks."""
+        if not self.is_writer():
+            return None
+        it = int(state.iteration if iteration is None else iteration)
+        t0 = time.perf_counter()
+        with timed("checkpoint::save"):
+            path = self._path(it)
+            _atomic_write_bytes(path, state.to_bytes())
+            self._write_manifest()
+            self._retain()
+        self.total_save_s += time.perf_counter() - t0
+        self.saves += 1
+        return path
+
+    def _write_manifest(self) -> None:
+        import json
+        entries = [{"iteration": it, "file": p.rsplit("/", 1)[-1]}
+                   for it, p in self.checkpoints(scan_only=True)]
+        atomic_write_text(self.manifest_path, json.dumps(
+            {"format": "lightgbm_tpu-checkpoint-manifest",
+             "keep": self.keep, "checkpoints": entries}))
+
+    def _retain(self) -> None:
+        """Keep the newest ``keep`` checkpoints; best-effort deletes (a
+        reader may hold an old file open on some backends)."""
+        ckpts = self.checkpoints(scan_only=True)
+        for it, path in ckpts[:-self.keep]:
+            try:
+                file_io.remove(path)
+            except OSError as e:
+                log_warning(f"could not prune old checkpoint {path}: {e}")
+
+    def clear(self) -> None:
+        """Remove every checkpoint + the manifest (rank-0-only).
+        resume=never semantics: a run that explicitly ignores existing
+        checkpoints must not leave stale higher-iteration files behind
+        for a later resume=auto to pick up."""
+        if not self.is_writer():
+            return
+        for _, path in self.checkpoints(scan_only=True):
+            try:
+                file_io.remove(path)
+            except OSError as e:
+                log_warning(f"could not remove checkpoint {path}: {e}")
+        if file_io.exists(self.manifest_path):
+            try:
+                file_io.remove(self.manifest_path)
+            except OSError:
+                pass
+
+    # -- read side -----------------------------------------------------
+    def checkpoints(self, scan_only: bool = False) -> List[Tuple[int, str]]:
+        """(iteration, path) pairs sorted ascending.  Directory scan is
+        authoritative (a crash can leave the manifest one step behind);
+        the manifest exists for operators and remote schemes whose list
+        op is expensive."""
+        out = {}
+        try:
+            names = file_io.listdir(self.directory)
+        except OSError:
+            names = []
+        for name in names:
+            m = _NAME_RE.match(name)
+            if m and m.group("prefix") == self.prefix:
+                out[int(m.group("iter"))] = f"{self.directory}/{name}"
+        if not out and not scan_only and file_io.exists(self.manifest_path):
+            import json
+            with file_io.open_readable(self.manifest_path) as fh:
+                data = json.load(fh)
+            for ent in data.get("checkpoints", []):
+                out[int(ent["iteration"])] = \
+                    f"{self.directory}/{ent['file']}"
+        return sorted(out.items())
+
+    def latest(self) -> Optional[str]:
+        ckpts = self.checkpoints()
+        return ckpts[-1][1] if ckpts else None
+
+    def load(self, path: Optional[str] = None) -> TrainState:
+        path = path or self.latest()
+        if path is None:
+            raise LightGBMError(
+                f"no checkpoint found under {self.directory}")
+        with file_io.open_readable(path, binary=True) as fh:
+            data = fh.read()
+        state = TrainState.from_bytes(data)
+        log_info(f"loaded checkpoint {path} (iteration {state.iteration})")
+        return state
+
+    def load_latest(self) -> Optional[TrainState]:
+        """Latest state or None when the directory holds no checkpoints
+        (the auto-resume probe)."""
+        path = self.latest()
+        return None if path is None else self.load(path)

@@ -1,0 +1,288 @@
+"""TrainState: the full resumable state of a training run.
+
+The reference's only persistence is the model text (gbdt_model_text.cpp),
+which is enough to PREDICT from but not to RESUME: the model text rounds
+floats through ``%g`` fields, drops the in-bin thresholds the device
+traversal needs, and carries none of the loop state (iteration counter,
+DART drop bookkeeping, early-stopping bests, eval history).  TrainState
+captures everything needed for a resumed run to be BIT-IDENTICAL to an
+uninterrupted one:
+
+- the tree list (pickled exactly — float64 leaf values, in-bin
+  thresholds, linear-leaf coefficients survive byte-for-byte),
+- the running train score (the f32 accumulation order matters, so the
+  array is saved rather than recomputed),
+- the iteration counter and per-mode extras (DART tree weights, stump
+  flag, CEGB used-feature set) via GBDT.training_state_extra(),
+- the per-iteration evaluation history, replayed through the callbacks
+  on resume so early-stopping/record_evaluation closures reconstruct
+  their exact state,
+- a dataset fingerprint (bin-mapper hash + shape) verified on restore —
+  resuming against different data silently corrupts the model, so it is
+  a hard error instead.
+
+RNG positions are deliberately NOT serialized: every sampler is
+iteration-derived (bagging ``bagging_seed + iteration``, GOSS
+``bagging_seed*65537 + iteration``, DART ``drop_seed + iteration``), so
+position == iteration and restoring the counter restores the stream.
+
+Serialization is a single zip archive (state.json + arrays.npz +
+trees.pkl + a debug-only model.txt) so the manager can commit it with one
+atomic rename.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import io
+import json
+import pickle
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..log import LightGBMError
+from ..tree import Tree
+
+__all__ = ["TrainState", "dataset_fingerprint", "verify_fingerprint",
+           "capture_train_state", "restore_train_state", "FORMAT_VERSION"]
+
+FORMAT_VERSION = 1
+
+
+# ----------------------------------------------------------------------
+def dataset_fingerprint(handle) -> Dict[str, Any]:
+    """Identity of a constructed TrainDataset: a hash over every bin
+    mapper's boundaries plus the dataset shape.  Two datasets that agree
+    here bin any row identically, which is exactly the property resumed
+    training needs (trees reference bins, not raw values).
+
+    For rank-sharded datasets the mapper hash is global (mappers are
+    synced across ranks at load) while row counts are per-rank, so
+    ``num_data`` carries the GLOBAL count there and the local count is
+    skipped from the hash.
+    """
+    h = hashlib.sha256()
+    for m in handle.all_bin_mappers:
+        h.update(str(m.bin_type).encode())
+        h.update(str(m.missing_type).encode())
+        h.update(np.int64(m.num_bin).tobytes())
+        if getattr(m, "bin_2_categorical", None):
+            h.update(np.asarray(m.bin_2_categorical, np.int64).tobytes())
+        elif getattr(m, "bin_upper_bound", None) is not None:
+            h.update(np.asarray(m.bin_upper_bound, np.float64).tobytes())
+    # targets matter as much as features: resuming with different labels
+    # or weights would boost the restored trees against the wrong
+    # objective while binning identically (metadata label/weight are
+    # GLOBAL even on rank-sharded datasets, dataset.py allgather)
+    md = handle.metadata
+    t = hashlib.sha256()
+    t.update(np.asarray(md.label, np.float32).tobytes())
+    if md.weight is not None:
+        t.update(np.asarray(md.weight, np.float32).tobytes())
+    if md.init_score is not None:
+        t.update(np.asarray(md.init_score, np.float64).tobytes())
+    if md.query_boundaries is not None:
+        t.update(np.asarray(md.query_boundaries, np.int64).tobytes())
+    return {
+        "mappers_sha256": h.hexdigest(),
+        "targets_sha256": t.hexdigest(),
+        "num_total_features": int(handle.num_total_features),
+        "num_data": int(handle.num_data),
+        "rank_local": bool(getattr(handle, "rank_local", False)),
+    }
+
+
+def verify_fingerprint(saved: Dict[str, Any], handle) -> None:
+    """Refuse restore onto a dataset that does not match the checkpoint."""
+    current = dataset_fingerprint(handle)
+    mismatches = [k for k in ("mappers_sha256", "targets_sha256",
+                              "num_total_features", "num_data")
+                  if saved.get(k) != current.get(k)]
+    if mismatches:
+        raise LightGBMError(
+            "checkpoint dataset fingerprint mismatch: the checkpoint was "
+            f"written for a different dataset (differs in: "
+            f"{', '.join(mismatches)}; saved={ {k: saved.get(k) for k in mismatches} } "
+            f"current={ {k: current.get(k) for k in mismatches} }). "
+            "Resuming would bin rows differently and corrupt the model — "
+            "point checkpoint_dir at a fresh directory to start over, or "
+            "train on the original data.")
+
+
+def _json_scalar(obj):
+    """json.dumps fallback for numpy scalars that slip into best_score or
+    eval history through custom fevals."""
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    raise TypeError(f"not JSON serializable in checkpoint header: "
+                    f"{type(obj).__name__}")
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class TrainState:
+    """Everything needed to resume training bit-identically."""
+
+    iteration: int
+    trees: List[Tree]
+    train_score: np.ndarray                 # [K, N] float32
+    extra: Dict[str, Any]                   # GBDT.training_state_extra()
+    eval_history: List[List[tuple]]         # per-iteration eval tuples
+    best_iteration: int
+    best_score: Dict[str, Dict[str, float]]
+    fingerprint: Dict[str, Any]
+    meta: Dict[str, Any]                    # boosting/objective/num_class
+
+    # -- serialization -------------------------------------------------
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        header = {
+            "format_version": FORMAT_VERSION,
+            "iteration": int(self.iteration),
+            "best_iteration": int(self.best_iteration),
+            "best_score": self.best_score,
+            "eval_history": [[list(t) for t in ev]
+                             for ev in self.eval_history],
+            "fingerprint": self.fingerprint,
+            "meta": self.meta,
+        }
+        arrays = io.BytesIO()
+        np.savez(arrays, train_score=np.asarray(self.train_score,
+                                                np.float32))
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("state.json", json.dumps(header,
+                                                 default=_json_scalar))
+            zf.writestr("arrays.npz", arrays.getvalue())
+            zf.writestr("trees.pkl", pickle.dumps(
+                {"trees": _clean_trees(self.trees), "extra": self.extra},
+                protocol=pickle.HIGHEST_PROTOCOL))
+            zf.writestr("model.txt", self._debug_model_text())
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "TrainState":
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            header = json.loads(zf.read("state.json"))
+            if header.get("format_version") != FORMAT_VERSION:
+                raise LightGBMError(
+                    "unsupported checkpoint format_version "
+                    f"{header.get('format_version')!r} (this build reads "
+                    f"{FORMAT_VERSION})")
+            with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
+                train_score = np.asarray(npz["train_score"])
+            payload = pickle.loads(zf.read("trees.pkl"))
+        return TrainState(
+            iteration=int(header["iteration"]),
+            trees=payload["trees"],
+            train_score=train_score,
+            extra=payload["extra"],
+            eval_history=[[tuple(t) for t in ev]
+                          for ev in header["eval_history"]],
+            best_iteration=int(header["best_iteration"]),
+            best_score=header["best_score"],
+            fingerprint=header["fingerprint"],
+            meta=header["meta"])
+
+    def _debug_model_text(self) -> str:
+        """Human-inspectable tree dump inside the archive.  NOT used for
+        restore (the %g fields are lossy); trees.pkl is authoritative."""
+        lines = [f"# lightgbm_tpu checkpoint (iteration={self.iteration}); "
+                 "debug dump only — restore reads trees.pkl", ""]
+        for i, t in enumerate(self.trees):
+            lines.append(t.to_string(i))
+        return "\n".join(lines)
+
+
+def _clean_trees(trees: List[Tree]) -> List[Tree]:
+    """Shallow-copy trees without device-array caches (the categorical
+    mask cache holds jax Arrays; rebuilt lazily after restore)."""
+    out = []
+    for t in trees:
+        if getattr(t, "_cat_mask_cache", None) is not None:
+            t = copy.copy(t)
+            t._cat_mask_cache = None
+        out.append(t)
+    return out
+
+
+# ----------------------------------------------------------------------
+def capture_train_state(booster,
+                        eval_history: Optional[List[List[tuple]]] = None
+                        ) -> TrainState:
+    """Snapshot a live Booster mid-training.  Reading ``models`` flushes
+    any pending device states first, so the captured tree list and score
+    are consistent with ``iter_``."""
+    gbdt = booster._gbdt
+    if gbdt is None:
+        raise LightGBMError("capture_train_state requires a training "
+                            "Booster (not a loaded predictor)")
+    trees = list(gbdt.models)              # flushes the fused pipeline
+    return TrainState(
+        iteration=int(gbdt.iter_),
+        trees=trees,
+        train_score=np.asarray(gbdt.train_score, np.float32),
+        extra=gbdt.training_state_extra(),
+        eval_history=[list(ev) for ev in (eval_history or [])],
+        best_iteration=int(booster.best_iteration),
+        best_score=dict(booster.best_score),
+        fingerprint=dataset_fingerprint(gbdt.train_data),
+        meta={
+            "boosting": type(gbdt).__name__.lower(),
+            "objective": gbdt.objective.name,
+            "num_class": int(gbdt.num_class),
+            "num_trees": len(trees),
+        })
+
+
+def restore_train_state(booster, state: TrainState) -> None:
+    """Load a TrainState into a freshly constructed Booster (zero
+    iterations trained, no valid sets added yet — valid-set score
+    catch-up happens in add_valid, which replays the restored trees).
+
+    Verifies the dataset fingerprint and the model-shape meta before
+    touching anything, so a mismatch leaves the Booster untrained."""
+    import jax.numpy as jnp
+
+    gbdt = booster._gbdt
+    if gbdt is None:
+        raise LightGBMError("restore_train_state requires a training "
+                            "Booster (not a loaded predictor)")
+    if gbdt.iter_ != 0 or gbdt.models:
+        raise LightGBMError("restore_train_state requires a fresh Booster "
+                            f"(this one already trained {gbdt.iter_} "
+                            "iterations)")
+    verify_fingerprint(state.fingerprint, gbdt.train_data)
+    expect = type(gbdt).__name__.lower()
+    if state.meta.get("boosting") != expect:
+        raise LightGBMError(
+            f"checkpoint was written by boosting={state.meta.get('boosting')!r}"
+            f" but this run uses boosting={expect!r}")
+    if int(state.meta.get("num_class", 1)) != gbdt.num_class:
+        raise LightGBMError(
+            f"checkpoint num_class={state.meta.get('num_class')} != "
+            f"configured num_class={gbdt.num_class}")
+    if len(state.trees) != state.iteration * gbdt.num_class:
+        raise LightGBMError(
+            f"corrupt checkpoint: {len(state.trees)} trees for "
+            f"{state.iteration} iterations x {gbdt.num_class} classes")
+    score = np.asarray(state.train_score, np.float32)
+    if score.shape != (gbdt.num_class, gbdt.train_data.num_data):
+        raise LightGBMError(
+            f"corrupt checkpoint: train_score shape {score.shape} != "
+            f"{(gbdt.num_class, gbdt.train_data.num_data)}")
+
+    gbdt.models = list(state.trees)
+    gbdt.iter_ = int(state.iteration)
+    gbdt.train_score = jnp.asarray(score)
+    gbdt.load_training_state_extra(dict(state.extra))
+    booster.best_iteration = int(state.best_iteration)
+    booster.best_score = dict(state.best_score)
+    booster._invalidate_stacked()

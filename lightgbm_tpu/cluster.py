@@ -1,5 +1,5 @@
 """Multi-process training launcher: the reference's Dask-orchestration
-equivalent.
+equivalent, with supervised checkpoint-restart recovery.
 
 Reference python-package/lightgbm/dask.py:67-181,724: the Dask layer's whole
 job is cluster plumbing — find open ports, build the `machines` list, launch
@@ -10,7 +10,15 @@ host runs one worker and the mesh spans all chips over ICI/DCN.
 
 Synchronous-SPMD fault model as in the reference: every worker must
 participate in every iteration; a dead worker fails the job (no elasticity),
-recovery is checkpoint-restart (SURVEY §5 failure model).
+recovery is checkpoint-restart (SURVEY §5 failure model).  The supervisor in
+``train_distributed`` implements that recovery: workers checkpoint through
+lightgbm_tpu/checkpoint/ (rank-0-only atomic writes), and when ANY worker
+exits abnormally the survivors are killed and the whole job is relaunched —
+resuming from the latest checkpoint — with bounded exponential backoff, up
+to ``max_restarts`` times.  ``LGBM_TPU_FAULT_ITER`` (checkpoint/fault.py)
+makes the path testable by killing a chosen rank at a chosen iteration;
+fault env vars are stripped on restart attempts, modelling a transient
+preemption.
 """
 
 from __future__ import annotations
@@ -20,9 +28,10 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Callable, Dict, Optional, Sequence
 
-from .log import log_info
+from .log import log_info, log_warning
 
 __all__ = ["train_distributed", "find_open_ports"]
 
@@ -71,6 +80,14 @@ print("LGBM_TPU_WORKER_DONE", rank, flush=True)
 """
 
 
+def _tail(path: str, n: int = 4000) -> str:
+    try:
+        with open(path, errors="replace") as fh:
+            return fh.read()[-n:]
+    except OSError:
+        return "<no worker log>"
+
+
 def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
                       num_workers: int = 2,
                       hosts: Optional[Sequence[str]] = None,
@@ -91,59 +108,141 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
     memory is O(N/num_workers).  Without it, every worker must return the
     FULL dataset (reference pre_partition=false semantics).
 
+    Fault tolerance: when ``max_restarts`` (param, default 2) is positive,
+    workers checkpoint into ``checkpoint_dir`` (param; defaults to a
+    job-private temp directory) and the supervisor relaunches the whole
+    job from the latest checkpoint after any worker death, waiting
+    ``restart_backoff_s * 2**attempt`` between attempts.  Each attempt
+    gets fresh ports (the dead mesh's ports may sit in TIME_WAIT).
+    ``timeout`` bounds each attempt, not the total.
+
     Only localhost launch is implemented — on a multi-host pod, start one
     process per host yourself with LIGHTGBM_TPU_RANK + the same params and
-    this module's machines list convention.
+    this module's machines list convention; ``checkpoint_dir`` must then
+    live on storage shared by every host.
     """
     if hosts is None:
         hosts = ["127.0.0.1"] * num_workers
-    ports = find_open_ports(num_workers)
-    machines = ",".join(f"{h}:{p}" for h, p in zip(hosts, ports))
-    log_info(f"launching {num_workers} workers: {machines}")
+    params = dict(params)
+    max_restarts = int(params.get("max_restarts", 2) or 0)
+    backoff_s = float(params.get("restart_backoff_s", 1.0) or 0.0)
 
     tmp = tempfile.mkdtemp(prefix="lgbm_tpu_cluster_")
-    payload = os.path.join(tmp, "job.pkl")
     model_out = os.path.join(tmp, "model.txt")
-    net_params = {"num_machines": num_workers, "machines": machines,
-                  "tree_learner": params.get("tree_learner", "data"),
-                  "num_tpu_devices": params.get("num_tpu_devices", 0)}
+    if max_restarts > 0 and not params.get("checkpoint_dir"):
+        # restarts without checkpoints would replay the whole run; give
+        # the job a private checkpoint directory so resume is automatic.
+        # Auto-provisioned checkpointing defaults to ~10 saves per run,
+        # not every iteration (full-state saves re-serialize the whole
+        # tree list and sync the device pipeline) — an explicit
+        # checkpoint_freq in params still wins.
+        params["checkpoint_dir"] = os.path.join(tmp, "checkpoints")
+        if int(params.get("checkpoint_freq", -1) or -1) <= 0:
+            params["checkpoint_freq"] = max(1, num_boost_round // 10)
     try:
         import cloudpickle as _pickler
     except ImportError:          # data_fn must then be importable by name
         import pickle as _pickler
-    with open(payload, "wb") as fh:
-        _pickler.dump({"params": params, "net_params": net_params,
-                     "data_fn": data_fn, "ports": ports,
-                     "num_workers": num_workers,
-                     "num_boost_round": num_boost_round,
-                     "model_out": model_out}, fh)
     script = os.path.join(tmp, "worker.py")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(script, "w") as fh:
-        fh.write(_WORKER_TEMPLATE.format(repo=repo, payload=payload))
 
-    procs = []
-    for rank in range(num_workers):
-        env = dict(os.environ)
-        env["LIGHTGBM_TPU_RANK"] = str(rank)
-        if platform:
-            env["LIGHTGBM_TPU_PLATFORM"] = platform
-            env["JAX_PLATFORMS"] = platform
-        procs.append(subprocess.Popen(
-            [sys.executable, script], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for p in procs:
-        try:
-            stdout, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(stdout)
-    for rank, (p, text) in enumerate(zip(procs, outs)):
-        if p.returncode != 0:
+    def _launch(attempt: int):
+        """One attempt: fresh ports/payload, one process per rank with
+        stdout+stderr to per-attempt log files (no PIPE: a supervisor that
+        polls instead of reading must not let a chatty worker block)."""
+        ports = find_open_ports(num_workers)
+        machines = ",".join(f"{h}:{p}" for h, p in zip(hosts, ports))
+        log_info(f"launching {num_workers} workers (attempt {attempt}): "
+                 f"{machines}")
+        net_params = {"num_machines": num_workers, "machines": machines,
+                      "tree_learner": params.get("tree_learner", "data"),
+                      "num_tpu_devices": params.get("num_tpu_devices", 0)}
+        payload = os.path.join(tmp, f"job_a{attempt}.pkl")
+        with open(payload, "wb") as fh:
+            _pickler.dump({"params": params, "net_params": net_params,
+                           "data_fn": data_fn, "ports": ports,
+                           "num_workers": num_workers,
+                           "num_boost_round": num_boost_round,
+                           "model_out": model_out}, fh)
+        with open(script, "w") as fh:
+            fh.write(_WORKER_TEMPLATE.format(repo=repo, payload=payload))
+        procs, logs = [], []
+        for rank in range(num_workers):
+            env = dict(os.environ)
+            env["LIGHTGBM_TPU_RANK"] = str(rank)
+            if platform:
+                env["LIGHTGBM_TPU_PLATFORM"] = platform
+                env["JAX_PLATFORMS"] = platform
+            if attempt > 0:
+                # transient-fault model: an injected fault does not recur
+                # on the relaunch (checkpoint/fault.py)
+                from .checkpoint.fault import FAULT_ENV_VARS
+                for var in FAULT_ENV_VARS:
+                    env.pop(var, None)
+            log_path = os.path.join(tmp, f"worker_{rank}_a{attempt}.log")
+            logs.append(log_path)
+            log_fh = open(log_path, "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, script], env=env,
+                stdout=log_fh, stderr=subprocess.STDOUT, text=True))
+            log_fh.close()       # the child keeps its own handle
+        return procs, logs
+
+    def _kill_all(procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+
+    attempt = 0
+    while True:
+        procs, logs = _launch(attempt)
+        deadline = time.time() + timeout
+        failed_rank = None
+        hung = False
+        while True:
+            rcs = [p.poll() for p in procs]
+            bad = [r for r, rc in enumerate(rcs) if rc not in (None, 0)]
+            if bad:
+                failed_rank = bad[0]
+                break
+            if all(rc == 0 for rc in rcs):
+                break
+            if time.time() > deadline:
+                # a preempted worker often HANGS (survivors block in
+                # collectives) rather than exiting: a timed-out attempt
+                # is a failure like any other and consumes a restart
+                hung = True
+                failed_rank = next((r for r, rc in enumerate(rcs)
+                                    if rc is None), 0)
+                break
+            time.sleep(0.2)
+        if failed_rank is None:
+            break                # every worker exited cleanly
+        # synchronous SPMD: one death stalls everyone — kill the
+        # survivors, then decide whether the restart budget allows a
+        # relaunch from the latest checkpoint
+        rc = procs[failed_rank].returncode
+        _kill_all(procs)
+        why = (f"hung past the {timeout}s attempt deadline" if hung
+               else f"died (rc={rc})")
+        if attempt >= max_restarts:
+            if hung:
+                raise subprocess.TimeoutExpired(
+                    cmd=f"{sys.executable} {script}", timeout=timeout)
             raise RuntimeError(
-                f"worker {rank} failed (rc={p.returncode}):\n{text[-4000:]}")
+                f"worker {failed_rank} failed (rc={rc}) and the restart "
+                f"budget is exhausted ({attempt}/{max_restarts} restarts "
+                f"used):\n{_tail(logs[failed_rank])}")
+        delay = backoff_s * (2.0 ** attempt)
+        log_warning(
+            f"worker {failed_rank} {why}; killed survivors, "
+            f"relaunching from the latest checkpoint in {delay:.1f}s "
+            f"(restart {attempt + 1}/{max_restarts})")
+        if delay > 0:
+            time.sleep(delay)
+        attempt += 1
+
     from .basic import Booster
     return Booster(model_file=model_out)

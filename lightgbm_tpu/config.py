@@ -120,7 +120,30 @@ _PARAMS: List[ParamSpec] = [
        ("convert_model_file",)),
     _p("convert_model_language", str, "cpp", ()),
     _p("saved_feature_importance_type", int, 0),
-    _p("snapshot_freq", int, -1, ("save_period",)),
+    # ---- Fault tolerance (lightgbm_tpu/checkpoint/; reference SURVEY §5
+    # checkpoint-restart failure model) ----
+    _p("checkpoint_freq", int, -1, ("snapshot_freq", "save_period"),
+       desc="save a full training checkpoint every N iterations when "
+            "checkpoint_dir is set (<=0 with a checkpoint_dir means every "
+            "iteration); without checkpoint_dir this is the CLI "
+            "model-snapshot period (reference snapshot_freq)"),
+    _p("checkpoint_dir", str, "",
+       desc="directory for TrainState checkpoints (trees + RNG-position "
+            "iteration + scores + early-stop state + dataset fingerprint); "
+            "training auto-resumes from the latest checkpoint unless "
+            "resume=never"),
+    _p("keep_checkpoints", int, 3, (), ">0",
+       "keep-last-N checkpoint retention in checkpoint_dir"),
+    _p("resume", str, "auto", (), "in:auto|never",
+       "auto = resume from the latest checkpoint in checkpoint_dir when "
+       "one exists; never = ignore existing checkpoints (they are still "
+       "overwritten as training progresses)"),
+    _p("max_restarts", int, 2, (), ">=0",
+       "cluster.train_distributed: relaunch the job from the latest "
+       "checkpoint at most this many times after a worker death"),
+    _p("restart_backoff_s", float, 1.0, (), ">=0",
+       "cluster.train_distributed: initial restart backoff, doubled per "
+       "consecutive failed attempt"),
     _p("linear_tree", bool, False, ("linear_trees",)),
     # ---- IO / Dataset ----
     _p("max_bin", int, 255, ("max_bins",), ">1"),

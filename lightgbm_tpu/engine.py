@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -25,8 +26,23 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List] = None,
           evals_result: Optional[Dict] = None,
           early_stopping_rounds: Optional[int] = None,
-          verbose_eval="warn") -> Booster:
-    """Train a model (reference engine.py:15 train())."""
+          verbose_eval="warn",
+          checkpoint_dir: Optional[str] = None,
+          checkpoint_freq: Optional[int] = None,
+          keep_checkpoints: Optional[int] = None,
+          resume: Optional[str] = None) -> Booster:
+    """Train a model (reference engine.py:15 train()).
+
+    Fault tolerance (lightgbm_tpu/checkpoint/): pass ``checkpoint_dir``
+    (kwarg or param) to save the full resumable TrainState every
+    ``checkpoint_freq`` iterations (default: every iteration) and keep the
+    newest ``keep_checkpoints``.  When the directory already holds a
+    checkpoint and ``resume`` is ``"auto"`` (the default), training
+    restores it — verifying a dataset fingerprint first — and continues
+    from the saved iteration; the resumed run is bit-identical to an
+    uninterrupted one.  Writes are atomic and rank-0-only; distributed
+    restores rendezvous on a mesh barrier.
+    """
     params = resolve_aliases(dict(params))
     if int(params.get("num_machines", 1)) > 1 and params.get("machines"):
         # must run before ANY jax computation initializes the local backend
@@ -69,6 +85,60 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set._handle = None  # rebuild with init score
 
     booster = Booster(params=params, train_set=train_set)
+
+    # ---- checkpoint/restore (lightgbm_tpu/checkpoint/) ----------------
+    def _opt(kwarg, key, default):
+        v = kwarg if kwarg is not None else params.get(key, default)
+        return default if v in (None, "") else v
+
+    ckpt_dir = _opt(checkpoint_dir, "checkpoint_dir", "") or None
+    manager = None
+    begin_iteration = 0
+    eval_history: List[List[tuple]] = []
+    ckpt_freq = 1
+    if ckpt_dir:
+        from .checkpoint import (CheckpointManager, capture_train_state,
+                                 restore_barrier, restore_train_state)
+        ckpt_freq = int(_opt(checkpoint_freq, "checkpoint_freq", -1))
+        if ckpt_freq <= 0:
+            ckpt_freq = 1
+        manager = CheckpointManager(
+            ckpt_dir, keep=int(_opt(keep_checkpoints, "keep_checkpoints", 3)))
+        res_mode = str(_opt(resume, "resume", "auto"))
+        if res_mode not in ("auto", "never"):
+            # a typo must not fall into the clear() branch and delete the
+            # interrupted run's checkpoints (Config validates the params
+            # path; the kwarg path lands here)
+            raise ValueError(f"resume={res_mode!r} must be 'auto' or "
+                             "'never'")
+        if res_mode == "auto":
+            state = manager.load_latest()
+            if state is not None:
+                # restore BEFORE valid sets attach: add_valid's catch-up
+                # then replays the restored trees into the valid scores
+                restore_train_state(booster, state)
+                begin_iteration = state.iteration
+                eval_history = [list(ev) for ev in state.eval_history]
+                log_info(f"resuming training from iteration "
+                         f"{begin_iteration} ({ckpt_dir})")
+                if begin_iteration > nbr:
+                    log_warning(
+                        f"checkpoint holds {begin_iteration} iterations "
+                        f"but num_boost_round={nbr}: returning the "
+                        f"{begin_iteration}-iteration model as-is — use "
+                        "resume=never (or a fresh checkpoint_dir) for a "
+                        "shorter run")
+            # every rank rendezvouses (fresh ranks at iteration 0): if
+            # checkpoint_dir is not actually shared storage, the ranks
+            # disagree and the barrier fails instead of silently training
+            # diverged models
+            restore_barrier(begin_iteration)
+        else:
+            # resume=never: stale higher-iteration checkpoints must not
+            # survive to poison a later resume=auto
+            manager.clear()
+    fault_armed = bool(os.environ.get("LGBM_TPU_FAULT_ITER"))
+
     for i, vs in enumerate(valid_sets or []):
         name = (valid_names[i] if valid_names and i < len(valid_names)
                 else f"valid_{i}")
@@ -83,8 +153,42 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     train_in_valid = any(vs is train_set for vs in (valid_sets or []))
 
+    if begin_iteration:
+        # replay the recorded eval history through the post-iteration
+        # callbacks so their closure state (early-stopping bests,
+        # record_evaluation dicts) is rebuilt exactly as it was when the
+        # checkpoint was written.  ONLY callbacks that declare
+        # replay_on_resume=True take part: side-effecting callbacks (e.g.
+        # checkpoint_callback writing model snapshots) must not re-run
+        # against the already-restored model.  Log output is silenced —
+        # these iterations already ran once.
+        replay_cbs = [cb for cb in cbs_after
+                      if getattr(cb, "replay_on_resume", False)]
+        from . import log as _log
+        prev_verbosity = _log._VERBOSITY
+        _log.set_verbosity(-10)
+        try:
+            for past_it, past_eval in enumerate(
+                    eval_history[:begin_iteration]):
+                env = CallbackEnv(
+                    model=booster, params=params, iteration=past_it,
+                    begin_iteration=0, end_iteration=nbr,
+                    evaluation_result_list=[tuple(x) for x in past_eval])
+                try:
+                    for cb in replay_cbs:
+                        cb(env)
+                except EarlyStopException:
+                    pass       # re-fires on the first live iteration
+        finally:
+            _log.set_verbosity(prev_verbosity)
+
     finished_early = False
-    for it in range(nbr):
+    evaluation_result_list = ([tuple(x) for x in eval_history[-1]]
+                              if eval_history else [])
+    for it in range(begin_iteration, nbr):
+        if fault_armed:
+            from .checkpoint.fault import maybe_inject_fault
+            maybe_inject_fault(it)
         env = CallbackEnv(model=booster, params=params, iteration=it,
                           begin_iteration=0, end_iteration=nbr,
                           evaluation_result_list=None)
@@ -108,8 +212,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
             finished_early = True
             break
+        if manager is not None:
+            # coerce to plain python types: feval results arrive as numpy
+            # scalars, which the checkpoint's json header cannot encode
+            eval_history.append([
+                (str(x[0]), str(x[1]), float(x[2]), bool(x[3]))
+                for x in evaluation_result_list])
+            if ((it + 1) % ckpt_freq == 0 or (it + 1) == nbr
+                    or should_stop) and manager.is_writer():
+                # rank-0-only: other ranks skip the capture too (it pulls
+                # the [K, N] score off device and flushes pending trees)
+                manager.save(capture_train_state(booster, eval_history),
+                             it + 1)
         if should_stop:
             break
+    if manager is not None:
+        booster._checkpoint_manager = manager
     if not finished_early:
         if evals_result:
             booster.best_iteration = booster.current_iteration()
@@ -195,6 +313,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """Cross-validation (reference engine.py:397 cv())."""
     params = resolve_aliases(dict(params))
+    if params.pop("checkpoint_dir", ""):
+        log_warning("checkpoint_dir is ignored in cv(): folds train on "
+                    "different row subsets and cannot share (or resume "
+                    "from) one checkpoint directory")
     if metrics is not None:
         params["metric"] = metrics
     if params.get("objective") in ("binary",) or stratified is True:

@@ -7,6 +7,11 @@ dispatch seam exists as a registry: local paths (with transparent .gz),
 fsspec/gcsfs/libhdfs bindings can register them without touching callers.
 ``hdfs://`` without a registered driver raises the same "no HDFS support"
 error the reference builds emit when compiled without USE_HDFS.
+
+Beyond open, the registry carries the directory-level operations the
+checkpoint subsystem needs for atomic tmp+rename writes and keep-last-N
+retention (``rename``/``remove``/``listdir``/``makedirs``): a registered
+scheme supplies whichever it supports and callers get a uniform surface.
 """
 
 from __future__ import annotations
@@ -14,18 +19,30 @@ from __future__ import annotations
 import gzip
 import io
 import os
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
-__all__ = ["open_readable", "open_writable", "register_scheme", "exists"]
+__all__ = ["open_readable", "open_writable", "register_scheme", "exists",
+           "rename", "remove", "listdir", "makedirs"]
 
-# scheme -> fn(path, mode) -> file object
-_SCHEMES: Dict[str, Callable] = {}
+# scheme -> {"open": fn(path, mode), "rename": fn(src, dst), ...}
+_SCHEMES: Dict[str, Dict[str, Callable]] = {}
 
 
-def register_scheme(scheme: str, opener: Callable) -> None:
-    """Register an opener for ``scheme://`` paths (reference: the HDFS
-    driver registers itself the same way when libhdfs is found)."""
-    _SCHEMES[scheme.lower()] = opener
+def register_scheme(scheme: str, opener: Callable,
+                    rename: Optional[Callable] = None,
+                    remove: Optional[Callable] = None,
+                    listdir: Optional[Callable] = None,
+                    makedirs: Optional[Callable] = None,
+                    exists: Optional[Callable] = None) -> None:
+    """Register an opener (and optional fs ops) for ``scheme://`` paths
+    (reference: the HDFS driver registers itself the same way when libhdfs
+    is found).  ``opener(path, mode)`` must return a file object; the
+    optional ops take full ``scheme://`` paths.  A scheme registered
+    without ``rename`` cannot host checkpoints (atomic writes need it)."""
+    _SCHEMES[scheme.lower()] = {
+        "open": opener, "rename": rename, "remove": remove,
+        "listdir": listdir, "makedirs": makedirs, "exists": exists,
+    }
 
 
 def _split_scheme(path: str):
@@ -33,6 +50,22 @@ def _split_scheme(path: str):
         scheme, rest = path.split("://", 1)
         return scheme.lower(), rest
     return None, path
+
+
+def _scheme_op(scheme: str, op: str) -> Callable:
+    entry = _SCHEMES.get(scheme)
+    if entry is None:
+        raise OSError(
+            f"no driver registered for {scheme}:// paths "
+            "(reference file_io.cpp: HDFS support requires the hdfs "
+            "driver; register one with "
+            "lightgbm_tpu.io.file_io.register_scheme)")
+    fn = entry.get(op)
+    if fn is None:
+        raise OSError(
+            f"the registered {scheme}:// driver does not support {op!r} "
+            "(register_scheme accepts it as a keyword argument)")
+    return fn
 
 
 def _open(path: str, mode: str):
@@ -44,14 +77,7 @@ def _open(path: str, mode: str):
             return io.TextIOWrapper(gzip.open(local, mode.replace("t", "") + "b")) \
                 if "b" not in mode else gzip.open(local, mode)
         return open(local, mode)
-    opener = _SCHEMES.get(scheme)
-    if opener is None:
-        raise OSError(
-            f"no driver registered for {scheme}:// paths "
-            "(reference file_io.cpp: HDFS support requires the hdfs "
-            "driver; register one with "
-            "lightgbm_tpu.io.file_io.register_scheme)")
-    return opener(path, mode)
+    return _scheme_op(scheme, "open")(path, mode)
 
 
 def open_readable(path: str, binary: bool = False):
@@ -66,8 +92,52 @@ def exists(path: str) -> bool:
     scheme, rest = _split_scheme(path)
     if scheme in (None, "file"):
         return os.path.exists(rest if scheme == "file" else path)
+    entry = _SCHEMES.get(scheme)
+    if entry is not None and entry.get("exists") is not None:
+        return bool(entry["exists"](path))
     try:
         with _open(path, "r"):
             return True
     except OSError:
         return False
+
+
+def rename(src: str, dst: str) -> None:
+    """Atomic replace where the backend supports it (os.replace for local
+    paths) — the commit step of every checkpoint write."""
+    scheme, rest = _split_scheme(src)
+    dscheme, drest = _split_scheme(dst)
+    local_src = scheme in (None, "file")
+    local_dst = dscheme in (None, "file")
+    if local_src and local_dst:       # file:// and bare paths: same backend
+        os.replace(rest if scheme == "file" else src,
+                   drest if dscheme == "file" else dst)
+        return
+    if scheme != dscheme:
+        raise OSError(f"cannot rename across schemes: {src} -> {dst}")
+    _scheme_op(scheme, "rename")(src, dst)
+
+
+def remove(path: str) -> None:
+    scheme, rest = _split_scheme(path)
+    if scheme in (None, "file"):
+        os.remove(rest if scheme == "file" else path)
+        return
+    _scheme_op(scheme, "remove")(path)
+
+
+def listdir(path: str) -> list:
+    """Names (not full paths) of a directory's entries."""
+    scheme, rest = _split_scheme(path)
+    if scheme in (None, "file"):
+        return os.listdir(rest if scheme == "file" else path)
+    return list(_scheme_op(scheme, "listdir")(path))
+
+
+def makedirs(path: str) -> None:
+    """mkdir -p; idempotent."""
+    scheme, rest = _split_scheme(path)
+    if scheme in (None, "file"):
+        os.makedirs(rest if scheme == "file" else path, exist_ok=True)
+        return
+    _scheme_op(scheme, "makedirs")(path)

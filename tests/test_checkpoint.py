@@ -1,0 +1,439 @@
+"""Fault-tolerant training: checkpoint/restore subsystem
+(lightgbm_tpu/checkpoint/).
+
+Core property under test: kill-at-iteration-k (LGBM_TPU_FAULT_ITER)
+followed by auto-resume produces a model BIT-IDENTICAL to the
+uninterrupted run — across plain, bagging, GOSS and DART modes, with
+early-stopping state surviving the round-trip.  Plus the manager
+mechanics: atomic tmp+rename writes, manifest + latest() discovery,
+keep-last-N retention, and the dataset-fingerprint guard on restore.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.checkpoint import (CheckpointManager, InjectedWorkerFault,
+                                     TrainState, capture_train_state,
+                                     dataset_fingerprint)
+from lightgbm_tpu.log import LightGBMError
+
+N_ROWS, N_FEATS = 500, 8
+
+
+def _data(seed=0, n=N_ROWS):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, N_FEATS)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+MODE_PARAMS = {
+    "plain": {},
+    "bagging": {"bagging_freq": 2, "bagging_fraction": 0.7},
+    "goss": {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2,
+             "learning_rate": 0.3},
+    "dart": {"boosting": "dart", "drop_rate": 0.3},
+}
+
+
+def _params(mode="plain", **over):
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5}
+    p.update(MODE_PARAMS[mode])
+    p.update(over)
+    return p
+
+
+def _train(params, n, X, y, ckpt=None, **kw):
+    ds = lgb.Dataset(X, y)
+    if ckpt:
+        kw["checkpoint_dir"] = ckpt
+    return lgb.train(dict(params), ds, num_boost_round=n, **kw)
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["plain", "bagging", "goss", "dart"])
+def test_kill_and_resume_bit_identical(mode, tmp_path, monkeypatch):
+    """LGBM_TPU_FAULT_ITER kills the run mid-training (raise mode keeps
+    it in-process); rerunning with the same checkpoint_dir auto-resumes
+    and the final model is bit-identical to an uninterrupted run.  The
+    kill lands at iteration 5 — ODD, so the bagging mode resumes
+    mid-bagging-cycle and must regenerate the cycle's mask."""
+    X, y = _data()
+    full = _train(_params(mode), 9, X, y)
+    d = str(tmp_path / "ckpts")
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "5")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        _train(_params(mode), 9, X, y, ckpt=d)
+    monkeypatch.delenv("LGBM_TPU_FAULT_ITER")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+    resumed = _train(_params(mode), 9, X, y, ckpt=d)
+    assert resumed.num_trees() == full.num_trees()
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+@pytest.mark.slow
+def test_fault_injection_kills_real_process(tmp_path):
+    """Default fault mode is a hard os._exit (no cleanup), like a real
+    preemption; the orphaned checkpoint directory then feeds an
+    auto-resume that matches the uninterrupted run bit-for-bit.
+
+    Slow: cold-start subprocess (fresh jax import).  The tier-1
+    kill+resume coverage is the in-process raise-mode matrix above; the
+    multi-process os._exit path also runs in tests/test_cluster.py."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    data_npz = str(tmp_path / "data.npz")
+    np.savez(data_npz, X=X, y=y)
+    script = (
+        "import numpy as np, lightgbm_tpu as lgb\n"
+        f"d = np.load({data_npz!r})\n"
+        f"lgb.train({_params('plain')!r}, lgb.Dataset(d['X'], d['y']),\n"
+        f"          num_boost_round=8, checkpoint_dir={d!r})\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LGBM_TPU_FAULT_ITER="4")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 43, proc.stderr[-2000:]
+    assert any(f.endswith(".lgbckpt") for f in os.listdir(d))
+    # resume in-process from the dead process's checkpoints
+    resumed = _train(_params("plain"), 8, X, y, ckpt=d)
+    full = _train(_params("plain"), 8, X, y)
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+def test_resume_is_idempotent_after_completion(tmp_path):
+    """A finished run leaves a final checkpoint; rerunning the same
+    command is a no-op returning the same model (supervisors can blindly
+    relaunch)."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    first = _train(_params(), 5, X, y, ckpt=d)
+    again = _train(_params(), 5, X, y, ckpt=d)
+    assert again.num_trees() == 5
+    assert again.model_to_string() == first.model_to_string()
+
+
+def test_resume_never_ignores_checkpoints(tmp_path):
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    _train(_params(), 8, X, y, ckpt=d)
+    fresh = _train(_params(), 6, X, y, ckpt=d, resume="never")
+    assert fresh.num_trees() == 6
+    assert fresh.model_to_string() == _train(_params(), 6, X, y) \
+        .model_to_string()
+    # never also CLEARED the stale iteration-8 checkpoint: a later
+    # resume=auto must see this run's final state, not the old run's
+    assert [it for it, _ in fresh._checkpoint_manager.checkpoints()][-1] == 6
+
+
+# ----------------------------------------------------------------------
+def test_early_stopping_state_roundtrip(tmp_path, monkeypatch):
+    """best_iteration/best score survive save->restore, the resumed run
+    stops at the SAME iteration as the uninterrupted one, and the
+    recorded eval history matches."""
+    X, y = _data()
+    Xv, yv = _data(seed=1, n=200)
+
+    def run(ckpt=None, fault=None):
+        if fault is not None:
+            monkeypatch.setenv("LGBM_TPU_FAULT_ITER", str(fault))
+            monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+        ds = lgb.Dataset(X, y)
+        res = {}
+        try:
+            bst = lgb.train(_params(metric="auc"), ds, num_boost_round=40,
+                            valid_sets=[lgb.Dataset(Xv, yv, reference=ds)],
+                            evals_result=res, early_stopping_rounds=5,
+                            checkpoint_dir=ckpt)
+        finally:
+            monkeypatch.delenv("LGBM_TPU_FAULT_ITER", raising=False)
+            monkeypatch.delenv("LGBM_TPU_FAULT_MODE", raising=False)
+        return bst, res
+
+    full, res_full = run()
+    assert 0 < full.best_iteration < 40   # early stopping actually fired
+    d = str(tmp_path / "ckpts")
+    with pytest.raises(InjectedWorkerFault):
+        run(ckpt=d, fault=8)
+    resumed, res_resumed = run(ckpt=d)
+    assert resumed.best_iteration == full.best_iteration
+    assert resumed.best_score == full.best_score
+    assert resumed.num_trees() == full.num_trees()
+    assert res_resumed == res_full
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+def test_fingerprint_mismatch_refused(tmp_path):
+    """Restoring against a different dataset is a hard, clear error —
+    not a silent corruption."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    _train(_params(), 3, X, y, ckpt=d)
+    X2, y2 = _data(seed=7)           # same shape, different values
+    with pytest.raises(LightGBMError, match="fingerprint mismatch"):
+        _train(_params(), 6, X2, y2, ckpt=d)
+    X3, y3 = _data(n=300)            # different shape
+    with pytest.raises(LightGBMError, match="fingerprint mismatch"):
+        _train(_params(), 6, X3, y3, ckpt=d)
+    # same FEATURES (bins identically) but different labels: resuming
+    # would boost against the wrong objective — must also be refused
+    with pytest.raises(LightGBMError, match="fingerprint mismatch"):
+        _train(_params(), 6, X, 1.0 - y, ckpt=d)
+
+
+def test_boosting_mode_mismatch_refused(tmp_path):
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    _train(_params("plain"), 3, X, y, ckpt=d)
+    with pytest.raises(LightGBMError, match="boosting"):
+        _train(_params("dart"), 6, X, y, ckpt=d)
+
+
+# ----------------------------------------------------------------------
+def test_manager_atomicity_retention_latest(tmp_path):
+    """checkpoint_freq + keep_checkpoints: only the newest N committed
+    files remain, no .tmp leftovers, manifest present, latest() loads."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    _train(_params(), 7, X, y, ckpt=d, checkpoint_freq=2,
+           keep_checkpoints=2)
+    names = sorted(os.listdir(d))
+    assert not any(n.endswith(".tmp") for n in names)
+    ckpts = [n for n in names if n.endswith(".lgbckpt")]
+    assert len(ckpts) == 2
+    assert "MANIFEST.json" in names
+    mgr = CheckpointManager(d, keep=2)
+    # freq=2 saves at 2,4,6 plus the final iteration 7; keep-last-2
+    assert [it for it, _ in mgr.checkpoints()] == [6, 7]
+    state = mgr.load()
+    assert isinstance(state, TrainState)
+    assert state.iteration == 7
+    assert len(state.trees) == 7
+    # round-trip through bytes is exact
+    clone = TrainState.from_bytes(state.to_bytes())
+    assert clone.iteration == state.iteration
+    assert np.array_equal(clone.train_score, state.train_score)
+    assert clone.fingerprint == state.fingerprint
+
+
+def test_rank0_only_writes(tmp_path, monkeypatch):
+    """Non-zero ranks must not write: save() is a silent no-op there."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    bst = _train(_params(), 3, X, y, ckpt=d)
+    mgr = bst._checkpoint_manager
+    state = capture_train_state(bst)
+    # is_writer() resolves comm_rank at call time, so patching the mesh
+    # module simulates a non-zero rank
+    import lightgbm_tpu.parallel.mesh as mesh
+    monkeypatch.setattr(mesh, "comm_rank", lambda: 1)
+    before = sorted(os.listdir(d))
+    assert mgr.save(state, 99) is None
+    assert sorted(os.listdir(d)) == before
+
+
+def test_checkpoint_callback_atomic_snapshots(tmp_path):
+    """Satellite: snapshot_freq promoted to a public engine-level
+    callback with atomic writes (no .tmp visible, loadable model)."""
+    X, y = _data()
+    out = str(tmp_path / "model.txt")
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=6,
+                    callbacks=[lgb.checkpoint_callback(2, out)])
+    snaps = sorted(p for p in os.listdir(tmp_path)
+                   if ".snapshot_iter_" in p)
+    assert snaps == ["model.txt.snapshot_iter_2", "model.txt.snapshot_iter_4",
+                     "model.txt.snapshot_iter_6"]
+    assert not any(p.endswith(".tmp") for p in os.listdir(tmp_path))
+    snap = lgb.Booster(model_file=str(tmp_path / snaps[1]))
+    assert snap.num_trees() == 4
+    # loaded snapshots predict through the host float64 traversal, the
+    # live booster through the f32 device path — equal up to f32 rounding
+    np.testing.assert_allclose(
+        snap.predict(X), bst.predict(X, num_iteration=4), rtol=1e-5)
+
+
+def test_cli_resume_auto(tmp_path, monkeypatch):
+    """CLI surface: task=train with checkpoint_dir auto-resumes after a
+    kill (resume=auto is the default)."""
+    from lightgbm_tpu.application import Application
+    X, y = _data()
+    csv = str(tmp_path / "train.csv")
+    np.savetxt(csv, np.column_stack([y, X]), delimiter=",", fmt="%.10g")
+    d = str(tmp_path / "ckpts")
+    model = str(tmp_path / "model.txt")
+    args = [f"data={csv}", f"output_model={model}", "objective=binary",
+            "num_trees=6", "num_leaves=7", "min_data_in_leaf=5",
+            "verbosity=-1", f"checkpoint_dir={d}"]
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "3")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        Application(args).run()
+    monkeypatch.delenv("LGBM_TPU_FAULT_ITER")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+    Application(args).run()                      # resumes, finishes, saves
+    resumed = lgb.Booster(model_file=model)
+    full = Application(args[:-1] + ["output_model=" + str(
+        tmp_path / "full.txt"), f"checkpoint_dir={tmp_path / 'ckpts2'}"])
+    full.run()
+    assert resumed.num_trees() == 6
+    assert (resumed.model_to_string()
+            == lgb.Booster(model_file=str(tmp_path / "full.txt"))
+            .model_to_string())
+
+
+# ----------------------------------------------------------------------
+def test_dart_drop_rng_is_iteration_derived(tmp_path):
+    """Regression (satellite): DART's drop decisions are a pure function
+    of (drop_seed, iteration) — poisoning the RandomState mid-run must
+    not change the model, so a resumed run redraws identical drop sets."""
+    X, y = _data()
+    clean = _train(_params("dart"), 8, X, y)
+
+    def poison(env):
+        env.model._gbdt._drop_rng = np.random.RandomState(999999)
+    poison.before_iteration = True
+    poisoned = lgb.train(_params("dart"), lgb.Dataset(X, y),
+                         num_boost_round=8, callbacks=[poison])
+    assert poisoned.model_to_string() == clean.model_to_string()
+
+
+def test_bagging_mask_midcycle_regeneration():
+    """Regression: a mid-cycle bagging mask regenerates bit-identically
+    from (bagging_seed, refresh iteration) with no cached state."""
+    X, y = _data()
+    p = _params("bagging")
+    b1 = lgb.train(p, lgb.Dataset(X, y), num_boost_round=4)
+    b2 = lgb.train(p, lgb.Dataset(X, y), num_boost_round=1)
+    g1, g2 = b1._gbdt, b2._gbdt
+    # iteration 3 is mid-cycle (freq=2): g1 cached the mask at iteration
+    # 2, g2 never saw iteration 2 at all — both must produce the same mask
+    m1 = np.asarray(g1._bagging_mask(3))
+    g2._last_mask_iter = None
+    m2 = np.asarray(g2._bagging_mask(3))
+    assert np.array_equal(m1, m2)
+
+
+def test_fingerprint_sensitivity():
+    X, y = _data()
+    ds1 = lgb.Dataset(X, y).construct()
+    ds2 = lgb.Dataset(X, y).construct()
+    assert dataset_fingerprint(ds1._handle) == dataset_fingerprint(ds2._handle)
+    X3 = X.copy()
+    X3[:, 0] *= 2.0
+    ds3 = lgb.Dataset(X3, y).construct()
+    assert (dataset_fingerprint(ds1._handle)["mappers_sha256"]
+            != dataset_fingerprint(ds3._handle)["mappers_sha256"])
+
+
+# ----------------------------------------------------------------------
+def test_checkpoint_overhead_under_10pct(tmp_path):
+    """Satellite: checkpointing every iteration adds <10% wall time on
+    the small synthetic config.  Both runs are hot (programs compiled by
+    a warmup), and a small absolute slack absorbs CI scheduler jitter."""
+    rng = np.random.RandomState(0)
+    n = 6_000
+    X = rng.randn(n, 10).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.randn(n) * 0.5 > 0) \
+        .astype(np.float32)
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "min_data_in_leaf": 20}
+    n_iter = 8
+    ds = lgb.Dataset(X, y)
+    lgb.train(p, ds, num_boost_round=2)          # warmup compile
+
+    def timed_run(**kw):
+        t0 = time.perf_counter()
+        bst = lgb.train(p, ds, num_boost_round=n_iter, **kw)
+        bst.num_trees()      # flush the lazy pipeline: count ALL the work
+        return time.perf_counter() - t0
+
+    # interleave plain/checkpointed samples so background-load drift hits
+    # both alike; best-of-3 discards scheduler hiccups
+    plain_s, ckpt_s = float("inf"), float("inf")
+    for i in range(3):
+        plain_s = min(plain_s, timed_run())
+        ckpt_s = min(ckpt_s, timed_run(
+            checkpoint_dir=str(tmp_path / f"ck_{i}"),
+            checkpoint_freq=1, keep_checkpoints=2))
+    assert ckpt_s <= plain_s * 1.10 + 0.35, (
+        f"checkpointing every iteration cost {ckpt_s:.3f}s vs plain "
+        f"{plain_s:.3f}s (> 10% + slack)")
+
+
+def test_checkpoint_with_custom_feval(tmp_path, monkeypatch):
+    """feval results arrive as numpy scalars; recording them into the
+    checkpoint's eval history must not break the json header, and the
+    replayed history must match the uninterrupted run's."""
+    X, y = _data()
+    Xv, yv = _data(seed=1, n=200)
+
+    def feval(preds, data):
+        return "np_mae", np.mean(np.abs(data.get_label() - preds)), np.bool_(False)
+
+    def run(ckpt=None, fault=None):
+        if fault is not None:
+            monkeypatch.setenv("LGBM_TPU_FAULT_ITER", str(fault))
+            monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+        ds = lgb.Dataset(X, y)
+        res = {}
+        try:
+            bst = lgb.train(_params(), ds, num_boost_round=6,
+                            valid_sets=[lgb.Dataset(Xv, yv, reference=ds)],
+                            feval=feval, evals_result=res,
+                            checkpoint_dir=ckpt)
+        finally:
+            monkeypatch.delenv("LGBM_TPU_FAULT_ITER", raising=False)
+            monkeypatch.delenv("LGBM_TPU_FAULT_MODE", raising=False)
+        return bst, res
+
+    full, res_full = run()
+    d = str(tmp_path / "ckpts")
+    with pytest.raises(InjectedWorkerFault):
+        run(ckpt=d, fault=4)
+    resumed, res_resumed = run(ckpt=d)
+    assert resumed.model_to_string() == full.model_to_string()
+    np.testing.assert_allclose(res_resumed["valid_0"]["np_mae"],
+                               res_full["valid_0"]["np_mae"], rtol=1e-12)
+
+
+def test_resume_typo_raises_instead_of_clearing(tmp_path):
+    """A resume value that is neither auto nor never must hard-error —
+    falling through to the clear() branch would delete the interrupted
+    run's checkpoints on a typo."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    _train(_params(), 3, X, y, ckpt=d)
+    with pytest.raises(ValueError, match="resume="):
+        _train(_params(), 3, X, y, ckpt=d, resume="always")
+    assert any(f.endswith(".lgbckpt") for f in os.listdir(d))  # untouched
+
+
+def test_replay_skips_side_effecting_callbacks(tmp_path, monkeypatch):
+    """Resume replay re-drives only replay_on_resume callbacks: a
+    checkpoint_callback must not rewrite historical snapshots with the
+    restored (later-iteration) model."""
+    X, y = _data()
+    d = str(tmp_path / "ckpts")
+    out = str(tmp_path / "m.txt")
+    cbs = [lgb.checkpoint_callback(1, out)]
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "4")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        _train(_params(), 6, X, y, ckpt=d, callbacks=cbs)
+    monkeypatch.delenv("LGBM_TPU_FAULT_ITER")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+    _train(_params(), 6, X, y, ckpt=d, callbacks=cbs)
+    # snapshot_iter_2 still holds the 2-tree model from before the crash,
+    # not a rewrite of the restored 4..6-tree model
+    snap2 = lgb.Booster(model_file=out + ".snapshot_iter_2")
+    assert snap2.num_trees() == 2
+    assert lgb.Booster(model_file=out + ".snapshot_iter_6").num_trees() == 6

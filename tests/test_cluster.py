@@ -35,6 +35,59 @@ def test_find_open_ports_distinct():
 
 
 @pytest.mark.slow
+def test_train_distributed_restart_after_kill(monkeypatch):
+    """Supervised restart (SURVEY §5 checkpoint-restart): LGBM_TPU_FAULT_ITER
+    hard-kills rank 1 at iteration 2; the supervisor must kill the
+    survivor, relaunch from the latest checkpoint, and the final model
+    must be bit-identical to an uninterrupted run.
+
+    tree_learner=serial keeps the test independent of the data-parallel
+    learner (whose shard_map call currently trips the environment's jax
+    check_vma API drift — a pre-existing issue unrelated to restart)."""
+    from lightgbm_tpu.cluster import train_distributed
+
+    def make_data(rank, num_workers):
+        rng = np.random.RandomState(0)
+        X = rng.randn(2000, 5)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        return X, y, None
+
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20, "tree_learner": "serial"}
+    ref = train_distributed(dict(params), make_data, num_boost_round=5,
+                            num_workers=2, platform="cpu", timeout=600)
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "2")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    params.update(max_restarts=2, restart_backoff_s=0.1)
+    bst = train_distributed(params, make_data, num_boost_round=5,
+                            num_workers=2, platform="cpu", timeout=600)
+    assert bst.num_trees() == 5
+    assert bst.model_to_string() == ref.model_to_string()
+
+
+@pytest.mark.slow
+def test_train_distributed_restart_budget_exhausted(monkeypatch):
+    """max_restarts=0: a worker death fails the job with the worker's
+    log tail in the error (the reference's fail-fast behavior)."""
+    from lightgbm_tpu.cluster import train_distributed
+
+    def make_data(rank, num_workers):
+        rng = np.random.RandomState(0)
+        X = rng.randn(1000, 5)
+        y = (X[:, 0] > 0).astype(np.float32)
+        return X, y, None
+
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "0")
+    with pytest.raises(RuntimeError, match="restart budget"):
+        train_distributed(
+            {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+             "tree_learner": "serial", "max_restarts": 0},
+            make_data, num_boost_round=3, num_workers=2, platform="cpu",
+            timeout=600)
+
+
+@pytest.mark.slow
 def test_train_distributed_pre_partitioned():
     """Dask-style data partitioning (reference _split_to_parts,
     dask.py:341): each worker's data_fn returns ONLY its shard, the model
