@@ -41,7 +41,10 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  `checkpoint_s`/`checkpoint_frac` — wall overhead of a
                  3-iter checkpoint_freq=1 run vs the plain hot probe
                  (fault-tolerance subsystem cost, measured outside the
-                 headline).
+                 headline) — and `telemetry`: the per-iteration phase
+                 breakdown (hist_s/split_s/partition_s/comm_s/checkpoint_s
+                 means) from a 3-iter telemetry=on probe, also outside the
+                 headline (telemetry unfuses the train step by design).
 - serve          serving throughput/latency through lightgbm_tpu/serving/:
                  sustained rows/s, p50/p99 latency, batch-fill ratio, and a
                  steady-state compile count (run_serving).  Tuning knobs:
@@ -196,6 +199,34 @@ def run_training():
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
+    # telemetry probe (unified telemetry subsystem): rerun the 3-iter hot
+    # probe with telemetry=on (+ checkpoint_freq=1 so checkpoint_s is a
+    # real number) and attach the mean per-iteration phase breakdown.
+    # Measured OUTSIDE the headline: telemetry=on unfuses the train step
+    # by design, so its numbers attribute, they don't race.
+    telemetry = {}
+    ckpt_dir2 = tempfile.mkdtemp(prefix="lgbm_bench_tele_")
+    try:
+        tp = dict(params)
+        tp["telemetry"] = True
+        bst_tp = lgb.train(tp, train_set, num_boost_round=3,
+                           checkpoint_dir=ckpt_dir2, checkpoint_freq=1)
+        summ = bst_tp.telemetry_summary() or {}
+        telemetry = {
+            "iterations": summ.get("iterations", 0),
+            "per_iteration": {
+                k: (round(summ[k], 5)
+                    if isinstance(summ.get(k), (int, float)) else None)
+                for k in ("iter_s", "grad_s", "grow_s", "hist_s",
+                          "split_s", "partition_s", "comm_s", "apply_s",
+                          "checkpoint_s")},
+            "compile_count": summ.get("compile_count", 0),
+        }
+    except Exception as exc:
+        telemetry = {"error": repr(exc)[-200:]}   # honest failure marker
+    finally:
+        shutil.rmtree(ckpt_dir2, ignore_errors=True)
+
     ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
     our_work = rows * iters
     ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
@@ -211,6 +242,7 @@ def run_training():
         "setup_breakdown": setup_breakdown,
         "checkpoint_s": round(checkpoint_s, 4),
         "checkpoint_frac": round(checkpoint_frac, 4),
+        "telemetry": telemetry,
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,

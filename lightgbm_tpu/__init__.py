@@ -21,25 +21,31 @@ if _os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "1") != "0":
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # admit sub-second programs too: a boosting run (and every CLI /
+        # cluster-worker subprocess) compiles dozens of medium programs
+        # whose compile times individually sit under 1s but sum to the
+        # bulk of setup time — same rationale as compile_cache.py
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # cache is best-effort; never block startup
         pass
 
 from .basic import Booster, Dataset, Sequence
 from .callback import (checkpoint_callback, early_stopping, log_evaluation,
-                       print_evaluation, record_evaluation, reset_parameter)
+                       print_evaluation, record_evaluation,
+                       record_telemetry, reset_parameter)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .log import LightGBMError, register_log_callback
+from . import telemetry
 
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
            "Config", "LightGBMError", "register_log_callback",
            "early_stopping", "log_evaluation", "print_evaluation",
-           "record_evaluation", "reset_parameter", "checkpoint_callback",
-           "__version__"]
+           "record_evaluation", "record_telemetry", "reset_parameter",
+           "checkpoint_callback", "telemetry", "__version__"]
 
 
 def __getattr__(name):

@@ -56,6 +56,10 @@ class Application:
     def __init__(self, argv: List[str]):
         self.raw_params = _parse_args(argv)
         self.config = Config(self.raw_params)
+        # every CLI task honors verbosity, not just the paths that later
+        # build a Booster (which re-applies it)
+        from .log import set_verbosity
+        set_verbosity(self.config.verbosity)
 
     # ------------------------------------------------------------------
     def run(self) -> None:

@@ -698,6 +698,21 @@ class Booster:
         return self._gbdt.num_class if self._gbdt else int(
             self._loaded_meta.get("num_tree_per_iteration", 1))
 
+    def telemetry_stats(self, start: int = 0) -> Optional[List[Dict]]:
+        """Per-iteration training stats (telemetry/training.py records) or
+        None when the booster trained with ``telemetry=off``.  ``start``
+        skips already-consumed records so streaming consumers (the
+        record_telemetry callback) stay O(new), not O(all), per call."""
+        tele = getattr(self._gbdt, "telemetry", None) if self._gbdt else None
+        if tele is None:
+            return None
+        return [dict(r) for r in tele.records[start:]]
+
+    def telemetry_summary(self) -> Optional[Dict]:
+        """Aggregated view of telemetry_stats(), or None when off."""
+        tele = getattr(self._gbdt, "telemetry", None) if self._gbdt else None
+        return tele.summary() if tele is not None else None
+
     def eval_valid(self, feval=None) -> List[tuple]:
         return [t for name in self._valid_names
                 for t in self._eval_set(name, feval)]

@@ -10,6 +10,7 @@ keeps the reference format (gbdt_model_text.cpp:311 SaveModelToString).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -47,6 +48,11 @@ class GBDT:
         self.iter_ = 0
         self.best_iteration = -1
         self.average_output = False    # RF sets True (reference rf.hpp:27)
+
+        # per-iteration telemetry (telemetry/training.py); None when
+        # telemetry=off, so the hot path pays one attribute check
+        from ..telemetry.training import maybe_training_telemetry
+        self.telemetry = maybe_training_telemetry(config)
 
         objective.init(train_data.metadata, train_data.num_data)
         self.tree_learner = self._create_tree_learner(config, train_data)
@@ -216,6 +222,9 @@ class GBDT:
     def _can_fuse(self) -> bool:
         from ..tree_learner import SerialTreeLearner
         return (self._fusable
+                # per-stage attribution needs the host boundaries the
+                # fused step removes — telemetry=on opts out of fusing
+                and self.telemetry is None
                 and type(self)._grow_and_apply is GBDT._grow_and_apply
                 and self.num_class == 1
                 and not self.objective.need_renew_tree_output
@@ -315,6 +324,7 @@ class GBDT:
         """Train one boosting iteration (reference GBDT::TrainOneIter,
         gbdt.cpp:369).  Returns True if training should stop (no splits)."""
         k = self.num_class
+        tele = self.telemetry
         init_scores = [0.0] * k
         if grad is None or hess is None:
             if self._can_fuse():
@@ -322,14 +332,24 @@ class GBDT:
             self._flush_pending()
             for cls in range(k):
                 init_scores[cls] = self._boost_from_average(cls)
+            if tele:
+                tele.start_iteration(self.iter_)
+                t0 = time.perf_counter()
             grad, hess = self._get_gradients()
+            if tele:
+                jax.block_until_ready((grad, hess))
+                tele.add("grad_s", time.perf_counter() - t0)
         else:
+            if tele:
+                tele.start_iteration(self.iter_)
             grad = jnp.asarray(np.asarray(grad, np.float32).reshape(k, -1))
             hess = jnp.asarray(np.asarray(hess, np.float32).reshape(k, -1))
 
         grad, hess, mask = self._adjust_gradients(grad, hess)
         stop = self._grow_and_apply(grad, hess, mask, init_scores)
         self.iter_ += 1
+        if tele:
+            tele.finish_iteration()
         return stop
 
     def _adjust_gradients(self, grad, hess):
@@ -379,6 +399,7 @@ class GBDT:
 
     def _grow_and_apply(self, grad, hess, mask, init_scores) -> bool:
         obj = self.objective
+        tele = self.telemetry
         any_split = False
         for cls in range(self.num_class):
             # recomputed per class: a feature used by class k's tree is
@@ -386,13 +407,27 @@ class GBDT:
             # checks the live feature_used state)
             cegb_pen = self._cegb_penalty()
             with timed("tree_learner_train"):
+                t0 = time.perf_counter() if tele else 0.0
                 state = self.tree_learner.train(grad[cls], hess[cls], mask,
                                                 self.iter_,
                                                 gain_penalty=cegb_pen)
+                if tele:
+                    jax.block_until_ready(state.n_leaves)
+                    tele.add("grow_s", time.perf_counter() - t0)
+            if tele:
+                # staged re-grow of the same inputs for the per-phase
+                # hist/split/partition decomposition (tree discarded)
+                tele.probe(self.tree_learner, grad[cls], hess[cls], mask)
             with timed("state_to_tree"):
+                t0 = time.perf_counter() if tele else 0.0
                 tree = state_to_tree(state,
                                      self.train_data.feature_mappers,
                                      self.train_data.real_feature_index)
+                if tele:
+                    tele.add("apply_s", time.perf_counter() - t0)
+                    # measured collective probe scaled by this tree's
+                    # histogram-reduction count (root + one per split)
+                    tele.comm(self.tree_learner, tree.num_leaves)
             self._cegb_mark_used(tree)
             row_out = None
             if (self.config.linear_tree and tree.num_leaves > 1
@@ -445,6 +480,8 @@ class GBDT:
     def _update_scores(self, cls: int, tree: Tree, state, row_out=None):
         # train: fast path via row->leaf vector (reference ScoreUpdater
         # AddScore(tree, data_partition), score_updater.hpp)
+        tele = self.telemetry
+        t0 = time.perf_counter() if tele else 0.0
         leaf_vals = jnp.asarray(tree.leaf_value[:self._L], jnp.float32)
         if tree.num_leaves > 1:
             if row_out is not None:
@@ -460,6 +497,9 @@ class GBDT:
             self.valid_scores[i] = self._add_tree_to_score(
                 self.valid_scores[i], cls, tree, valid.device_bins, state,
                 raw=getattr(valid, "raw", None))
+        if tele:
+            jax.block_until_ready(self.train_score)
+            tele.add("apply_s", time.perf_counter() - t0)
 
     def _add_tree_to_score(self, score, cls, tree: Tree, bins, state=None,
                            raw=None):

@@ -8,8 +8,8 @@ from typing import Callable, Dict, List
 from .log import log_info, log_warning
 
 __all__ = ["EarlyStopException", "CallbackEnv", "print_evaluation",
-           "log_evaluation", "record_evaluation", "reset_parameter",
-           "early_stopping", "checkpoint_callback"]
+           "log_evaluation", "record_evaluation", "record_telemetry",
+           "reset_parameter", "early_stopping", "checkpoint_callback"]
 
 
 class EarlyStopException(Exception):
@@ -70,6 +70,32 @@ def record_evaluation(eval_result: Dict) -> Callable:
     # pure closure-state rebuild: safe (and necessary) to re-drive from the
     # recorded eval history when training resumes from a checkpoint
     _callback.replay_on_resume = True
+    return _callback
+
+
+def record_telemetry(result: Dict) -> Callable:
+    """Stream per-iteration telemetry records into ``result`` as training
+    runs (the telemetry analogue of record_evaluation): after each
+    iteration ``result["iterations"]`` holds every record so far and
+    ``result["summary"]`` the aggregate.  No-op (result stays empty) when
+    the booster trains with ``telemetry=off``.  Only NEW records are
+    copied per call (O(1) amortized, not O(iterations)); note the engine
+    attributes checkpoint save time to a record AFTER callbacks run, so
+    per-iteration ``checkpoint_s`` is authoritative in the JSONL log and
+    the end-of-train summary, not in this stream."""
+    if not isinstance(result, dict):
+        raise TypeError("result should be a dict")
+
+    def _callback(env: CallbackEnv) -> None:
+        seen = result.get("iterations")
+        fresh = env.model.telemetry_stats(start=len(seen or ()))
+        if fresh is None:
+            return
+        if seen is None:
+            seen = result["iterations"] = []
+        seen.extend(fresh)
+        result["summary"] = env.model.telemetry_summary()
+    _callback.order = 25
     return _callback
 
 

@@ -129,6 +129,12 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
 
     tmp = tempfile.mkdtemp(prefix="lgbm_tpu_cluster_")
     model_out = os.path.join(tmp, "model.txt")
+    from .config import coerce_bool
+    if coerce_bool(params.get("telemetry", False)) \
+            and not params.get("telemetry_dir"):
+        # per-rank JSONL event logs land next to the worker logs; the
+        # supervisor rolls them up into telemetry_summary.json on exit
+        params["telemetry_dir"] = os.path.join(tmp, "telemetry")
     if max_restarts > 0 and not params.get("checkpoint_dir"):
         # restarts without checkpoints would replay the whole run; give
         # the job a private checkpoint directory so resume is automatic.
@@ -181,6 +187,9 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
                     env.pop(var, None)
             log_path = os.path.join(tmp, f"worker_{rank}_a{attempt}.log")
             logs.append(log_path)
+            # rank-prefixed at spawn so failed-run triage never requires
+            # knowing the tmp layout
+            log_info(f"worker {rank} log: {log_path}")
             log_fh = open(log_path, "w")
             procs.append(subprocess.Popen(
                 [sys.executable, script], env=env,
@@ -231,10 +240,14 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
             if hung:
                 raise subprocess.TimeoutExpired(
                     cmd=f"{sys.executable} {script}", timeout=timeout)
+            log_list = "\n".join(f"  rank {r}: {p}"
+                                 for r, p in enumerate(logs))
             raise RuntimeError(
                 f"worker {failed_rank} failed (rc={rc}) and the restart "
                 f"budget is exhausted ({attempt}/{max_restarts} restarts "
-                f"used):\n{_tail(logs[failed_rank])}")
+                f"used); worker logs:\n{log_list}\n"
+                f"--- tail of rank {failed_rank} ---\n"
+                f"{_tail(logs[failed_rank])}")
         delay = backoff_s * (2.0 ** attempt)
         log_warning(
             f"worker {failed_rank} {why}; killed survivors, "
@@ -243,6 +256,21 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
         if delay > 0:
             time.sleep(delay)
         attempt += 1
+
+    tdir = params.get("telemetry_dir")
+    if tdir and os.path.isdir(tdir):
+        # job-level rollup of every rank's JSONL (records accumulate per
+        # rank across supervised restarts, so the summary covers them too)
+        try:
+            from .telemetry.export import rollup_telemetry_dir
+            summary = rollup_telemetry_dir(tdir)
+            if summary is not None:
+                log_info(
+                    f"telemetry rollup ({summary['ranks']} ranks, "
+                    f"{summary['total_iterations']} iterations): "
+                    f"{summary['path']}")
+        except Exception as exc:   # a rollup bug must not fail the job
+            log_warning(f"telemetry rollup failed: {exc!r}")
 
     from .basic import Booster
     return Booster(model_file=model_out)

@@ -12,7 +12,17 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Config", "ParamSpec", "param_docs", "resolve_aliases"]
+__all__ = ["Config", "ParamSpec", "coerce_bool", "param_docs",
+           "resolve_aliases"]
+
+
+def coerce_bool(value) -> bool:
+    """The config system's single bool-string coercion ("on"/"off"
+    accepted everywhere, e.g. telemetry=on); reused by callers that must
+    interpret raw params dicts before a Config exists (cluster)."""
+    if isinstance(value, str):
+        return value.lower() in ("true", "1", "yes", "+", "t", "on")
+    return bool(value)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +124,29 @@ _PARAMS: List[ParamSpec] = [
     _p("path_smooth", float, 0.0, (), ">=0"),
     _p("interaction_constraints", str, ""),
     _p("verbosity", int, 1, ("verbose",)),
+    # ---- Telemetry (lightgbm_tpu/telemetry/) ----
+    _p("telemetry", bool, False, (),
+       desc="enable the unified telemetry subsystem: phase spans + event "
+            "recording, per-iteration training stats (grad/grow/apply "
+            "actuals, staged-probe hist/split/partition decomposition, "
+            "collective probe, compile deltas) on Booster.telemetry_stats()."
+            " Disables the fused train step (attribution needs host "
+            "boundaries), so keep it off for peak throughput; "
+            "LIGHTGBM_TPU_TIMETAG=1 remains the env alias for the plain "
+            "phase timers"),
+    _p("telemetry_dir", str, "",
+       desc="directory for per-rank telemetry output: one "
+            "telemetry_rank<R>.jsonl event log (iteration stats + summary "
+            "+ spans) and a Chrome-trace span timeline per rank; "
+            "cluster.train_distributed auto-provisions it under the job "
+            "tmp and rolls the rank files up into telemetry_summary.json"),
+    _p("profile_dir", str, "",
+       desc="capture jax.profiler device traces (xprof/tensorboard) into "
+            "this directory around the iterations listed in "
+            "profile_iterations"),
+    _p("profile_iterations", list, None,
+       desc="iteration indices to device-trace into profile_dir "
+            "(default: [1] — the first post-compile iteration)"),
     _p("input_model", str, "", ("model_input", "model_in")),
     _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
     _p("convert_model", str, "gbdt_prediction.cpp",
@@ -265,9 +298,7 @@ def _coerce(spec: ParamSpec, value: Any) -> Any:
     if value is None:
         return None
     if spec.typ is bool:
-        if isinstance(value, str):
-            return value.lower() in ("true", "1", "yes", "+", "t")
-        return bool(value)
+        return coerce_bool(value)
     if spec.typ is int:
         return int(value)
     if spec.typ is float:

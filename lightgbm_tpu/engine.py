@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -44,6 +45,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     restores rendezvous on a mesh barrier.
     """
     params = resolve_aliases(dict(params))
+    from .log import apply_verbosity
+    apply_verbosity(params)
     if int(params.get("num_machines", 1)) > 1 and params.get("machines"):
         # must run before ANY jax computation initializes the local backend
         # (reference Network::Init happens first too, application.cpp:170)
@@ -85,6 +88,33 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set._handle = None  # rebuild with init score
 
     booster = Booster(params=params, train_set=train_set)
+
+    # ---- telemetry (lightgbm_tpu/telemetry/) --------------------------
+    tele = getattr(booster._gbdt, "telemetry", None)
+    run_cfg = booster._gbdt.config
+    profile_iters = set()
+    if getattr(run_cfg, "profile_dir", ""):
+        profile_iters = {int(x) for x in
+                         (run_cfg.profile_iterations or [1])}
+    tele_log, tele_rank, tele_emitted = None, 0, 0
+    if tele is not None:
+        from .telemetry import spans as _spans
+        tele_rank = _telemetry_rank()
+        _spans.set_context(rank=tele_rank)
+        if getattr(run_cfg, "telemetry_dir", ""):
+            # scope the span dump to THIS run: the recorder is process-
+            # global and earlier runs (or telemetry=off runs made while
+            # recording stayed on) may have left spans behind
+            _spans.clear_recorded()
+            # open the per-rank JSONL NOW and stream each iteration as it
+            # finishes — a preempted worker's attempt must still leave its
+            # records behind for the cluster rollup (the append-mode
+            # fault-tolerance contract), not lose them to an end-of-train
+            # buffer flush that never runs
+            from .telemetry.export import JsonlEventLog, rank_jsonl_path
+            os.makedirs(run_cfg.telemetry_dir, exist_ok=True)
+            tele_log = JsonlEventLog(
+                rank_jsonl_path(run_cfg.telemetry_dir, tele_rank))
 
     # ---- checkpoint/restore (lightgbm_tpu/checkpoint/) ----------------
     def _opt(kwarg, key, default):
@@ -194,7 +224,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
                           evaluation_result_list=None)
         for cb in cbs_before:
             cb(env)
-        should_stop = booster.update(fobj=fobj)
+        if it in profile_iters:
+            # device trace around the chosen iteration (view with
+            # xprof/tensorboard; config profile_dir/profile_iterations)
+            from .timer import device_trace
+            with device_trace(run_cfg.profile_dir):
+                should_stop = booster.update(fobj=fobj)
+        else:
+            should_stop = booster.update(fobj=fobj)
         evaluation_result_list = []
         if booster._valid_names or train_in_valid:
             if train_in_valid:
@@ -222,12 +259,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     or should_stop) and manager.is_writer():
                 # rank-0-only: other ranks skip the capture too (it pulls
                 # the [K, N] score off device and flushes pending trees)
+                t_ck = time.perf_counter()
                 manager.save(capture_train_state(booster, eval_history),
                              it + 1)
+                if tele is not None:
+                    tele.annotate_last("checkpoint_s",
+                                       time.perf_counter() - t_ck)
+        if tele_log is not None:
+            # stream after the checkpoint annotation so the emitted line
+            # carries this iteration's checkpoint_s
+            while tele_emitted < len(tele.records):
+                tele_log.emit("iteration", dict(tele.records[tele_emitted],
+                                                rank=tele_rank))
+                tele_emitted += 1
         if should_stop:
             break
     if manager is not None:
         booster._checkpoint_manager = manager
+    if tele_log is not None:
+        _finish_telemetry_outputs(run_cfg.telemetry_dir, tele, tele_log,
+                                  tele_rank, tele_emitted)
     if not finished_early:
         if evals_result:
             booster.best_iteration = booster.current_iteration()
@@ -236,6 +287,41 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for item in (evaluation_result_list if nbr > 0 else []):
             booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
     return booster
+
+
+def _telemetry_rank() -> int:
+    try:
+        from .parallel.mesh import comm_rank
+        return int(comm_rank())
+    except Exception:
+        return 0
+
+
+def _finish_telemetry_outputs(telemetry_dir: str, tele, log, rank: int,
+                              emitted: int) -> None:
+    """Close out this rank's telemetry: flush any iteration records the
+    loop didn't stream (early-stop break), then the summary, the recorded
+    spans, and a Chrome-trace timeline.  The JSONL is append-mode so a
+    supervised restart's relaunched worker accumulates into the same file;
+    recording is drained AND switched back off so later runs in this
+    process don't silently buffer spans with no consumer."""
+    from .telemetry import spans as _spans
+    from .telemetry.export import write_chrome_trace
+    try:
+        for rec in tele.records[emitted:]:
+            log.emit("iteration", dict(rec, rank=rank))
+        log.emit("summary", dict(tele.summary(), rank=rank))
+        span_list = _spans.recorded_spans()
+        for s in span_list:
+            log.emit("span", s.to_dict())
+        write_chrome_trace(
+            os.path.join(telemetry_dir, f"trace_rank{rank}.json"),
+            span_list)
+    finally:
+        log.close()
+        _spans.clear_recorded()
+        _spans.set_recording(False)
+    log_info(f"telemetry written: {log.path}")
 
 
 class CVBooster:
@@ -313,6 +399,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     """Cross-validation (reference engine.py:397 cv())."""
     params = resolve_aliases(dict(params))
+    from .log import apply_verbosity
+    apply_verbosity(params)
     if params.pop("checkpoint_dir", ""):
         log_warning("checkpoint_dir is ignored in cv(): folds train on "
                     "different row subsets and cannot share (or resume "

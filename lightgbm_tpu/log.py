@@ -10,7 +10,8 @@ import sys
 from typing import Callable, Optional
 
 __all__ = ["log_debug", "log_info", "log_warning", "log_fatal",
-           "register_log_callback", "set_verbosity", "LightGBMError"]
+           "register_log_callback", "set_verbosity", "apply_verbosity",
+           "LightGBMError"]
 
 
 class LightGBMError(Exception):
@@ -24,6 +25,19 @@ _CALLBACK: Optional[Callable[[str], None]] = None
 def set_verbosity(v: int) -> None:
     global _VERBOSITY
     _VERBOSITY = v
+
+
+def apply_verbosity(params) -> None:
+    """Wire a params dict's ``verbosity`` into the logger at an entry
+    point (engine.train/cv, sklearn fit) — pre-construction warnings then
+    honor it too, not just paths that eventually build a Booster (which
+    re-applies it).  Unparseable values are ignored, matching Config's
+    coercion failure mode."""
+    if "verbosity" in params:
+        try:
+            set_verbosity(int(params["verbosity"]))
+        except (TypeError, ValueError):
+            pass
 
 
 def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:

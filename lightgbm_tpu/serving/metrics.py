@@ -1,15 +1,22 @@
-"""Per-model serving counters and latency histograms.
+"""Per-model serving counters and latency histograms, backed by the
+unified metrics registry.
 
 Every observable the serving stack exposes funnels through one
-``ServingMetrics`` instance: request/row/batch counters, batch-fill ratio
-(how much the micro-batcher actually coalesces), queue depth, XLA compile
-count, and request-latency percentiles.  ``snapshot()`` renders the whole
-thing as a plain dict so the HTTP front-end can serve it as JSON and tests
-can assert on it without scraping.
+``ServingMetrics`` instance whose instruments live in a
+``telemetry.MetricsRegistry`` (one registry per ServingMetrics, so
+independent front-ends — and tests — never share counter state): the
+counters/gauges are registry objects labeled ``model=<name>``, which is
+what ``GET /v1/metrics/prometheus`` renders, while ``snapshot()`` keeps
+the original plain-dict JSON shape for ``GET /v1/metrics`` and tests.
 
-Wall-clock attribution additionally follows the package-wide phase-timer
-convention (timer.py, ``LIGHTGBM_TPU_TIMETAG=1``): the hot serving phases
-are accumulated under ``serving::*`` labels in the same global_timer the
+Request-latency percentiles come from a bounded ring of recent latencies
+(exact percentiles over "now", what dashboards want) AND feed the
+registry's fixed-bucket histogram (what Prometheus scrapes, all-time).
+
+Wall-clock attribution additionally follows the package-wide phase-span
+convention (timer.py shims over telemetry/spans.py,
+``LIGHTGBM_TPU_TIMETAG=1`` / ``telemetry=on``): the hot serving phases are
+accumulated under ``serving::*`` labels in the same global_timer the
 training engine uses, so one flag profiles both halves of the system.
 """
 
@@ -18,6 +25,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..telemetry.registry import MetricsRegistry
 from ..timer import global_timer, timers_enabled
 
 __all__ = ["LatencyWindow", "ModelMetrics", "ServingMetrics"]
@@ -60,78 +68,149 @@ class LatencyWindow:
 
 
 class ModelMetrics:
-    """Counters for one served model (all versions pooled)."""
+    """Observables for one served model (all versions pooled); each is a
+    registry instrument labeled model=<name>."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.requests = 0
-        self.rows = 0
-        self.batches = 0
-        self.batched_requests = 0
-        self.batched_rows = 0
-        self.errors = 0
-        self.device_calls = 0       # compiled-program executions
-        self.device_rows = 0        # rows actually sent to the device
-        self.queue_depth = 0        # gauge, set by the batcher
-        self.queue_rejections = 0
+    def __init__(self, name: str = "default",
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+        self.name = name
+        lab = {"model": name}
+        self._requests = reg.counter(
+            "lgbm_serving_requests_total", "user-facing predict requests",
+            **lab)
+        self._rows = reg.counter(
+            "lgbm_serving_rows_total", "rows across predict requests", **lab)
+        self._batches = reg.counter(
+            "lgbm_serving_batches_total", "coalesced device flushes", **lab)
+        self._batched_requests = reg.counter(
+            "lgbm_serving_batched_requests_total",
+            "requests served via a coalesced flush", **lab)
+        self._batched_rows = reg.counter(
+            "lgbm_serving_batched_rows_total",
+            "rows served via a coalesced flush", **lab)
+        self._errors = reg.counter(
+            "lgbm_serving_errors_total", "failed predict requests", **lab)
+        self._device_calls = reg.counter(
+            "lgbm_serving_device_calls_total",
+            "compiled-program executions", **lab)
+        self._device_rows = reg.counter(
+            "lgbm_serving_device_rows_total",
+            "real (pre-pad) rows sent to the device", **lab)
+        self._queue_depth = reg.gauge(
+            "lgbm_serving_queue_depth", "rows waiting in the micro-batch "
+            "queue", **lab)
+        self._queue_rejections = reg.counter(
+            "lgbm_serving_queue_rejections_total",
+            "requests rejected by queue backpressure", **lab)
+        self._latency_hist = reg.histogram(
+            "lgbm_serving_request_latency_seconds",
+            "user-facing request latency", **lab)
+        self._compiles = reg.gauge(
+            "lgbm_serving_compile_count", "XLA programs compiled for this "
+            "model (all versions)", **lab)
         self.latency = LatencyWindow()
+        # keeps the batch triple (batches, batched_requests, batched_rows)
+        # mutually consistent between record_batch and the ratio reads in
+        # snapshot — the per-counter locks alone allow a flush to land
+        # between the numerator and denominator reads
+        self._batch_lock = threading.Lock()
 
+    def set_compile_count(self, count: int) -> None:
+        self._compiles.set(int(count))
+
+    # -- back-compat attribute views (old dict-of-ints shape) ----------
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def rows(self) -> int:
+        return int(self._rows.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def device_calls(self) -> int:
+        return int(self._device_calls.value)
+
+    @property
+    def device_rows(self) -> int:
+        return int(self._device_rows.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
+
+    @property
+    def queue_rejections(self) -> int:
+        return int(self._queue_rejections.value)
+
+    # -- recording -------------------------------------------------------
     def record_request(self, rows: int, latency_s: Optional[float] = None,
                        error: bool = False) -> None:
         """One USER-FACING request (batcher scatter or app direct path).
         The predictor's own device call is recorded separately via
         record_device, so coalesced traffic isn't double-counted."""
-        with self._lock:
-            self.requests += 1
-            self.rows += int(rows)
-            if error:
-                self.errors += 1
+        self._requests.inc()
+        self._rows.inc(int(rows))
+        if error:
+            self._errors.inc()
         if latency_s is not None:
             self.latency.observe(latency_s)
+            self._latency_hist.observe(latency_s)
 
     def record_device(self, rows: int) -> None:
         """One compiled-program execution of `rows` real (pre-pad) rows."""
-        with self._lock:
-            self.device_calls += 1
-            self.device_rows += int(rows)
+        self._device_calls.inc()
+        self._device_rows.inc(int(rows))
 
     def record_batch(self, n_requests: int, n_rows: int,
                      device_s: float) -> None:
         """One coalesced device call serving `n_requests` requests."""
-        with self._lock:
-            self.batches += 1
-            self.batched_requests += int(n_requests)
-            self.batched_rows += int(n_rows)
+        with self._batch_lock:
+            self._batches.inc()
+            self._batched_requests.inc(int(n_requests))
+            self._batched_rows.inc(int(n_rows))
         if timers_enabled():
             global_timer.add("serving::batch_predict", device_s)
 
     def record_queue(self, depth: int) -> None:
-        self.queue_depth = int(depth)
+        self._queue_depth.set(int(depth))
 
     def record_rejection(self) -> None:
-        with self._lock:
-            self.queue_rejections += 1
+        self._queue_rejections.inc()
 
     def snapshot(self, compile_count: Optional[int] = None) -> Dict:
-        with self._lock:
-            out = {
-                "requests": self.requests,
-                "rows": self.rows,
-                "batches": self.batches,
-                "errors": self.errors,
-                "device_calls": self.device_calls,
-                "device_rows": self.device_rows,
-                "queue_depth": self.queue_depth,
-                "queue_rejections": self.queue_rejections,
-                # >1 means the micro-batcher is actually coalescing:
-                # device calls are amortized over multiple requests
-                "batch_fill_ratio": (self.batched_requests / self.batches
-                                     if self.batches else 0.0),
-                # batched rows only: direct-path requests bump self.rows
-                # but never ride a flush, and would inflate this
-                "rows_per_batch": (self.batched_rows / self.batches
-                                   if self.batches else 0.0),
-            }
+        with self._batch_lock:
+            batches = self.batches
+            batched_requests = self._batched_requests.value
+            batched_rows = self._batched_rows.value
+        out = {
+            "requests": self.requests,
+            "rows": self.rows,
+            "batches": batches,
+            "errors": self.errors,
+            "device_calls": self.device_calls,
+            "device_rows": self.device_rows,
+            "queue_depth": self.queue_depth,
+            "queue_rejections": self.queue_rejections,
+            # >1 means the micro-batcher is actually coalescing:
+            # device calls are amortized over multiple requests
+            "batch_fill_ratio": (batched_requests / batches
+                                 if batches else 0.0),
+            # batched rows only: direct-path requests bump self.rows
+            # but never ride a flush, and would inflate this
+            "rows_per_batch": (batched_rows / batches
+                               if batches else 0.0),
+        }
         out.update(self.latency.percentiles())
         if compile_count is not None:
             out["compile_count"] = int(compile_count)
@@ -139,17 +218,19 @@ class ModelMetrics:
 
 
 class ServingMetrics:
-    """name -> ModelMetrics, created on first touch."""
+    """name -> ModelMetrics, created on first touch; all models share this
+    instance's MetricsRegistry (the Prometheus exporter's source)."""
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
+        self.registry = registry if registry is not None else MetricsRegistry()
 
     def model(self, name: str) -> ModelMetrics:
         with self._lock:
             m = self._models.get(name)
             if m is None:
-                m = self._models[name] = ModelMetrics()
+                m = self._models[name] = ModelMetrics(name, self.registry)
             return m
 
     def snapshot(self, compile_counts: Optional[Dict[str, int]] = None) -> Dict:

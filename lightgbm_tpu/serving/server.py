@@ -14,7 +14,10 @@ Routes (JSON bodies):
 
 - ``GET  /healthz``                     liveness
 - ``GET  /v1/models``                   registry listing
-- ``GET  /v1/metrics``                  ServingMetrics snapshot
+- ``GET  /v1/metrics``                  ServingMetrics snapshot (JSON)
+- ``GET  /v1/metrics/prometheus``       Prometheus text exposition
+                                        (serving registry + process-wide
+                                        telemetry registry)
 - ``POST /v1/models/<name>:publish``    {"model_file"|"model_str": ...}
 - ``POST /v1/models/<name>:rollback``
 - ``POST /v1/models/<name>:predict``    {"rows": [[...]...],
@@ -108,7 +111,9 @@ class ServingApp:
     # ------------------------------------------------------------------
     def handle(self, method: str, path: str,
                body: Optional[dict] = None) -> Tuple[int, dict]:
-        """Pure request handler: (status_code, response_dict)."""
+        """Pure request handler: (status_code, response_dict).  The
+        Prometheus route returns (status_code, text) instead — a plain
+        ``str`` payload is served as text/plain by the HTTP wrapper."""
         try:
             return self._route(method.upper(), path.rstrip("/") or "/",
                                body or {})
@@ -129,6 +134,8 @@ class ServingApp:
             return 200, {"models": self.registry.models()}
         if method == "GET" and path == "/v1/metrics":
             return 200, self.metrics.snapshot(self.registry.compile_counts())
+        if method == "GET" and path == "/v1/metrics/prometheus":
+            return 200, self._prometheus()
         if path.startswith("/v1/models/") and ":" in path:
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
@@ -143,6 +150,16 @@ class ServingApp:
         return 404, {"error": f"no route for {method} {path}"}
 
     # ------------------------------------------------------------------
+    def _prometheus(self) -> str:
+        """Prometheus text dump: this app's serving registry plus the
+        process-wide telemetry registry (training stats when colocated).
+        Additive — ``/v1/metrics`` keeps its JSON shape unchanged."""
+        from ..telemetry import REGISTRY, prometheus_text
+        # refresh the per-model compile gauges from the live predictors
+        for name, count in self.registry.compile_counts().items():
+            self.metrics.model(name).set_compile_count(count)
+        return prometheus_text(self.metrics.registry, REGISTRY)
+
     def _publish(self, name: str, body: dict) -> Tuple[int, dict]:
         version = self.registry.publish(
             name,
@@ -214,9 +231,14 @@ def make_server(app: ServingApp, host: str = "127.0.0.1", port: int = 8080):
             self._send(status, payload)
 
         def _send(self, status, payload):
-            data = json.dumps(payload).encode()
+            if isinstance(payload, str):       # Prometheus text exposition
+                data = payload.encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -196,6 +196,10 @@ class LGBMModel(_SKBase):
             callbacks=None, init_model=None) -> "LGBMModel":
         self._objective = self.objective
         params = self._process_params()
+        # wire verbosity at the sklearn entry point too (Dataset
+        # construction below logs before any Booster applies it)
+        from .log import apply_verbosity
+        apply_verbosity(params)
         if "objective" not in params and not callable(self.objective):
             params["objective"] = self._default_objective()
 

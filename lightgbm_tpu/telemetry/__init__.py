@@ -1,0 +1,50 @@
+"""Unified telemetry subsystem: spans, metrics registry, exporters,
+per-iteration training stats.
+
+One observability layer for the whole system, absorbing the ad-hoc pieces
+that grew alongside it (the flat phase timer, serving-only counters,
+dataset setup timings, checkpoint overhead probes):
+
+- ``spans`` — structured, nestable phase spans with thread-local parent
+  tracking, optional device-sync duration, and free-form attributes
+  (rank/iteration); ``timer.timed``/``timer.global_timer`` are thin compat
+  shims over it.
+- ``registry`` — process-wide metrics registry (counters, gauges,
+  fixed-bucket histograms with percentile reads); ``ServingMetrics``
+  re-registers its per-model counters into one instead of owning dicts.
+- ``training`` — per-iteration training stats (grad/grow/apply actuals,
+  staged-probe hist/split/partition decomposition, measured collective
+  probe, compile deltas) wired through GBDT and surfaced via
+  ``Booster.telemetry_stats()`` / the ``record_telemetry`` callback.
+- ``export`` — Prometheus text format (served at
+  ``GET /v1/metrics/prometheus``), Chrome-trace/Perfetto span timelines,
+  and the per-rank JSONL event log + cluster rollup.
+
+Config surface: ``telemetry=on|off`` (default off — the fused train step
+stays fused and span overhead is one bool check), ``telemetry_dir`` (JSONL
++ trace output, one file per rank), ``profile_dir`` +
+``profile_iterations`` (jax.profiler device traces around chosen
+iterations).  ``LIGHTGBM_TPU_TIMETAG=1`` remains the env alias for the
+phase timers alone.
+
+``training`` is imported lazily (it pulls the tree-learner stack); spans,
+registry, and export are light.
+"""
+
+from . import spans
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY)
+from .export import (JsonlEventLog, chrome_trace, prometheus_text,
+                     rollup_telemetry_dir, write_chrome_trace)
+from .spans import span, set_enabled, set_recording, set_context
+
+__all__ = ["spans", "span", "set_enabled", "set_recording", "set_context",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "prometheus_text", "chrome_trace", "write_chrome_trace",
+           "JsonlEventLog", "rollup_telemetry_dir"]
+
+
+def __getattr__(name):
+    if name == "training":
+        from . import training as _training
+        return _training
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

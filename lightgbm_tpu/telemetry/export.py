@@ -1,0 +1,222 @@
+"""Telemetry exporters: Prometheus text format, Chrome-trace JSON, and the
+per-rank JSONL event log + job-level rollup.
+
+Three views of the same data, one per consumer:
+
+- ``prometheus_text`` renders one or more MetricsRegistry instances in the
+  Prometheus exposition format; the serving front-end serves it at
+  ``GET /v1/metrics/prometheus`` (additive — ``/v1/metrics`` stays JSON).
+- ``chrome_trace``/``write_chrome_trace`` turn recorded spans into a
+  Chrome-trace/Perfetto ``traceEvents`` timeline (load in ui.perfetto.dev
+  or chrome://tracing; device-level traces come from ``profile_dir`` /
+  xprof instead).
+- ``JsonlEventLog`` appends one JSON object per line (iteration stats,
+  span dumps, summaries) to a per-rank file; ``rollup_telemetry_dir``
+  aggregates every rank's file into a job-level summary — the shape
+  ``cluster.train_distributed`` writes on exit, append-mode so supervised
+  restarts accumulate into the same per-rank files.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from .registry import Histogram, MetricsRegistry
+from . import spans as _spans
+
+__all__ = ["prometheus_text", "chrome_trace", "write_chrome_trace",
+           "JsonlEventLog", "rank_jsonl_path", "rollup_telemetry_dir"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format
+# ---------------------------------------------------------------------------
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(*registries: MetricsRegistry) -> str:
+    """Render registries in the Prometheus exposition format (duplicates —
+    e.g. the global registry passed twice — are emitted once)."""
+    lines: List[str] = []
+    seen_regs, seen_names = set(), set()
+    for reg in registries:
+        if reg is None or id(reg) in seen_regs:
+            continue
+        seen_regs.add(id(reg))
+        for name, kind, help_text, rows in reg.collect():
+            if name in seen_names:      # same family from two registries:
+                continue                # first (app-local) one wins
+            seen_names.add(name)
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, inst in rows:
+                if isinstance(inst, Histogram):
+                    for le, cum in inst.bucket_counts():
+                        le_attr = 'le="' + _fmt_value(le) + '"'
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(labels, le_attr)}"
+                            f" {cum}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(labels)} "
+                        f"{_fmt_value(inst.sum)}")
+                    lines.append(
+                        f"{name}_count{_fmt_labels(labels)} {inst.count}")
+                else:
+                    lines.append(f"{name}{_fmt_labels(labels)} "
+                                 f"{_fmt_value(inst.value)}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace (Perfetto-loadable)
+# ---------------------------------------------------------------------------
+def chrome_trace(span_list: Optional[Iterable[_spans.Span]] = None) -> Dict:
+    """Recorded spans -> Chrome-trace dict ({"traceEvents": [...]})."""
+    if span_list is None:
+        span_list = _spans.recorded_spans()
+    pid = os.getpid()
+    events = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+               "args": {"name": "lightgbm_tpu"}}]
+    for s in span_list:
+        events.append({
+            "name": s.name, "ph": "X", "pid": pid, "tid": s.thread_id,
+            # trace timestamps are microseconds
+            "ts": s.start_unix_s * 1e6, "dur": s.dur_s * 1e6,
+            "args": dict(s.attrs),
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str,
+                       span_list: Optional[Iterable[_spans.Span]] = None
+                       ) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(chrome_trace(span_list), fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# JSONL event log + cluster rollup
+# ---------------------------------------------------------------------------
+def rank_jsonl_path(telemetry_dir: str, rank: int) -> str:
+    return os.path.join(telemetry_dir, f"telemetry_rank{int(rank)}.jsonl")
+
+
+class JsonlEventLog:
+    """Append-only one-JSON-object-per-line event sink (one file per rank,
+    like the cluster worker logs).  Append mode on purpose: a supervised
+    restart reopens the same file and its records accumulate."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(path, "a")
+
+    def emit(self, kind: str, payload: Dict) -> None:
+        rec = {"kind": kind}
+        rec.update(payload)
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+def _json_default(obj):
+    try:
+        import numpy as np
+        if isinstance(obj, np.generic):
+            return obj.item()
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+    except ImportError:
+        pass
+    return str(obj)
+
+
+def rollup_telemetry_dir(telemetry_dir: str,
+                         out_path: Optional[str] = None) -> Optional[Dict]:
+    """Aggregate every rank's JSONL into one job-level summary dict (and
+    write it to ``out_path`` / telemetry_summary.json).
+
+    Iteration records from ALL attempts count (after a supervised restart
+    the per-rank files simply grow), so the summary reflects the whole
+    job's work, not just the surviving attempt."""
+    import glob
+    files = sorted(glob.glob(os.path.join(telemetry_dir,
+                                          "telemetry_rank*.jsonl")))
+    if not files:
+        return None
+    per_rank: Dict[str, Dict] = {}
+    phase_keys = ("iter_s", "grad_s", "grow_s", "hist_s", "split_s",
+                  "partition_s", "comm_s", "apply_s", "checkpoint_s")
+    for path in files:
+        rank_name = os.path.basename(path)[len("telemetry_rank"):-len(".jsonl")]
+        iters: List[Dict] = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue       # torn write from a killed worker
+                if rec.get("kind") == "iteration":
+                    iters.append(rec)
+        totals = {k: sum(float(r[k]) for r in iters
+                         if isinstance(r.get(k), (int, float)))
+                  for k in phase_keys}
+        per_rank[rank_name] = {
+            "iterations": len(iters),
+            "totals": totals,
+            "per_iter_s": (totals["iter_s"] / len(iters)) if iters else 0.0,
+        }
+    n_ranks = len(per_rank)
+    total_iters = sum(r["iterations"] for r in per_rank.values())
+    summary = {
+        "ranks": n_ranks,
+        "total_iterations": total_iters,
+        "per_rank": per_rank,
+        # job totals: straight sums — honest "machine-seconds by phase"
+        "totals": {k: sum(r["totals"][k] for r in per_rank.values())
+                   for k in phase_keys},
+        "max_per_iter_s": max((r["per_iter_s"] for r in per_rank.values()),
+                              default=0.0),
+    }
+    if out_path is None:
+        out_path = os.path.join(telemetry_dir, "telemetry_summary.json")
+    with open(out_path, "w") as fh:
+        json.dump(summary, fh, indent=2)
+    summary["path"] = out_path
+    return summary

@@ -1,0 +1,222 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+One registry instance holds get-or-create instrument families keyed by
+(metric name, label set) — the Prometheus data model, kept deliberately
+tiny (no external client library; the exposition format lives in
+telemetry/export.py).  ``REGISTRY`` is the process-wide default that
+training telemetry publishes into; serving builds one registry per
+``ServingMetrics`` (per app) so independent front-ends — and tests — don't
+share counter state, and the HTTP exporter dumps both.
+
+Histograms use fixed upper-bound buckets with linear interpolation inside
+the winning bucket for percentile reads — O(buckets) memory under any
+traffic, the standard trade against exact quantiles.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "DEFAULT_LATENCY_BUCKETS"]
+
+# seconds; spans request latencies from sub-ms device calls to stragglers
+DEFAULT_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                           0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Set-to-current value."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile reads.
+
+    ``buckets`` are inclusive upper bounds; an implicit +inf bucket catches
+    the tail.  ``percentile(p)`` interpolates linearly inside the bucket
+    holding the p-th observation (the +inf bucket reports its lower edge —
+    a deliberate under-estimate rather than an invented tail)."""
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_LATENCY_BUCKETS):
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._bounds = bs
+        self._counts = [0] * (len(bs) + 1)     # +1 = the +inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = len(self._bounds)
+        for i, b in enumerate(self._bounds):
+            if value <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative (upper_bound, count) pairs, Prometheus ``le`` style,
+        ending with (+inf, total)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for b, c in zip(self._bounds, counts):
+            cum += c
+            out.append((b, cum))
+        out.append((math.inf, cum + counts[-1]))
+        return out
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; 0.0 when empty."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        target = max(p, 0.0) / 100.0 * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if cum + c >= target and c > 0:
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                if i >= len(self._bounds):     # +inf bucket: report its edge
+                    return self._bounds[-1]
+                hi = self._bounds[i]
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self._bounds[-1]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": self.count, "sum": self.sum,
+                "p50": self.percentile(50.0), "p95": self.percentile(95.0),
+                "p99": self.percentile(99.0)}
+
+
+class _Family:
+    def __init__(self, kind: str, help_text: str):
+        self.kind = kind
+        self.help = help_text
+        self.instruments: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Get-or-create instrument store; same (name, labels) returns the SAME
+    instrument, so re-registration is idempotent and cheap."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get(self, kind: str, name: str, help_text: str, labels: Dict,
+             factory):
+        key = _label_key(labels or {})
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(kind, help_text)
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}, "
+                    f"cannot re-register as {kind}")
+            inst = fam.instruments.get(key)
+            if inst is None:
+                inst = fam.instruments[key] = factory()
+            return inst
+
+    def counter(self, name: str, help_text: str = "", **labels) -> Counter:
+        return self._get("counter", name, help_text, labels, Counter)
+
+    def gauge(self, name: str, help_text: str = "", **labels) -> Gauge:
+        return self._get("gauge", name, help_text, labels, Gauge)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Optional[Iterable[float]] = None,
+                  **labels) -> Histogram:
+        return self._get(
+            "histogram", name, help_text, labels,
+            lambda: Histogram(buckets or DEFAULT_LATENCY_BUCKETS))
+
+    def collect(self):
+        """[(name, kind, help, [(labels_dict, instrument), ...])], sorted by
+        name — the exporter's stable iteration order."""
+        with self._lock:
+            fams = sorted(self._families.items())
+            out = []
+            for name, fam in fams:
+                rows = [(dict(key), inst)
+                        for key, inst in sorted(fam.instruments.items())]
+                out.append((name, fam.kind, fam.help, rows))
+        return out
+
+    def snapshot(self) -> Dict:
+        """Plain-dict view (JSON-friendly) for tests and debug endpoints."""
+        out: Dict = {}
+        for name, kind, _help, rows in self.collect():
+            fam: Dict = {}
+            for labels, inst in rows:
+                key = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                fam[key or "_"] = (inst.snapshot()
+                                   if isinstance(inst, Histogram)
+                                   else inst.value)
+            out[name] = fam
+        return out
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,219 @@
+"""Structured, nestable phase spans — the timing core of the telemetry
+subsystem.
+
+Supersedes the flat label timer (timer.py keeps ``timed``/``global_timer``
+as thin shims over this module): every ``span()`` still accumulates into
+the process-wide aggregate (name -> seconds/calls, printed at exit exactly
+like the reference Common::Timer), and additionally — when event recording
+is on — captures a structured ``Span`` event with start/duration, thread
+id, the enclosing span (thread-local parent tracking), and free-form
+attributes (rank, iteration, ...).  The recorded events feed the exporters
+(telemetry/export.py): Chrome-trace/Perfetto timelines and the per-rank
+JSONL event log.
+
+Enablement is RUNTIME state, not import-frozen: ``set_enabled()`` flips the
+timers (``LIGHTGBM_TPU_TIMETAG=1`` stays the env-var default for
+back-compat), ``set_recording()`` flips event capture (``telemetry=on``
+turns both on).  The disabled fast path is a single bool check.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "PhaseTimer", "global_timer", "span", "enabled",
+           "set_enabled", "recording", "set_recording", "set_context",
+           "get_context", "recorded_spans", "clear_recorded",
+           "current_span"]
+
+# wall-clock epoch matching perf_counter 0, so exported timestamps are
+# absolute while in-process math stays on the monotonic clock
+_EPOCH = time.time() - time.perf_counter()
+
+
+class PhaseTimer:
+    """name -> accumulated seconds (reference Common::Timer::Print
+    semantics); the aggregate view every span feeds."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acc: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.acc[name] = self.acc.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU phase timers:"]
+        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
+            lines.append(f"  {name}: {self.acc[name]:.3f}s "
+                         f"({self.counts[name]} calls)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.acc.clear()
+            self.counts.clear()
+
+
+global_timer = PhaseTimer()
+
+# exact historical truthiness (any non-empty value except "0" enables)
+_enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+_recording = False
+_MAX_RECORDED = 65536          # bounded: sustained traffic must not OOM
+
+_ids = itertools.count(1)
+_tls = threading.local()
+_ctx_lock = threading.Lock()
+_context: Dict[str, Any] = {}   # process-wide attrs stamped on every span
+
+
+class Span:
+    """One finished (or in-flight) timed region."""
+
+    __slots__ = ("id", "name", "start_s", "dur_s", "thread_id", "parent_id",
+                 "parent_name", "attrs")
+
+    def __init__(self, name: str, parent: Optional["Span"],
+                 attrs: Dict[str, Any]):
+        self.id = next(_ids)
+        self.name = name
+        self.start_s = time.perf_counter()
+        self.dur_s = 0.0
+        self.thread_id = threading.get_ident()
+        self.parent_id = parent.id if parent is not None else None
+        self.parent_name = parent.name if parent is not None else None
+        self.attrs = attrs
+
+    @property
+    def start_unix_s(self) -> float:
+        return self.start_s + _EPOCH
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"id": self.id, "name": self.name,
+                "start_unix_s": self.start_unix_s, "dur_s": self.dur_s,
+                "thread_id": self.thread_id, "parent_id": self.parent_id,
+                "parent_name": self.parent_name, "attrs": dict(self.attrs)}
+
+
+class _Recorder:
+    """Bounded ring of finished spans (drop-newest once full, with a
+    dropped counter so truncation is visible, never silent)."""
+
+    def __init__(self, capacity: int = _MAX_RECORDED):
+        self._lock = threading.Lock()
+        self._cap = capacity
+        self._spans: List[Span] = []
+        self.dropped = 0
+
+    def record(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) >= self._cap:
+                self.dropped += 1
+                return
+            self._spans.append(s)
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+
+recorder = _Recorder()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    """Runtime switch for the phase timers (tests and ``telemetry=on`` flip
+    it without re-importing; LIGHTGBM_TPU_TIMETAG only sets the default)."""
+    global _enabled
+    _enabled = bool(value)
+
+
+def recording() -> bool:
+    return _recording
+
+
+def set_recording(value: bool) -> None:
+    global _recording
+    _recording = bool(value)
+
+
+def set_context(**attrs) -> None:
+    """Merge process-wide attributes (e.g. rank) stamped on every span;
+    ``set_context(rank=None)`` removes a key."""
+    with _ctx_lock:
+        for k, v in attrs.items():
+            if v is None:
+                _context.pop(k, None)
+            else:
+                _context[k] = v
+
+
+def get_context() -> Dict[str, Any]:
+    with _ctx_lock:
+        return dict(_context)
+
+
+def _stack() -> List[Span]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Optional[Span]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+def recorded_spans() -> List[Span]:
+    return recorder.snapshot()
+
+
+def clear_recorded() -> None:
+    recorder.clear()
+
+
+@contextmanager
+def span(name: str, sync=None, **attrs):
+    """Time a region under `name` when timers are enabled.
+
+    sync: optional array/pytree to block_until_ready before stopping the
+    clock, so async-dispatched device work is attributed to the phase that
+    launched it instead of whoever syncs next.  Extra kwargs become span
+    attributes (merged over the process-wide context)."""
+    if not _enabled:
+        yield None
+        return
+    stack = _stack()
+    merged = get_context()
+    merged.update(attrs)
+    s = Span(name, stack[-1] if stack else None, merged)
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        stack.pop()
+        if sync is not None:
+            import jax
+            jax.block_until_ready(sync)
+        s.dur_s = time.perf_counter() - s.start_s
+        global_timer.add(name, s.dur_s)
+        if _recording:
+            recorder.record(s)

@@ -1,0 +1,467 @@
+"""Per-iteration training statistics: honest per-stage attribution.
+
+The production grower is ONE jitted XLA program (tree_learner.py), so a
+host clock cannot see inside it — and per-stage numbers that are guesses
+are worse than none (PROFILE_r05: when the chip is flaky, honest
+attribution is the scarcest resource).  This module therefore reports two
+kinds of numbers, clearly separated:
+
+- **Actuals**, measured around real host boundaries of the production
+  path: ``grad_s`` (gradient computation), ``grow_s`` (the whole grower
+  program, device-synced), ``apply_s`` (state->tree conversion + score
+  update), ``iter_s``, ``checkpoint_s`` (engine save time), and XLA
+  compile count/seconds deltas (via jax.monitoring backend-compile
+  events).  Telemetry disables the fused train step — per-stage
+  attribution needs the host boundaries the fused path deliberately
+  removes, which is exactly why ``telemetry=off`` is the perf default.
+
+- **Staged-probe decompositions**: ``hist_s`` / ``split_s`` /
+  ``partition_s`` come from re-growing the iteration's tree with the SAME
+  device ops (build_histogram / find_best_split / partition) staged as
+  separate jitted programs with a sync after each — a real measurement of
+  real work on the real data, following the dense-grower decomposition
+  (one masked both-children histogram pass per split).  The probe's tree
+  is discarded; the production model is untouched.  ``comm_s`` is a
+  measured collective probe: one psum of the iteration's histogram shape
+  on the learner's actual mesh, scaled by the number of histogram
+  reductions the iteration performed (data/voting-parallel).  Unsupported
+  configurations (forced splits, CEGB lazy, interaction constraints,
+  extra_trees, per-node column sampling, parallel learners for the staged
+  part) report ``None`` for the probe keys rather than a fabricated 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import spans
+from .registry import REGISTRY
+
+__all__ = ["TrainingTelemetry", "maybe_training_telemetry",
+           "compile_tracker", "PHASE_KEYS"]
+
+PHASE_KEYS = ("grad_s", "grow_s", "hist_s", "split_s", "partition_s",
+              "comm_s", "apply_s", "checkpoint_s")
+
+_ITER_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0,
+                 60.0)
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class _CompileTracker:
+    """Counts XLA backend compiles + seconds via jax.monitoring duration
+    events; process-wide (listeners cannot be unregistered, so exactly one
+    is ever installed)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._installed = False
+        self.count = 0
+        self.seconds = 0.0
+
+    def install(self) -> None:
+        with self._lock:
+            if self._installed:
+                return
+            self._installed = True
+        try:
+            import jax.monitoring as _monitoring
+
+            def _on_duration(event, duration, **kwargs):
+                if event == _COMPILE_EVENT:
+                    with self._lock:
+                        self.count += 1
+                        self.seconds += float(duration)
+
+            _monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:    # monitoring API drift: compiles report as 0
+            pass
+
+    def snapshot(self):
+        with self._lock:
+            return self.count, self.seconds
+
+
+compile_tracker = _CompileTracker()
+
+
+def maybe_training_telemetry(config) -> Optional["TrainingTelemetry"]:
+    """Create the per-iteration collector when ``telemetry=on``; also flips
+    the span timers on (the config-driven equivalent of
+    LIGHTGBM_TPU_TIMETAG).  Span EVENT recording — which buffers Span
+    objects for the JSONL/Chrome-trace exporters — only turns on when a
+    ``telemetry_dir`` will actually consume them: without a consumer the
+    process-global recorder would silently buffer every later span
+    (serving hot paths included) up to its cap for the process lifetime."""
+    if not getattr(config, "telemetry", False):
+        return None
+    spans.set_enabled(True)
+    if getattr(config, "telemetry_dir", ""):
+        spans.set_recording(True)
+    compile_tracker.install()
+    return TrainingTelemetry()
+
+
+# ---------------------------------------------------------------------------
+# Staged probe: the dense-grower decomposition as separate jitted programs
+# ---------------------------------------------------------------------------
+def _staged_probe_supported(learner) -> bool:
+    from ..tree_learner import SerialTreeLearner
+    cfg = learner.grower_cfg
+    return (type(learner) is SerialTreeLearner
+            and getattr(learner, "forced", None) is None
+            and not cfg.use_cegb_lazy
+            and not cfg.use_interaction
+            and not cfg.extra_trees
+            # any column sampling: the probe's all-ones mask would grow a
+            # DIFFERENT tree than production and misreport its phase times
+            and learner.config.feature_fraction >= 1.0
+            and cfg.feature_fraction_bynode >= 1.0
+            and not (cfg.use_monotone
+                     and cfg.monotone_method in ("intermediate", "advanced"))
+            and getattr(learner.dataset, "device_bins", None) is not None)
+
+
+def _jits():
+    """Lazily build the staged jitted programs (jax import deferred so
+    merely importing telemetry never initializes a backend)."""
+    global _STAGE
+    if _STAGE is not None:
+        return _STAGE
+    import jax
+    import jax.numpy as jnp
+    from ..ops.histogram import build_histogram
+    from ..tree_learner import (_apply_split_bookkeeping, _child_weights,
+                                _init_tree_state, _scan_leaf, _store_best)
+    from ..ops.split import leaf_output
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def root_hist(cfg, bins, grad_m, hess_m, mask, hist_layout):
+        return build_histogram(
+            bins, jnp.stack([grad_m, hess_m, mask], axis=1), cfg.num_bins,
+            impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
+            layout=hist_layout, widths=cfg.hist_widths)
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "n", "f"))
+    def root_scan(cfg, rhist, num_bins_f, has_missing_f, fmask, monotone,
+                  is_cat_f, bmap, gain_scale_f, n, f):
+        root_sums = rhist[0].sum(axis=0)
+        root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
+                               cfg.lambda_l2, cfg.max_delta_step)
+        state = _init_tree_state(cfg, n, root_sums.dtype, root_out,
+                                 root_sums, f)
+        res = _scan_leaf(rhist, root_sums, jnp.int32(0), cfg, num_bins_f,
+                         has_missing_f, fmask, monotone, is_cat_f, bmap,
+                         gain_scale_f=gain_scale_f)
+        return _store_best(state, 0, res)
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def partition(cfg, state, bins, num_bins_f, has_missing_f, monotone,
+                  bmap):
+        best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
+        gain = state.best_gain[best_leaf]
+        new_leaf = state.n_leaves
+        feat = state.best_feature[best_leaf]
+        thr = state.best_threshold[best_leaf]
+        dleft = state.best_default_left[best_leaf]
+        split_cat = (state.best_is_cat[best_leaf]
+                     if cfg.use_categorical else jnp.asarray(False))
+        cat_mask = state.best_cat_mask[best_leaf]
+        if cfg.use_efb:
+            from ..efb import decode_member_bin
+            col = jnp.take(bins, bmap.bundle_of_f[feat],
+                           axis=1).astype(jnp.int32)
+            fcol = decode_member_bin(col, bmap.offset_of_f[feat],
+                                     num_bins_f[feat])
+        else:
+            fcol = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+        missing_bin = num_bins_f[feat] - 1
+        is_missing = has_missing_f[feat] & (fcol == missing_bin)
+        go_left = jnp.where(is_missing, dleft, fcol <= thr)
+        if cfg.use_categorical:
+            go_left = jnp.where(split_cat, cat_mask[fcol], go_left)
+        in_leaf = state.row_leaf == best_leaf
+        row_leaf = jnp.where(in_leaf & ~go_left, new_leaf, state.row_leaf)
+        state = _apply_split_bookkeeping(
+            state, best_leaf, gain, feat, thr, dleft, split_cat, cat_mask,
+            cfg, monotone)._replace(row_leaf=row_leaf)
+        return state, best_leaf, new_leaf
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def child_hists(cfg, bins, row_leaf, best_leaf, new_leaf, grad_m,
+                    hess_m, mask, hist_layout):
+        left_m = (row_leaf == best_leaf).astype(grad_m.dtype)
+        right_m = (row_leaf == new_leaf).astype(grad_m.dtype)
+        h6 = build_histogram(
+            bins, _child_weights(grad_m, hess_m, mask, left_m, right_m),
+            cfg.num_bins, impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
+            layout=hist_layout, widths=cfg.hist_widths)
+        return h6[..., 0:3], h6[..., 3:6]
+
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def scan(cfg, state, hist_l, hist_r, best_leaf, new_leaf, num_bins_f,
+             has_missing_f, fmask, monotone, is_cat_f, bmap, gain_scale_f):
+        depth = state.leaf_depth[best_leaf]   # bookkeeping already advanced
+        res_l = _scan_leaf(hist_l, state.leaf_sum[best_leaf], depth, cfg,
+                           num_bins_f, has_missing_f, fmask, monotone,
+                           is_cat_f, bmap,
+                           bounds=(state.leaf_lo[best_leaf],
+                                   state.leaf_hi[best_leaf]),
+                           gain_scale_f=gain_scale_f)
+        res_r = _scan_leaf(hist_r, state.leaf_sum[new_leaf], depth, cfg,
+                           num_bins_f, has_missing_f, fmask, monotone,
+                           is_cat_f, bmap,
+                           bounds=(state.leaf_lo[new_leaf],
+                                   state.leaf_hi[new_leaf]),
+                           gain_scale_f=gain_scale_f)
+        state = _store_best(state, best_leaf, res_l)
+        return _store_best(state, new_leaf, res_r)
+
+    _STAGE = {"root_hist": root_hist, "root_scan": root_scan,
+              "partition": partition, "child_hists": child_hists,
+              "scan": scan}
+    return _STAGE
+
+
+_STAGE = None
+
+
+def run_staged_probe(learner, grad, hess, mask,
+                     timings: Optional[Dict[str, float]] = None
+                     ) -> Optional[Dict[str, float]]:
+    """Re-grow one tree from (grad, hess, mask) with each phase as its own
+    synced device program; returns accumulated {hist_s, split_s,
+    partition_s, probe_steps}.  The grown tree is discarded — the
+    production model never sees the probe."""
+    if not _staged_probe_supported(learner):
+        return None
+    import jax
+    import jax.numpy as jnp
+    from ..ops.split import K_EPSILON
+    stage = _jits()
+    ds = learner.dataset
+    cfg = learner.grower_cfg._replace(parallel_mode="none", axis_name=None)
+    bins = ds.device_bins
+    n = int(bins.shape[0])
+    f = int(np.asarray(ds.num_bins_per_feature).shape[0])
+    # all-ones feature mask on purpose: calling learner.feature_mask()
+    # here would advance its column-sampling RNG and change the MODEL —
+    # the probe must be observation-only
+    fmask = jnp.ones((f,), bool)
+    grad_m = grad * mask
+    hess_m = hess * mask
+    layout = learner.hist_layout
+    out = timings if timings is not None else {}
+    for k in ("hist_s", "split_s", "partition_s"):
+        out.setdefault(k, 0.0)
+    out.setdefault("probe_steps", 0)
+
+    def timed_call(key, fn, *args, **kwargs):
+        t0 = time.perf_counter()
+        res = fn(*args, **kwargs)
+        jax.block_until_ready(res)
+        out[key] += time.perf_counter() - t0
+        return res
+
+    rhist = timed_call("hist_s", stage["root_hist"], cfg, bins, grad_m,
+                       hess_m, mask, layout)
+    state = timed_call("split_s", stage["root_scan"], cfg, rhist,
+                       ds.num_bins_per_feature, ds.has_missing_per_feature,
+                       fmask, learner.monotone, learner.is_cat_f,
+                       learner.bmap, learner.gain_scale, n, f)
+    for _ in range(cfg.num_leaves - 1):
+        if float(jnp.max(state.best_gain)) <= K_EPSILON:
+            break
+        state, bl, nl = timed_call(
+            "partition_s", stage["partition"], cfg, state, bins,
+            ds.num_bins_per_feature, ds.has_missing_per_feature,
+            learner.monotone, learner.bmap)
+        hist_l, hist_r = timed_call(
+            "hist_s", stage["child_hists"], cfg, bins, state.row_leaf, bl,
+            nl, grad_m, hess_m, mask, layout)
+        state = timed_call(
+            "split_s", stage["scan"], cfg, state, hist_l, hist_r, bl, nl,
+            ds.num_bins_per_feature, ds.has_missing_per_feature, fmask,
+            learner.monotone, learner.is_cat_f, learner.bmap,
+            learner.gain_scale)
+        out["probe_steps"] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Collective probe: one real psum of the histogram shape on the real mesh
+# ---------------------------------------------------------------------------
+class _CommProbe:
+    def __init__(self, mesh, axis: str, shape):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import compat_shard_map
+        ndev = int(mesh.devices.size)
+        spec = P(axis, *([None] * len(shape)))
+
+        def psum_local(x):
+            return jax.lax.psum(x, axis)
+
+        self._fn = jax.jit(compat_shard_map(
+            psum_local, mesh=mesh, in_specs=(spec,), out_specs=spec))
+        self._x = jax.device_put(
+            jnp.ones((ndev,) + tuple(shape), jnp.float32),
+            NamedSharding(mesh, spec))
+        self._fn(self._x).block_until_ready()     # compile outside the clock
+
+    def measure(self) -> float:
+        import jax
+        t0 = time.perf_counter()
+        jax.block_until_ready(self._fn(self._x))
+        return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# The per-iteration collector GBDT drives
+# ---------------------------------------------------------------------------
+class TrainingTelemetry:
+    """Collects one record per boosting iteration; attached to a GBDT when
+    ``telemetry=on``.  Records are plain dicts (JSON-ready) — the engine
+    streams them to the per-rank JSONL log and ``Booster.telemetry_stats``
+    exposes them to callers/callbacks."""
+
+    def __init__(self, probe: bool = True, probe_every: int = 1):
+        self.records: List[Dict] = []
+        self.probe_enabled = probe
+        self.probe_every = max(int(probe_every), 1)
+        self._cur: Optional[Dict] = None
+        self._t0 = 0.0
+        self._span_cm = None
+        self._probe_warmed = False
+        self._comm_probe: Optional[_CommProbe] = None
+        self._comm_probe_key = None
+        self._c_iters = REGISTRY.counter(
+            "lgbm_train_iterations_total", "boosting iterations completed")
+        self._h_iter = REGISTRY.histogram(
+            "lgbm_train_iteration_seconds", "wall time per boosting "
+            "iteration", buckets=_ITER_BUCKETS)
+
+    # -- iteration lifecycle -------------------------------------------
+    def start_iteration(self, iteration: int) -> None:
+        if self._cur is not None:      # unbalanced start: close the old one
+            self.finish_iteration()
+        cc, cs = compile_tracker.snapshot()
+        self._cur = {"iteration": int(iteration),
+                     "grad_s": 0.0, "grow_s": 0.0, "apply_s": 0.0,
+                     "comm_s": 0.0, "checkpoint_s": 0.0,
+                     "hist_s": None, "split_s": None, "partition_s": None,
+                     "_cc": cc, "_cs": cs}
+        self._t0 = time.perf_counter()
+        self._span_cm = spans.span("train::iteration", iteration=iteration)
+        self._span_cm.__enter__()
+
+    def add(self, key: str, seconds: float) -> None:
+        if self._cur is not None:
+            base = self._cur.get(key)
+            self._cur[key] = (base or 0.0) + float(seconds)
+
+    def finish_iteration(self) -> None:
+        cur, self._cur = self._cur, None
+        if cur is None:
+            return
+        if self._span_cm is not None:
+            self._span_cm.__exit__(None, None, None)
+            self._span_cm = None
+        cur["iter_s"] = time.perf_counter() - self._t0
+        cc, cs = compile_tracker.snapshot()
+        cur["compile_count"] = cc - cur.pop("_cc")
+        cur["compile_s"] = round(cs - cur.pop("_cs"), 6)
+        self.records.append(cur)
+        self._c_iters.inc()
+        self._h_iter.observe(cur["iter_s"])
+
+    def annotate_last(self, key: str, seconds: float) -> None:
+        """Attach a post-iteration cost (engine checkpoint save) to the
+        most recent record."""
+        if self.records:
+            self.records[-1][key] = (self.records[-1].get(key) or 0.0) \
+                + float(seconds)
+
+    # -- probes ---------------------------------------------------------
+    def probe(self, learner, grad, hess, mask) -> None:
+        if not self.probe_enabled or self._cur is None:
+            return
+        if self._cur["iteration"] % self.probe_every != 0:
+            return
+        if not self._probe_warmed:
+            # first call pays the staged programs' compiles; run once
+            # untimed so compile time never masquerades as phase time
+            run_staged_probe(learner, grad, hess, mask, timings={})
+            self._probe_warmed = True
+        timings = {k: v for k, v in self._cur.items()
+                   if k in ("hist_s", "split_s", "partition_s")
+                   and v is not None}
+        res = run_staged_probe(learner, grad, hess, mask, timings=timings)
+        if res is not None:
+            self._cur.update({k: res[k] for k in
+                              ("hist_s", "split_s", "partition_s")})
+            self._cur["probe_steps"] = res["probe_steps"]
+
+    def comm(self, learner, n_hist_reductions: int) -> None:
+        """Measured collective probe for parallel learners: one psum of
+        the histogram shape on the learner's mesh, scaled by the number of
+        histogram reductions this iteration performed (root + one per
+        split for data-parallel; voting's elected-feature psums are
+        approximated with the same shape).  Data/voting only: the
+        feature-parallel learner performs no histogram reductions (its
+        comm is tiny split-decision exchanges), so a histogram-shaped
+        probe would fabricate a comm_s it never pays."""
+        from ..parallel.data_parallel import DataParallelTreeLearner
+        if not isinstance(learner, DataParallelTreeLearner):
+            return
+        mesh = getattr(learner, "mesh", None)
+        ax = getattr(learner, "AXIS", None)
+        if mesh is None or ax is None or self._cur is None:
+            return
+        if int(mesh.devices.size) <= 1:
+            return
+        try:
+            g = int(getattr(learner, "sharded_bins").shape[1])
+        except AttributeError:
+            g = int(np.asarray(
+                learner.dataset.num_bins_per_feature).shape[0])
+        shape = (g, int(learner.grower_cfg.num_bins), 3)
+        key = (id(mesh), shape)
+        try:
+            if self._comm_probe is None or self._comm_probe_key != key:
+                self._comm_probe = _CommProbe(mesh, ax, shape)
+                self._comm_probe_key = key
+            per_psum = self._comm_probe.measure()
+        except Exception:
+            # a mesh the probe cannot drive (API drift, feature-parallel
+            # layouts) must not take training down; comm stays unreported
+            self._cur["comm_s"] = None
+            return
+        self.add("comm_s", per_psum * max(int(n_hist_reductions), 0))
+
+    # -- summaries ------------------------------------------------------
+    def summary(self) -> Dict:
+        recs = self.records
+        out: Dict = {"iterations": len(recs)}
+        if not recs:
+            return out
+
+        def mean(key):
+            vals = [r[key] for r in recs
+                    if isinstance(r.get(key), (int, float))]
+            return (sum(vals) / len(vals)) if vals else None
+
+        for key in ("iter_s",) + PHASE_KEYS:
+            out[key] = mean(key)
+        out["compile_count"] = sum(int(r.get("compile_count") or 0)
+                                   for r in recs)
+        out["compile_s"] = round(sum(float(r.get("compile_s") or 0.0)
+                                     for r in recs), 6)
+        return out

@@ -1,10 +1,17 @@
-"""Labeled phase timers + optional device profiler traces.
+"""Labeled phase timers + optional device profiler traces — compat shims.
 
-TPU-native equivalent of the reference's compile-time-gated label timer
-(Common::Timer / FunctionTimer, utils/common.h:953-1017; singleton
-global_timer printed at exit, src/boosting/gbdt.cpp:20).  Differences by
-design: enabled at runtime via ``LIGHTGBM_TPU_TIMETAG=1`` (the reference
-needs a -DTIMETAG rebuild), and ``device_trace`` wraps ``jax.profiler`` so a
+Historically this module owned the timing state (the TPU-native equivalent
+of the reference's compile-time label timer, Common::Timer /
+FunctionTimer, utils/common.h:953-1017).  The state now lives in the
+unified telemetry subsystem: ``timed`` is a thin wrapper over
+``telemetry.spans.span`` and ``global_timer`` IS the span engine's
+aggregate, so existing call sites keep working unchanged while their
+timings also feed span recording and the exporters.
+
+Enablement is runtime state (``set_enabled``) rather than frozen at
+import; ``LIGHTGBM_TPU_TIMETAG=1`` remains the env-var default (the
+reference needs a -DTIMETAG rebuild), and ``telemetry=on`` in the config
+flips it programmatically.  ``device_trace`` wraps ``jax.profiler`` so a
 phase can capture an XLA/TPU trace for xprof (the reference has no device
 tracing story at all).
 """
@@ -12,69 +19,29 @@ tracing story at all).
 from __future__ import annotations
 
 import atexit
-import os
-import threading
-import time
 from contextlib import contextmanager
-from typing import Dict
 
-__all__ = ["global_timer", "timed", "device_trace", "timers_enabled"]
+from .telemetry import spans as _spans
+from .telemetry.spans import PhaseTimer, global_timer
 
-_ENABLED = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+__all__ = ["global_timer", "timed", "device_trace", "timers_enabled",
+           "set_enabled", "PhaseTimer"]
 
 
 def timers_enabled() -> bool:
-    return _ENABLED
+    return _spans.enabled()
 
 
-class PhaseTimer:
-    """name -> accumulated seconds, printed at exit (reference
-    Common::Timer::Print semantics)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.acc: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
-
-    def add(self, name: str, seconds: float) -> None:
-        with self._lock:
-            self.acc[name] = self.acc.get(name, 0.0) + seconds
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def report(self) -> str:
-        lines = ["LightGBM-TPU phase timers:"]
-        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
-            lines.append(f"  {name}: {self.acc[name]:.3f}s "
-                         f"({self.counts[name]} calls)")
-        return "\n".join(lines)
-
-    def reset(self) -> None:
-        with self._lock:
-            self.acc.clear()
-            self.counts.clear()
+def set_enabled(value: bool) -> None:
+    """Flip the phase timers at runtime (tests / ``telemetry=on``); the
+    env var only sets the import-time default."""
+    _spans.set_enabled(value)
 
 
-global_timer = PhaseTimer()
-
-
-@contextmanager
-def timed(name: str, sync=None):
-    """Accumulate wall-clock under `name` when timers are enabled.
-
-    sync: optional array/pytree to block_until_ready before stopping the
-    clock, so async-dispatched device work is attributed to the phase that
-    launched it instead of whoever syncs next."""
-    if not _ENABLED:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        if sync is not None:
-            import jax
-            jax.block_until_ready(sync)
-        global_timer.add(name, time.perf_counter() - t0)
+# ``timed(name, sync=None)``: same contract as before — accumulate
+# wall-clock under `name` when timers are enabled, blocking on `sync`
+# first so async device work is attributed to the phase that launched it.
+timed = _spans.span
 
 
 @contextmanager
@@ -91,6 +58,6 @@ def device_trace(log_dir: str):
 
 @atexit.register
 def _print_at_exit():
-    if _ENABLED and global_timer.acc:
+    if _spans.enabled() and global_timer.acc:
         from .log import log_info
         log_info(global_timer.report())

@@ -1,0 +1,383 @@
+"""Unified telemetry subsystem (lightgbm_tpu/telemetry/): spans, metrics
+registry, training stats, exporters, serving Prometheus endpoint."""
+
+import json
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import spans
+from lightgbm_tpu.telemetry.registry import MetricsRegistry
+from lightgbm_tpu.telemetry.export import (chrome_trace, prometheus_text,
+                                           write_chrome_trace)
+
+
+@pytest.fixture(autouse=True)
+def _span_state():
+    """Save/restore the span engine's runtime switches and buffers so
+    telemetry tests never leak state into (or inherit it from) the rest
+    of the suite."""
+    was_enabled = spans.enabled()
+    was_recording = spans.recording()
+    spans.clear_recorded()
+    yield
+    spans.set_enabled(was_enabled)
+    spans.set_recording(was_recording)
+    spans.clear_recorded()
+    spans.set_context(rank=None, iteration=None)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+def test_span_nesting_parent_tracking():
+    spans.set_enabled(True)
+    spans.set_recording(True)
+    with spans.span("outer", label="x") as outer:
+        with spans.span("inner") as inner:
+            assert inner.parent_id == outer.id
+            assert inner.parent_name == "outer"
+        with spans.span("inner2") as inner2:
+            assert inner2.parent_id == outer.id
+    assert outer.parent_id is None
+    recorded = spans.recorded_spans()
+    names = [s.name for s in recorded]
+    # children finish (and record) before the parent
+    assert names == ["inner", "inner2", "outer"]
+    assert recorded[2].dur_s >= recorded[0].dur_s
+    assert recorded[2].attrs["label"] == "x"
+
+
+def test_span_thread_safety_and_isolation():
+    spans.set_enabled(True)
+    spans.set_recording(True)
+    errors = []
+
+    def worker(i):
+        try:
+            for _ in range(50):
+                with spans.span(f"t{i}::outer") as outer:
+                    with spans.span(f"t{i}::inner") as inner:
+                        # parent tracking is thread-local: never another
+                        # thread's span
+                        assert inner.parent_id == outer.id
+                        assert inner.parent_name == f"t{i}::outer"
+        except Exception as exc:       # surfaced after join
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    agg = spans.global_timer.counts
+    for i in range(8):
+        assert agg.get(f"t{i}::inner") == 50
+        assert agg.get(f"t{i}::outer") == 50
+
+
+def test_timer_runtime_set_enabled():
+    """Satellite: enablement is runtime state, not frozen at import — the
+    timed() shim starts/stops accumulating without re-importing."""
+    from lightgbm_tpu import timer
+    timer.set_enabled(False)
+    before = dict(timer.global_timer.counts)
+    with timer.timed("runtime_flip_probe"):
+        pass
+    assert timer.global_timer.counts.get("runtime_flip_probe") \
+        == before.get("runtime_flip_probe")
+    timer.set_enabled(True)
+    assert timer.timers_enabled()
+    with timer.timed("runtime_flip_probe"):
+        pass
+    assert timer.global_timer.counts.get("runtime_flip_probe", 0) \
+        == (before.get("runtime_flip_probe") or 0) + 1
+
+
+def test_disabled_spans_record_nothing():
+    spans.set_enabled(False)
+    with spans.span("off_probe") as s:
+        assert s is None
+    assert "off_probe" not in spans.global_timer.counts
+    assert all(x.name != "off_probe" for x in spans.recorded_spans())
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+def test_registry_counters_gauges_and_identity():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests", model="a")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    # get-or-create: same (name, labels) -> same instrument
+    assert reg.counter("req_total", model="a") is c
+    assert reg.counter("req_total", model="b") is not c
+    g = reg.gauge("depth")
+    g.set(7)
+    assert g.value == 7
+    with pytest.raises(ValueError):
+        c.inc(-1)                      # counters only go up
+    with pytest.raises(ValueError):
+        reg.gauge("req_total")         # kind conflict
+    snap = reg.snapshot()
+    assert snap["req_total"]["model=a"] == 3
+    assert snap["depth"]["_"] == 7
+
+
+def test_registry_histogram_percentile_math():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 2.0, 4.0, 8.0))
+    # 100 observations uniform over (0, 1]: everything in the first bucket
+    for i in range(100):
+        h.observe((i + 1) / 100.0)
+    assert h.count == 100
+    assert abs(h.sum - 50.5) < 1e-9
+    # linear interpolation inside [0, 1]: p50 ~ 0.5
+    assert 0.4 <= h.percentile(50) <= 0.6
+    assert h.percentile(100) <= 1.0
+    # push the tail into the second bucket
+    for _ in range(100):
+        h.observe(1.5)
+    p75 = h.percentile(75)             # 150th of 200 -> inside (1, 2]
+    assert 1.0 <= p75 <= 2.0
+    # above the last bound: +inf bucket reports the last edge, never an
+    # invented tail
+    h2 = reg.histogram("lat2", buckets=(1.0,))
+    h2.observe(100.0)
+    assert h2.percentile(99) == 1.0
+    assert h2.bucket_counts()[-1] == (math.inf, 1)
+
+
+def test_prometheus_text_golden():
+    reg = MetricsRegistry()
+    reg.counter("lgbm_req_total", "requests served", model="m").inc(3)
+    reg.gauge("lgbm_depth", "queue depth").set(2.5)
+    h = reg.histogram("lgbm_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    golden = (
+        '# HELP lgbm_depth queue depth\n'
+        '# TYPE lgbm_depth gauge\n'
+        'lgbm_depth 2.5\n'
+        '# HELP lgbm_lat_seconds latency\n'
+        '# TYPE lgbm_lat_seconds histogram\n'
+        'lgbm_lat_seconds_bucket{le="0.1"} 1\n'
+        'lgbm_lat_seconds_bucket{le="1"} 2\n'
+        'lgbm_lat_seconds_bucket{le="+Inf"} 2\n'
+        'lgbm_lat_seconds_sum 0.55\n'
+        'lgbm_lat_seconds_count 2\n'
+        '# HELP lgbm_req_total requests served\n'
+        '# TYPE lgbm_req_total counter\n'
+        'lgbm_req_total{model="m"} 3\n'
+    )
+    assert prometheus_text(reg) == golden
+    # passing the same registry twice must not duplicate families
+    assert prometheus_text(reg, reg) == golden
+
+
+def test_chrome_trace_loads(tmp_path):
+    spans.set_enabled(True)
+    spans.set_recording(True)
+    with spans.span("phase_a", iteration=3):
+        with spans.span("phase_b"):
+            pass
+    path = str(tmp_path / "trace.json")
+    write_chrome_trace(path)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert "traceEvents" in doc
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} >= {"phase_a", "phase_b"}
+    for e in evs:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert key in e
+    a = next(e for e in evs if e["name"] == "phase_a")
+    assert a["args"]["iteration"] == 3
+
+
+# ---------------------------------------------------------------------------
+# training stats
+# ---------------------------------------------------------------------------
+def _train_data(n=600, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_training_stats_serial(tmp_path):
+    X, y = _train_data()
+    tdir = str(tmp_path / "tele")
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 7,
+              "telemetry": "on", "telemetry_dir": tdir}
+    bst = lgb.train(params, lgb.Dataset(X, y), 3)
+    recs = bst.telemetry_stats()
+    assert recs is not None and len(recs) == 3
+    for r in recs:
+        for key in ("iter_s", "grad_s", "grow_s", "apply_s", "hist_s",
+                    "split_s", "partition_s", "comm_s", "checkpoint_s",
+                    "compile_count", "compile_s"):
+            assert key in r, key
+        assert r["iter_s"] > 0 and r["grow_s"] > 0
+        # serial: staged probe runs, collectives don't exist
+        assert r["hist_s"] > 0 and r["split_s"] > 0 and r["partition_s"] > 0
+        assert r["comm_s"] == 0.0
+    summ = bst.telemetry_summary()
+    assert summ["iterations"] == 3 and summ["grow_s"] > 0
+    # per-rank JSONL + chrome trace written under telemetry_dir
+    jl = os.path.join(tdir, "telemetry_rank0.jsonl")
+    assert os.path.exists(jl)
+    kinds = [json.loads(line)["kind"] for line in open(jl)]
+    assert kinds.count("iteration") == 3
+    assert "summary" in kinds and "span" in kinds
+    assert os.path.exists(os.path.join(tdir, "trace_rank0.json"))
+    # off by default: no stats, and the model is unaffected by telemetry
+    bst_off = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7}, lgb.Dataset(X, y), 3)
+    assert bst_off.telemetry_stats() is None
+    assert bst_off.num_trees() == bst.num_trees()
+
+
+def test_training_stats_checkpoint_time(tmp_path):
+    X, y = _train_data()
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7, "telemetry": True},
+                    lgb.Dataset(X, y), 3,
+                    checkpoint_dir=str(tmp_path / "ck"), checkpoint_freq=1)
+    recs = bst.telemetry_stats()
+    assert len(recs) == 3
+    # every iteration saved a checkpoint -> engine attributed its wall time
+    assert all(r["checkpoint_s"] > 0 for r in recs)
+
+
+def test_training_stats_data_parallel_injected():
+    """Injected-collective data-parallel (single-process 2-device mesh):
+    per-iteration stats must be present; comm_s is the measured collective
+    probe (>0 on a >1-device mesh); the staged hist/split/partition probe
+    is serial-only and reports None rather than a fabricated number."""
+    X, y = _train_data(n=1200)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 7,
+              "tree_learner": "data", "num_machines": 2,
+              "num_tpu_devices": 2, "telemetry": "on"}
+    try:
+        bst = lgb.train(params, lgb.Dataset(X, y, params=params), 3)
+    except TypeError as exc:
+        if "check_vma" in str(exc) or "check_rep" in str(exc):
+            # the data-parallel learner's pinned shard_map kwarg doesn't
+            # match this environment's jax (pre-existing drift, documented
+            # at seed); telemetry isn't what's broken here
+            pytest.skip(f"jax shard_map kwarg drift: {exc}")
+        raise
+    recs = bst.telemetry_stats()
+    assert recs is not None and len(recs) == 3
+    for r in recs:
+        assert r["iter_s"] > 0 and r["grow_s"] > 0
+        assert r["comm_s"] is None or r["comm_s"] > 0
+        assert r["hist_s"] is None and r["partition_s"] is None
+    assert bst.num_trees() == 3
+
+
+def test_record_telemetry_callback():
+    X, y = _train_data()
+    result = {}
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7, "telemetry": True},
+                    lgb.Dataset(X, y), 3,
+                    callbacks=[lgb.record_telemetry(result)])
+    assert len(result["iterations"]) == 3
+    assert result["summary"]["iterations"] == 3
+    assert bst.num_trees() == 3
+    # off -> the callback stays silent instead of erroring
+    result2 = {}
+    lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7},
+              lgb.Dataset(X, y), 2,
+              callbacks=[lgb.record_telemetry(result2)])
+    assert result2 == {}
+
+
+# ---------------------------------------------------------------------------
+# serving endpoint
+# ---------------------------------------------------------------------------
+def test_serving_prometheus_endpoint():
+    from lightgbm_tpu.serving.server import ServingApp
+    X, y = _train_data()
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, y), 3)
+    app = ServingApp(batching=False)
+    app.registry.publish("m", booster=bst)
+    status, _ = app.handle("POST", "/v1/models/m:predict",
+                           {"rows": X[:4].tolist()})
+    assert status == 200
+    # JSON metrics route unchanged
+    status, snap = app.handle("GET", "/v1/metrics")
+    assert status == 200 and snap["m"]["requests"] == 1
+    # additive Prometheus text route
+    status, text = app.handle("GET", "/v1/metrics/prometheus")
+    assert status == 200 and isinstance(text, str)
+    assert '# TYPE lgbm_serving_requests_total counter' in text
+    assert 'lgbm_serving_requests_total{model="m"} 1' in text
+    assert 'lgbm_serving_rows_total{model="m"} 4' in text
+    assert 'lgbm_serving_request_latency_seconds_count{model="m"} 1' in text
+    assert 'lgbm_serving_compile_count{model="m"}' in text
+    # parses as prometheus exposition: every non-comment line is
+    # "name{labels} value" with a float-parseable value
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        name_part, value = line.rsplit(" ", 1)
+        assert name_part
+        float(value.replace("+Inf", "inf"))
+    app.close()
+
+
+def test_serving_metrics_isolated_registries():
+    """Two ServingMetrics instances (two apps / two tests) must not share
+    counter state — each owns its registry."""
+    from lightgbm_tpu.serving.metrics import ServingMetrics
+    m1 = ServingMetrics()
+    m2 = ServingMetrics()
+    m1.model("a").record_request(5)
+    assert m1.model("a").requests == 1
+    assert m2.model("a").requests == 0
+    assert m1.registry is not m2.registry
+
+
+# ---------------------------------------------------------------------------
+# cluster rollup (multiprocess)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_cluster_telemetry_rollup(tmp_path):
+    """2-worker job with telemetry=on: each rank writes its JSONL, the
+    supervisor rolls them up into telemetry_summary.json on exit."""
+    from lightgbm_tpu.cluster import train_distributed
+
+    def make_data(rank, num_workers):
+        rng = np.random.RandomState(0)
+        X = rng.randn(2000, 5)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        return X, y, None
+
+    tdir = str(tmp_path / "tele")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20, "tree_learner": "serial",
+              "telemetry": "on", "telemetry_dir": tdir}
+    bst = train_distributed(params, make_data, num_boost_round=4,
+                            num_workers=2, platform="cpu", timeout=600)
+    assert bst.num_trees() == 4
+    summary_path = os.path.join(tdir, "telemetry_summary.json")
+    assert os.path.exists(summary_path)
+    with open(summary_path) as fh:
+        summary = json.load(fh)
+    assert summary["ranks"] == 2
+    # every rank ran every iteration (synchronous SPMD)
+    assert summary["total_iterations"] == 8
+    for rank in ("0", "1"):
+        assert summary["per_rank"][rank]["iterations"] == 4
+        assert summary["per_rank"][rank]["per_iter_s"] > 0
