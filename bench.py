@@ -45,9 +45,15 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  breakdown (hist_s/split_s/partition_s/comm_s/checkpoint_s
                  means) from a 3-iter telemetry=on probe, also outside the
                  headline (telemetry unfuses the train step by design).
+                 `aot` adds fused_per_iter_s / aot_load_s /
+                 compiles_steady from a cold-start-with-bundle probe
+                 (lightgbm_tpu/aot/; compiles_steady == 0 is the bar).
 - serve          serving throughput/latency through lightgbm_tpu/serving/:
-                 sustained rows/s, p50/p99 latency, batch-fill ratio, and a
-                 steady-state compile count (run_serving).  Tuning knobs:
+                 sustained rows/s, p50/p99 latency, batch-fill ratio, a
+                 steady-state compile count, and a cold-start-with-bundle
+                 probe (`cold_start_with_bundle`: a fresh predictor warmed
+                 from a serialized AOT bundle; cold_start_compiles == 0 is
+                 the bar) (run_serving).  Tuning knobs:
                  BENCH_SERVE_{TREES,THREADS,MAX_REQ_ROWS,SECONDS,TRAIN_ROWS}.
 - hist           histogram microbenchmark (run_hist): rows*features/s per
                  impl x bin-width class x contraction dtype, one JSON line
@@ -227,6 +233,45 @@ def run_training():
     finally:
         shutil.rmtree(ckpt_dir2, ignore_errors=True)
 
+    # AOT probe (lightgbm_tpu/aot/): run an 8-round fused-block train
+    # twice against a fresh bundle — the first populates it (and pays the
+    # compiles), the second is the COLD-START model: a fresh booster that
+    # must deserialize its programs instead of compiling.  Reported:
+    # fused_per_iter_s (steady per-round cost of the K=8 scan program),
+    # aot_load_s (bundle deserialize time inside the second run), and
+    # compiles_steady (XLA backend compiles during the second run — the
+    # acceptance bar is 0).
+    aot = {}
+    aot_dir = tempfile.mkdtemp(prefix="lgbm_bench_aot_")
+    try:
+        from lightgbm_tpu.telemetry.training import compile_tracker
+        compile_tracker.install()
+        ap = dict(params)
+        ap["aot_bundle_dir"] = aot_dir
+        ap["fused_rounds"] = 8
+        bst_w = lgb.train(ap, train_set, num_boost_round=8)
+        bst_w.num_trees()
+        c0 = compile_tracker.snapshot()[0]
+        t0 = time.time()
+        bst_a = lgb.train(ap, train_set, num_boost_round=8)
+        bst_a.num_trees()              # forces the lazy flush -> full sync
+        fused_wall = time.time() - t0
+        load_s = bst_a._gbdt.aot_stats.get("aot_load_s", 0.0)
+        aot = {
+            # steady per-round cost of the K=8 scan program: the one-time
+            # bundle deserialize is reported separately as aot_load_s, not
+            # smeared into the per-iteration figure
+            "fused_per_iter_s": round(max(fused_wall - load_s, 0.0) / 8.0, 4),
+            "aot_load_s": round(
+                bst_a._gbdt.aot_stats.get("aot_load_s", -1.0), 4),
+            "aot_programs_loaded": bst_a._gbdt.aot_stats.get("loaded", 0),
+            "compiles_steady": compile_tracker.snapshot()[0] - c0,
+        }
+    except Exception as exc:
+        aot = {"error": repr(exc)[-200:]}     # honest failure marker
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
     ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
     our_work = rows * iters
     ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
@@ -243,6 +288,7 @@ def run_training():
         "checkpoint_s": round(checkpoint_s, 4),
         "checkpoint_frac": round(checkpoint_frac, 4),
         "telemetry": telemetry,
+        "aot": aot,
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,
@@ -326,6 +372,31 @@ def run_serving():
             t.join()
         elapsed = time.time() - t0
 
+    # cold-start-with-bundle probe (lightgbm_tpu/aot/): serialize the
+    # warmed ladder, then stand up a FRESH predictor that loads it —
+    # the replica-restart path.  cold_start_compiles == 0 is the bar.
+    import shutil
+    import tempfile
+    cold = {}
+    aot_dir = tempfile.mkdtemp(prefix="lgbm_bench_serve_aot_")
+    try:
+        saved = pred.save_bundle(aot_dir)
+        t0 = time.time()
+        pred_cold = bst.to_compiled()
+        loaded = pred_cold.load_bundle(aot_dir, kinds=("prob",))
+        bundle_load_s = time.time() - t0
+        pred_cold.predict(pool[:max_req])     # serve through a loaded program
+        cold = {
+            "bundle_programs_saved": saved,
+            "bundle_programs_loaded": loaded,
+            "bundle_load_s": round(bundle_load_s, 4),
+            "cold_start_compiles": pred_cold.compile_count,
+        }
+    except Exception as exc:
+        cold = {"error": repr(exc)[-200:]}     # honest failure marker
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
     snap = metrics.snapshot(pred.compile_count)
     rows_s = sum(sent) / max(elapsed, 1e-9)
     print("BENCH_RESULT " + json.dumps({
@@ -340,6 +411,7 @@ def run_serving():
         "direct_rows_s": round(direct_rows_s, 1),
         "warmup_compiles": warmup_compiles,
         "steady_compiles": pred.compile_count - warmup_compiles,
+        "cold_start_with_bundle": cold,
         "requests": snap["requests"],
         "errors": len(errors),
         "setup_s": round(setup_s, 3),

@@ -37,6 +37,7 @@ from .callback import (checkpoint_callback, early_stopping, log_evaluation,
 from .config import Config
 from .engine import CVBooster, cv, train
 from .log import LightGBMError, register_log_callback
+from . import aot
 from . import telemetry
 
 __version__ = "0.1.0"
@@ -45,7 +46,7 @@ __all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
            "Config", "LightGBMError", "register_log_callback",
            "early_stopping", "log_evaluation", "print_evaluation",
            "record_evaluation", "record_telemetry", "reset_parameter",
-           "checkpoint_callback", "telemetry", "__version__"]
+           "checkpoint_callback", "telemetry", "aot", "__version__"]
 
 
 def __getattr__(name):

@@ -1,0 +1,29 @@
+"""Ahead-of-time compilation subsystem.
+
+Two halves (see bundle.py and precompile.py):
+
+- **Program bundles** (``ProgramBundle``): versioned on-disk artifacts —
+  a manifest plus serialized XLA executables keyed by a structured
+  signature (shapes, dtypes, config fingerprint, jax/backend/topology).
+  Consumers go through ``resolve_program``: load on a signature match,
+  recompile (with the differing keys logged) on any mismatch, and save
+  the fresh executable back so the next cold process loads instead.
+
+- **Precompilation** (``precompile_training`` / ``precompile_predictor``,
+  CLI ``task=precompile``): build every program a run will need — the
+  fused multi-round training blocks for a dataset's exact shapes, the
+  serving predictor's bucket ladder — ahead of time, so trainers,
+  checkpoint-restarted workers, and serving replicas all start warm with
+  zero steady-state XLA compiles.
+"""
+
+from .bundle import (BUNDLE_VERSION, ProgramBundle, describe_mismatch,
+                     resolve_program, runtime_signature,
+                     signature_fingerprint)
+from .precompile import (default_bundle_dir, precompile_predictor,
+                         precompile_training)
+
+__all__ = ["BUNDLE_VERSION", "ProgramBundle", "describe_mismatch",
+           "resolve_program", "runtime_signature", "signature_fingerprint",
+           "default_bundle_dir", "precompile_predictor",
+           "precompile_training"]

@@ -1,0 +1,284 @@
+"""Versioned AOT program bundles: serialized XLA executables as artifacts.
+
+The reference ships AOT-compiled kernels inside its binary, so a cold
+process pays zero compilation; the JAX stack instead JIT-compiles the
+grower/predict programs on first use — BENCH_r05 measured 17.3 s of that
+against 7.2 s of actual boosting.  A ``ProgramBundle`` closes the gap by
+making compilation a *build artifact*: executables are AOT-lowered once
+(``jax.jit(...).lower(...).compile()``), serialized with
+``jax.experimental.serialize_executable``, and persisted next to the model
+as a manifest + one program file per entry.  A later process (trainer,
+restarted worker, serving replica) deserializes instead of compiling.
+
+Every entry carries a structured **signature** — shapes, dtypes, config
+fingerprint, jax version, backend, device count — and loading is
+load-or-recompile: any mismatch falls back to a fresh compile with the
+differing keys logged, never a wrong or crashing program.  All IO goes
+through the ``io/file_io`` scheme registry, so bundles live wherever
+checkpoints do (local disk, ``file://``, or any registered scheme).
+
+Layout (``bundle_dir/``)::
+
+    MANIFEST.json                  {"bundle_version": 1, "programs": {...}}
+    <name>.xprog                   pickled (blob, in_tree, out_tree)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..io import file_io
+from ..log import log_info, log_warning
+
+__all__ = ["BUNDLE_VERSION", "ProgramBundle", "runtime_signature",
+           "signature_fingerprint", "describe_mismatch", "resolve_program",
+           "serializable_compiles"]
+
+
+@contextlib.contextmanager
+def serializable_compiles():
+    """Compile with jax's persistent compilation cache OFF.
+
+    An executable that jax itself loaded from its persistent cache
+    re-serializes INCOMPLETELY on the CPU backend — the blob drops the
+    parallel-codegen split modules and deserialization dies with
+    "Symbols not found" (verified on jax 0.4.37).  Anything destined for
+    a bundle must therefore come from a genuine codegen run; the bundle
+    replaces the persistent cache for these programs anyway."""
+    import jax
+
+    def _reset():
+        # jax memoizes the is-cache-used decision per process; without a
+        # reset the flag flip is silently ignored (same trap
+        # compile_cache.py documents for the cache DIR update)
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    try:
+        prev = bool(jax.config.jax_enable_compilation_cache)
+    except AttributeError:        # config name drift: nothing to disable
+        yield
+        return
+    jax.config.update("jax_enable_compilation_cache", False)
+    _reset()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
+        _reset()
+
+BUNDLE_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def runtime_signature() -> Dict:
+    """The runtime facts a serialized executable is only valid for: an XLA
+    executable is compiled for one backend/topology and one jax version —
+    loading it anywhere else is undefined, so these keys gate every load."""
+    import jax
+    return {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": int(jax.device_count()),
+        "process_count": int(jax.process_count()),
+    }
+
+
+def _canonical(sig: Dict) -> Dict:
+    """JSON round-trip so tuples/np scalars compare equal to their loaded
+    (list/int) forms."""
+    return json.loads(json.dumps(sig, sort_keys=True, default=str))
+
+
+def signature_fingerprint(sig: Dict) -> str:
+    blob = json.dumps(_canonical(sig), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def describe_mismatch(expected: Dict, found: Dict) -> str:
+    """Human-readable reason string naming exactly which signature keys
+    differ (the logged 'why we recompiled')."""
+    expected, found = _canonical(expected), _canonical(found)
+    diffs = []
+    for key in sorted(set(expected) | set(found)):
+        e, f = expected.get(key, "<absent>"), found.get(key, "<absent>")
+        if e != f:
+            diffs.append(f"{key}: bundle has {f!r}, run needs {e!r}")
+    return "; ".join(diffs) if diffs else "signatures differ"
+
+
+def _join(base: str, name: str) -> str:
+    return base.rstrip("/") + "/" + name
+
+
+class ProgramBundle:
+    """One bundle directory: manifest + serialized executables.
+
+    Single-writer semantics like the checkpoint manager: program files are
+    committed tmp+rename, the manifest is rewritten whole (read-modify-
+    write) after each save.  Readers only ever see committed files.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    # -- manifest -------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return _join(self.path, MANIFEST_NAME)
+
+    def _raw_manifest(self) -> Optional[Dict]:
+        if not file_io.exists(self._manifest_path()):
+            return None
+        with file_io.open_readable(self._manifest_path()) as fh:
+            return json.load(fh)
+
+    def manifest(self) -> Dict:
+        man = self._raw_manifest()
+        if man is None:
+            return {"bundle_version": BUNDLE_VERSION, "programs": {}}
+        if int(man.get("bundle_version", -1)) != BUNDLE_VERSION:
+            log_warning(
+                f"aot bundle at {self.path!r} has version "
+                f"{man.get('bundle_version')!r} (this build reads "
+                f"{BUNDLE_VERSION}); ignoring its programs")
+            return {"bundle_version": BUNDLE_VERSION, "programs": {}}
+        man.setdefault("programs", {})
+        return man
+
+    def _write_manifest(self, man: Dict) -> None:
+        # pid-suffixed tmp: saves are rank-0-gated (resolve_program callers)
+        # but an unrelated process racing the same bundle dir must at worst
+        # lose a manifest entry, never interleave bytes in one tmp file
+        tmp = self._manifest_path() + f".tmp.{os.getpid()}"
+        with file_io.open_writable(tmp) as fh:
+            json.dump(man, fh, indent=1, sort_keys=True, default=str)
+        file_io.rename(tmp, self._manifest_path())
+
+    def program_names(self) -> list:
+        return sorted(self.manifest()["programs"])
+
+    def entry(self, name: str) -> Optional[Dict]:
+        return self.manifest()["programs"].get(name)
+
+    # -- save / load ----------------------------------------------------
+    def save_program(self, name: str, signature: Dict, compiled) -> None:
+        """Serialize one compiled executable under ``name`` and commit it
+        (program file tmp+rename first, manifest second — a crash between
+        the two leaves an orphan file, never a dangling manifest entry)."""
+        from jax.experimental import serialize_executable as se
+        raw = self._raw_manifest()
+        if raw is not None and \
+                int(raw.get("bundle_version", -1)) != BUNDLE_VERSION:
+            # never downgrade-clobber a bundle written by another build's
+            # format (manifest() would read it as empty and the rewrite
+            # below would erase every entry the other build saved)
+            raise OSError(
+                f"bundle at {self.path!r} has version "
+                f"{raw.get('bundle_version')!r}; this build writes "
+                f"{BUNDLE_VERSION} and will not overwrite it")
+        blob, in_tree, out_tree = se.serialize(compiled)
+        # verify BEFORE committing: a blob that cannot load back (e.g. the
+        # executable was itself a persistent-cache hit — see
+        # serializable_compiles) must never enter the manifest, where every
+        # later cold start would trip over it
+        se.deserialize_and_load(blob, in_tree, out_tree)
+        file_io.makedirs(self.path)
+        fname = f"{name}.xprog"
+        tmp = _join(self.path, fname + f".tmp.{os.getpid()}")
+        with file_io.open_writable(tmp, binary=True) as fh:
+            pickle.dump((blob, in_tree, out_tree), fh)
+        file_io.rename(tmp, _join(self.path, fname))
+        man = self.manifest()
+        man["programs"][name] = {
+            "file": fname,
+            "signature": _canonical(signature),
+            "fingerprint": signature_fingerprint(signature),
+            "saved_at": time.time(),
+        }
+        self._write_manifest(man)
+
+    def load_program(self, name: str, signature: Dict,
+                     manifest: Optional[Dict] = None
+                     ) -> Tuple[Optional[object], str]:
+        """(executable, "") on a signature match, else (None, reason).
+
+        Never raises for a bad/missing/stale bundle — the caller always has
+        the recompile fallback, so every failure mode reduces to a reason
+        string it can log.  Callers resolving many programs pass one
+        ``manifest()`` snapshot instead of re-reading it per program."""
+        try:
+            if manifest is None:
+                manifest = self.manifest()
+            entry = manifest["programs"].get(name)
+        except Exception as exc:
+            return None, f"unreadable manifest at {self.path!r}: {exc!r}"
+        if entry is None:
+            return None, f"no program {name!r} in bundle {self.path!r}"
+        if entry.get("fingerprint") != signature_fingerprint(signature):
+            return None, describe_mismatch(signature,
+                                           entry.get("signature", {}))
+        try:
+            from jax.experimental import serialize_executable as se
+            with file_io.open_readable(_join(self.path, entry["file"]),
+                                       binary=True) as fh:
+                blob, in_tree, out_tree = pickle.load(fh)
+            return se.deserialize_and_load(blob, in_tree, out_tree), ""
+        except Exception as exc:
+            return None, (f"failed to deserialize {name!r} from "
+                          f"{self.path!r}: {exc!r}")
+
+
+def resolve_program(bundle_dir: str, name: str, signature: Dict,
+                    build_lowered: Callable[[], object],
+                    save_on_miss: bool = True,
+                    stats: Optional[Dict] = None):
+    """Load ``name`` from the bundle or compile it — the subsystem's single
+    load-or-recompile seam.
+
+    ``build_lowered`` is called only on a miss and must return a
+    ``jax.stages.Lowered`` (the caller owns tracing, which needs its
+    arguments).  On a miss the freshly compiled executable is saved back
+    (best-effort) so the *next* cold process loads instead of compiling.
+    ``stats`` (optional dict) accumulates ``aot_load_s`` / ``loaded`` /
+    ``compiled`` for benchmarks and tests.
+    """
+    bundle = ProgramBundle(bundle_dir)
+    t0 = time.perf_counter()
+    compiled, reason = bundle.load_program(name, signature)
+    if compiled is not None:
+        dt = time.perf_counter() - t0
+        log_info(f"aot: loaded program {name!r} from bundle "
+                 f"{bundle_dir!r} in {dt:.3f}s")
+        if stats is not None:
+            stats["aot_load_s"] = stats.get("aot_load_s", 0.0) + dt
+            stats["loaded"] = stats.get("loaded", 0) + 1
+        return compiled, True
+    log_warning(f"aot: compiling {name!r} (bundle miss: {reason})")
+    if save_on_miss:
+        # cache-off is only needed when the result will be serialize()d
+        # (see serializable_compiles); non-writer ranks keep the persistent
+        # compile cache's fast path
+        with serializable_compiles():
+            compiled = build_lowered().compile()
+    else:
+        compiled = build_lowered().compile()
+    if stats is not None:
+        stats["compiled"] = stats.get("compiled", 0) + 1
+    if save_on_miss:
+        try:
+            bundle.save_program(name, signature, compiled)
+            log_info(f"aot: saved program {name!r} to bundle {bundle_dir!r}")
+        except Exception as exc:
+            # an unwritable bundle location must not fail training
+            log_warning(f"aot: could not save {name!r} to "
+                        f"{bundle_dir!r}: {exc!r}")
+    return compiled, False

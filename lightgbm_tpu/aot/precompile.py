@@ -1,0 +1,74 @@
+"""Precompilation entry points: populate a program bundle ahead of time.
+
+The CLI (``task=precompile``, application.py) and bench drive these; both
+halves are also callable directly:
+
+- ``precompile_training(params, train_set, ...)`` AOT-compiles the fused
+  multi-round training blocks for the dataset's exact shapes — every
+  (variant, K) pair a run visits — and persists them to the bundle.  A
+  later ``train()`` with the same ``aot_bundle_dir`` (same machine class,
+  same shapes/config) then loads instead of compiling, which is what makes
+  cold trainer starts, supervised restarts (cluster.py), and repeated CI
+  runs cheap.
+
+- ``precompile_predictor(model, ...)`` warms a serving
+  ``CompiledPredictor``'s bucket ladder and serializes the resulting
+  executables, so a replica can ``load_bundle`` at publish time and serve
+  its first request with zero compiles (serving/compiled.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..log import log_info
+
+__all__ = ["precompile_training", "precompile_predictor",
+           "default_bundle_dir"]
+
+
+def default_bundle_dir(model_path: str) -> str:
+    """The convention for a bundle living next to its model."""
+    return str(model_path) + ".aot"
+
+
+def precompile_training(params: Dict, train_set, bundle_dir: str,
+                        rounds: Optional[int] = None) -> Dict:
+    """AOT-compile the fused training programs for ``train_set``'s shapes
+    into ``bundle_dir`` without training.  Returns a summary dict."""
+    from ..basic import Booster
+    params = dict(params)
+    params["aot_bundle_dir"] = str(bundle_dir)
+    t0 = time.perf_counter()
+    booster = Booster(params=params, train_set=train_set)
+    out = booster._gbdt.precompile_fused(rounds)
+    out["seconds"] = round(time.perf_counter() - t0, 3)
+    out["bundle_dir"] = str(bundle_dir)
+    if not out.get("supported"):
+        log_info("aot precompile: this config has no fused training "
+                 "program (parallel learner, multiclass, custom objective "
+                 "or telemetry=on) — nothing to bundle for training")
+    else:
+        log_info(f"aot precompile: {out['programs']} training program(s) "
+                 f"ready in {out['seconds']}s ({bundle_dir})")
+    return out
+
+
+def precompile_predictor(model, bundle_dir: str, buckets=None, dtype=None,
+                         kinds=("prob", "raw")) -> Dict:
+    """Warm a CompiledPredictor for ``model`` (a Booster or a model file
+    path) across its bucket ladder and serialize every program into
+    ``bundle_dir``.  Returns a summary dict."""
+    from ..basic import Booster
+    if isinstance(model, str):
+        model = Booster(model_file=model)
+    t0 = time.perf_counter()
+    pred = model.to_compiled(buckets=buckets, dtype=dtype)
+    compiled = pred.warmup(kinds=kinds)
+    saved = pred.save_bundle(bundle_dir)
+    dt = round(time.perf_counter() - t0, 3)
+    log_info(f"aot precompile: {saved} predict program(s) "
+             f"({compiled} freshly compiled) ready in {dt}s ({bundle_dir})")
+    return {"programs": saved, "compiled": compiled, "seconds": dt,
+            "bundle_dir": str(bundle_dir)}

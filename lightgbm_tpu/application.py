@@ -79,6 +79,8 @@ class Application:
             self._save_binary()
         elif task == "serve":
             self._serve()
+        elif task == "precompile":
+            self._precompile()
         else:
             raise ValueError(f"unknown task {task!r}")
 
@@ -195,7 +197,10 @@ class Application:
 
     def _serve(self) -> None:
         """task=serve: publish input_model into a registry and run the
-        HTTP inference front-end (lightgbm_tpu/serving/)."""
+        HTTP inference front-end (lightgbm_tpu/serving/).  With an
+        ``aot_bundle_dir`` (populated by task=precompile) the replica
+        warms by deserializing the bundled predict programs instead of
+        compiling them."""
         from .serving.server import ServingApp, serve
         cfg = self.config
         if not cfg.input_model:
@@ -203,11 +208,40 @@ class Application:
         app = ServingApp(max_batch=cfg.serving_max_batch,
                          max_wait_ms=cfg.serving_max_wait_ms,
                          max_queue_rows=cfg.serving_max_queue_rows)
-        version = app.registry.publish(cfg.serving_model_name,
-                                       model_file=cfg.input_model)
+        version = app.registry.publish(
+            cfg.serving_model_name, model_file=cfg.input_model,
+            aot_bundle_dir=cfg.aot_bundle_dir or None)
         log_info(f"serving {cfg.input_model} as "
                  f"{cfg.serving_model_name!r} v{version}")
         serve(app, host=cfg.serving_host, port=cfg.serving_port)
+
+    def _precompile(self) -> None:
+        """task=precompile: populate an AOT program bundle
+        (lightgbm_tpu/aot/) ahead of time.
+
+        With ``data=FILE`` the fused training programs are compiled for
+        that dataset's exact shapes; with ``input_model=FILE`` the serving
+        predictor's bucket ladder is compiled.  Either or both.  The
+        bundle lands in ``aot_bundle_dir`` (default: next to the model —
+        ``<input_model>.aot`` or ``<output_model>.aot``)."""
+        from .aot import (default_bundle_dir, precompile_predictor,
+                          precompile_training)
+        cfg = self.config
+        if not cfg.data and not cfg.input_model:
+            raise ValueError("task=precompile requires data=FILE (training "
+                             "programs), input_model=FILE (serving "
+                             "programs), or both")
+        bundle_dir = cfg.aot_bundle_dir or default_bundle_dir(
+            cfg.input_model or cfg.output_model)
+        if cfg.data:
+            train_set, _, _ = self._build_dataset(cfg.data)
+            out = precompile_training(self.raw_params, train_set, bundle_dir,
+                                      rounds=cfg.fused_rounds)
+            log_info(f"precompile train: {out}")
+        if cfg.input_model:
+            out = precompile_predictor(cfg.input_model, bundle_dir)
+            log_info(f"precompile serve: {out}")
+        log_info(f"Finished precompile; bundle at {bundle_dir}")
 
     def _convert_model(self) -> None:
         from .basic import Booster

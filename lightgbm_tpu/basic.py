@@ -663,6 +663,20 @@ class Booster:
                 return self._gbdt.train_one_iter(grad, hess)
             return self._gbdt.train_one_iter()
 
+    def supports_fused_blocks(self) -> bool:
+        """True when this booster can run multiple rounds as one compiled
+        program (GBDT.train_block; serial learner, telemetry off, no valid
+        sets, built-in objective)."""
+        return self._gbdt is not None and self._gbdt._can_fuse()
+
+    def update_block(self, k: int):
+        """Run up to ``k`` boosting rounds as one fused program (falls back
+        to per-round steps when the config can't fuse); returns
+        (rounds_run, stop) — the multi-round counterpart of update()."""
+        with self._lock.write():
+            self._invalidate_stacked()
+            return self._gbdt.train_block(k)
+
     def _raw_train_score(self):
         score = np.asarray(self._gbdt.train_score)
         if self._gbdt.num_class == 1:

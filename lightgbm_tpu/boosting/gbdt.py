@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from ..dataset import TrainDataset, ValidDataset
 from ..tree import Tree
-from ..tree_learner import SerialTreeLearner, state_to_tree
+from ..tree_learner import (SerialTreeLearner, grow_tree, grow_tree_compact,
+                            state_to_tree)
 from ..ops.predict import traverse_binned
 from ..metrics import create_metrics
 from ..log import log_info, log_warning
@@ -45,6 +46,10 @@ class GBDT:
         # never stalls on python; flushed lazily via the `models` property)
         self._pending: List[tuple] = []
         self._fused_step = None
+        self._fused_const = None
+        # aot bundle load/compile accounting for this booster (aot/bundle.py
+        # resolve_program fills it; bench.py reports aot_load_s from it)
+        self.aot_stats: Dict = {}
         self.iter_ = 0
         self.best_iteration = -1
         self.average_output = False    # RF sets True (reference rf.hpp:27)
@@ -89,6 +94,8 @@ class GBDT:
         self.valid_sets, self.valid_scores, self.valid_names = [], [], []
         self.train_score = None
         self.tree_learner = None       # holds the sharded device matrix
+        self._fused_const = None       # holds refs to the device arrays too
+        self._fused_step = None
 
     def reset_config(self, config) -> None:
         """Re-resolve tunable training params mid-run (reference
@@ -101,6 +108,7 @@ class GBDT:
         self.tree_learner = self._create_tree_learner(config, self.train_data)
         self.train_metrics = create_metrics(config, self.objective)
         self._fused_step = None        # recompile against the new config
+        self._fused_const = None
         self._L = self.tree_learner.grower_cfg.num_leaves
 
     @property
@@ -238,72 +246,258 @@ class GBDT:
         sampling on after the warmup iterations)."""
         return 0
 
+    def _fused_variants(self) -> tuple:
+        """Every variant a full run can visit (precompile compiles all)."""
+        return (0,)
+
+    def _fused_block_clamp(self, k: int) -> int:
+        """Largest round count from the CURRENT iteration that keeps one
+        program variant (GOSS clamps at its sampling-warmup boundary)."""
+        return k
+
     def _fused_gradient_adjust(self, grad, hess, mask, key, variant: int):
         """Traceable gradient-adjustment hook (GOSS overrides)."""
         return grad, hess, mask
 
-    def _fused_adjust_key(self):
-        """Key for _fused_gradient_adjust; GOSS derives it from bagging_seed
-        so fused and unfused runs draw the SAME sample sequence."""
+    def _fused_adjust_key_at(self, iteration: int):
+        """Key for _fused_gradient_adjust at one iteration; GOSS derives it
+        from bagging_seed so fused and unfused runs draw the SAME sample
+        sequence."""
         return jax.random.PRNGKey(0)
 
-    def _build_fused_step(self, variant: int):
+    def _fused_const_args(self) -> tuple:
+        """The per-run-constant arrays of the fused block, as ARGUMENTS.
+
+        Everything array-valued rides the jit/AOT signature instead of a
+        closure: closure-captured arrays are inlined as HLO *constants*,
+        which bloats the program, defeats the persistent compile cache, and
+        would bake this run's data into a serialized bundle executable."""
+        if self._fused_const is None:
+            ds = self.train_data
+            learner = self.tree_learner
+            forced = (learner.forced
+                      if self.config.grow_strategy == "compact" else None)
+            self._fused_const = (
+                ds.device_bins, ds.label, ds.weight,
+                ds.num_bins_per_feature, ds.has_missing_per_feature,
+                learner.monotone, learner.is_cat_f, learner.bmap,
+                learner.igroups, learner.gain_scale, learner.hist_layout,
+                forced)
+        return self._fused_const
+
+    def _build_fused_block(self, variant: int, k: int):
+        """Pure function running ``k`` boosting rounds as ONE program:
+        ``lax.scan`` over rounds carrying the raw score, with gradients,
+        histogram build, split scan and partition all inside the scan body
+        (grow_tree/grow_tree_compact traced through).  Only non-array state
+        (objective methods, the static GrowerConfig) is closed over."""
         obj = self.objective
-        learner = self.tree_learner
-        ds = self.train_data
-        label, weight = ds.label, ds.weight
+        cfg = self.tree_learner.grower_cfg
+        compact = self.config.grow_strategy == "compact"
         booster = self
 
-        @jax.jit
-        def step(score_row, mask, fmask, key, adjust_key, lr):
-            g, h = obj.get_gradients(score_row, label, weight)
-            g2, h2, mask2 = booster._fused_gradient_adjust(
-                g[None, :], h[None, :], mask, adjust_key, variant)
-            state = learner.grow_traced(g2[0], h2[0], mask2, fmask, key)
-            delta = jnp.where(state.n_leaves > 1,
-                              (state.leaf_value * lr)[state.row_leaf],
-                              jnp.zeros_like(score_row))
-            # drop the [N]-sized fields before the state is retained
-            slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
-            return score_row + delta, slim
+        def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
+                  igroups, gscale, hlayout, forced,
+                  score_row, lr, masks, fmasks, keys, adjust_keys):
+            grow = grow_tree_compact if compact else grow_tree
 
-        return step
+            def body(score, per_round):
+                mask, fmask, key, akey = per_round
+                g, h = obj.get_gradients(score, label, weight)
+                g2, h2, mask2 = booster._fused_gradient_adjust(
+                    g[None, :], h[None, :], mask, akey, variant)
+                kw = {"forced": forced} if compact else {}
+                state = grow(cfg, bins, g2[0], h2[0], mask2, nbf, hmf,
+                             fmask, monotone, key, is_cat, bmap, igroups,
+                             gscale, None, hist_layout=hlayout, **kw)
+                delta = jnp.where(state.n_leaves > 1,
+                                  (state.leaf_value * lr)[state.row_leaf],
+                                  jnp.zeros_like(score))
+                # drop the [N]-sized fields before the state is retained
+                slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
+                return score + delta, slim
 
-    def _train_one_iter_fused(self) -> bool:
+            return jax.lax.scan(body, score_row,
+                                (masks, fmasks, keys, adjust_keys))
+
+        return block
+
+    def _fused_signature(self, variant: int, k: int, args: tuple) -> Dict:
+        """Bundle signature of one fused block program: every fact the
+        serialized executable is specialized on (aot/bundle.py gates loads
+        on it and logs the differing keys on mismatch)."""
+        from ..aot.bundle import runtime_signature
+        import hashlib
+        leaves = jax.tree_util.tree_leaves(args)
+        avals = [[list(map(int, leaf.shape)), str(leaf.dtype)]
+                 for leaf in leaves]
+        tree_str = str(jax.tree_util.tree_structure(args))
+        cfg = self.config
+        # params baked into the traced program as compile-time CONSTANTS
+        # but absent from GrowerConfig/objective.to_string(): the gradient
+        # function's knobs (config Objective section) and the GOSS sampling
+        # rates (_goss_ks is evaluated at trace time).  Omitting any of
+        # these would let a stale bundle signature-match and silently train
+        # with the OLD constants.
+        semantics = {key: getattr(cfg, key, None) for key in (
+            "sigmoid", "fair_c", "alpha", "poisson_max_delta_step",
+            "tweedie_variance_power", "is_unbalance", "scale_pos_weight",
+            "reg_sqrt", "boost_from_average", "lambdarank_truncation_level",
+            "lambdarank_norm", "label_gain", "objective_seed",
+            "top_rate", "other_rate")}
+        return {
+            "kind": "fused_train_block", "k": int(k), "variant": int(variant),
+            "boosting": self.config.boosting,
+            "objective": self.objective.to_string(),
+            "objective_params": semantics,
+            "grow_strategy": self.config.grow_strategy,
+            "grower_cfg": repr(self.tree_learner.grower_cfg),
+            "args_tree": hashlib.sha256(tree_str.encode()).hexdigest()[:12],
+            "args_avals": avals,
+            **runtime_signature(),
+        }
+
+    def _fused_block_callable(self, variant: int, k: int, args: tuple):
+        """The executable for one (variant, K): in-process cache, then the
+        AOT bundle (load-or-recompile, aot/bundle.py) when
+        ``aot_bundle_dir`` is set, else plain jit."""
+        if self._fused_step is None:
+            self._fused_step = {}
+        key = (variant, k)
+        fn = self._fused_step.get(key)
+        if fn is not None:
+            return fn
+        builder = self._build_fused_block(variant, k)
+        bundle_dir = getattr(self.config, "aot_bundle_dir", "") or ""
+        if bundle_dir:
+            from ..aot.bundle import resolve_program
+            from ..parallel.mesh import comm_rank
+            fn, _ = resolve_program(
+                bundle_dir, f"fused_train_block_v{variant}_k{k}",
+                self._fused_signature(variant, k, args),
+                lambda: jax.jit(builder).lower(*args),
+                # rank-0-only writes, like checkpoints: ProgramBundle is
+                # single-writer and every rank compiles the same program
+                save_on_miss=(comm_rank() == 0),
+                stats=self.aot_stats)
+        else:
+            fn = jax.jit(builder)
+        self._fused_step[key] = fn
+        return fn
+
+    def _fused_example_args(self, k: int) -> tuple:
+        """Args with this run's exact shapes/dtypes for AOT lowering WITHOUT
+        touching stateful sampling RNGs (precompile must be side-effect
+        free; masks are data, not program, so all-ones stands in)."""
+        n = self.train_data.num_data
+        f = self.train_data.num_features
+        masks = jnp.ones((k, n), jnp.float32)
+        fmasks = np.ones((k, f), bool)
+        keys = jnp.stack([self.tree_learner.iter_key(i) for i in range(k)])
+        akeys = jnp.stack([self._fused_adjust_key_at(i) for i in range(k)])
+        return self._fused_const_args() + (
+            self.train_score[0], jnp.float32(self.shrinkage_rate),
+            masks, fmasks, keys, akeys)
+
+    def precompile_fused(self, rounds: Optional[int] = None) -> Dict:
+        """AOT-compile the fused block programs for this booster's exact
+        shapes — every (variant, K) pair a run visits — persisting them
+        when ``aot_bundle_dir`` is set.  No training happens; returns a
+        summary dict (task=precompile CLI and bench use it)."""
+        if not self._can_fuse():
+            return {"supported": False, "programs": 0}
+        k_cfg = int(rounds if rounds is not None
+                    else getattr(self.config, "fused_rounds", 1) or 1)
+        ks = sorted({1, max(k_cfg, 1)})
+        count = 0
+        for k in ks:
+            args = self._fused_example_args(k)
+            for variant in self._fused_variants():
+                self._fused_block_callable(variant, k, args)
+                count += 1
+        return {"supported": True, "programs": count, "rounds": ks,
+                **self.aot_stats}
+
+    def train_block(self, k: int):
+        """Run up to ``k`` boosting rounds; returns (rounds_run, stop).
+
+        ``k > 1`` runs the rounds as ONE compiled scan program when the
+        config can express it; anything the fused body can't express
+        (DART/RF host logic, custom objectives, valid sets, telemetry, a
+        GOSS variant boundary mid-block) falls back to per-round steps
+        automatically."""
+        k = int(k)
+        if getattr(self, "_saw_stump", False):
+            self._flush_pending()
+            return 0, True
+        if k <= 1 or not self._can_fuse():
+            return 1, self.train_one_iter()
+        kc = min(k, max(self._fused_block_clamp(k), 1))
+        if kc < k:
+            # e.g. the GOSS sampling-warmup boundary: run the pre-boundary
+            # rounds as singles so only the (K, 1) program pair compiles
+            stop, ran = False, 0
+            for _ in range(kc):
+                stop = self.train_one_iter()
+                ran += 1
+                if stop:
+                    break
+            return ran, stop
+        return self._train_block_fused(k)
+
+    def _train_block_fused(self, k: int):
         if getattr(self, "_saw_stump", False):
             # a flushed earlier iteration produced no splits -> stop now
             # (a few iterations later than the reference's immediate stop,
             # gbdt.cpp:418-434; the extra stump trees add zero score)
-            return True
+            return 0, True
         init = self._boost_from_average(0)
-        if self._fused_step is None:
-            self._fused_step = {}
         variant = self._fused_variant()
-        if variant not in self._fused_step:
-            self._fused_step[variant] = self._build_fused_step(variant)
         learner = self.tree_learner
-        mask = self._bagging_mask(self.iter_)
-        with timed("fused_train_iter"):
-            new_score, slim = self._fused_step[variant](
-                self.train_score[0], mask, learner.feature_mask(),
-                learner.iter_key(self.iter_), self._fused_adjust_key(),
-                jnp.float32(self.shrinkage_rate))
+        base = self.iter_
+        masks = jnp.stack([self._bagging_mask(base + i) for i in range(k)])
+        fmasks = np.stack([learner.feature_mask() for _ in range(k)])
+        keys = jnp.stack([learner.iter_key(base + i) for i in range(k)])
+        akeys = jnp.stack([self._fused_adjust_key_at(base + i)
+                           for i in range(k)])
+        args = self._fused_const_args() + (
+            self.train_score[0], jnp.float32(self.shrinkage_rate),
+            masks, fmasks, keys, akeys)
+        step = self._fused_block_callable(variant, k, args)
+        with timed("fused_train_block"):
+            new_score, slims = step(*args)
         self.train_score = new_score[None, :]
-        self._pending.append((slim, float(init), self.shrinkage_rate))
-        self.iter_ += 1
-        # stall check on an iteration that finished long ago, so reading the
-        # scalar doesn't drain the pipeline
+        for i in range(k):
+            slim = jax.tree_util.tree_map(lambda x, i=i: x[i], slims)
+            self._pending.append((slim, float(init) if i == 0 else 0.0,
+                                  self.shrinkage_rate))
+        self.iter_ += k
+        # stall check on iterations that finished >= lag rounds ago, so
+        # reading the scalars never drains the pipeline head.  EVERY
+        # old-enough pending entry is inspected exactly once (_stall_checked
+        # cursor) — a K-round block checks the same entry positions K
+        # single-round steps would have.  A mid-block stump still stops at
+        # the block's end, so fused-K may append up to K-1 more zero-score
+        # stump trees than fused-1 before stopping (the same class of
+        # accepted deviation as the lag itself vs the reference's immediate
+        # stop, gbdt.cpp:418-434).
         lag = 8
-        if len(self._pending) >= lag:
-            if int(self._pending[-lag][0].n_leaves) <= 1:
+        start = getattr(self, "_stall_checked", 0)
+        end = len(self._pending) - lag + 1
+        if end > start:
+            stalled = any(int(self._pending[j][0].n_leaves) <= 1
+                          for j in range(start, end))
+            self._stall_checked = end
+            if stalled:
                 self._flush_pending()
-                return True
-        return getattr(self, "_saw_stump", False)
+                return k, True
+        return k, getattr(self, "_saw_stump", False)
 
     def _flush_pending(self) -> None:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
+        self._stall_checked = 0
         with timed("flush_states_to_host"):
             states = jax.device_get([p[0] for p in pending])
         for state, (_, init, lr) in zip(states, pending):
@@ -328,7 +522,7 @@ class GBDT:
         init_scores = [0.0] * k
         if grad is None or hess is None:
             if self._can_fuse():
-                return self._train_one_iter_fused()
+                return self._train_block_fused(1)[1]
             self._flush_pending()
             for cls in range(k):
                 init_scores[cls] = self._boost_from_average(cls)

@@ -60,13 +60,20 @@ class GOSS(GBDT):
         return (max(1, int(n * self.config.top_rate)),
                 max(1, int(n * self.config.other_rate)))
 
+    def _goss_boundary(self) -> int:
+        """First iteration with sampling ON (reference goss.hpp:156) —
+        single source for _goss_active AND the fused block clamp: the two
+        MUST agree or a block could straddle the variant flip."""
+        return int(1.0 / self.config.learning_rate)
+
     def _goss_active(self) -> bool:
         # no sampling for early iterations (reference goss.hpp:156)
-        return self.iter_ >= int(1.0 / self.config.learning_rate)
+        return self.iter_ >= self._goss_boundary()
 
     def _goss_key(self):
-        return jax.random.PRNGKey(self.config.bagging_seed * 65537 +
-                                  self.iter_)
+        # single source with the fused path's per-iteration key — the two
+        # MUST stay identical or fused-vs-unfused bit-identity breaks
+        return self._fused_adjust_key_at(self.iter_)
 
     def _adjust_gradients(self, grad, hess):
         n = self.train_data.num_data
@@ -78,11 +85,23 @@ class GOSS(GBDT):
     def _fused_variant(self) -> int:
         return 1 if self._goss_active() else 0
 
+    def _fused_variants(self) -> tuple:
+        return (0, 1)
+
+    def _fused_block_clamp(self, k: int) -> int:
+        # a block must not straddle the sampling-warmup boundary: the
+        # variant (and therefore the compiled program) flips there
+        boundary = self._goss_boundary()
+        if self.iter_ < boundary:
+            return min(k, boundary - self.iter_)
+        return k
+
     def _fused_gradient_adjust(self, grad, hess, mask, key, variant: int):
         if variant == 0:
             return grad, hess, mask
         top_k, other_k = self._goss_ks()
         return goss_adjust(grad, hess, key, top_k, other_k)
 
-    def _fused_adjust_key(self):
-        return self._goss_key()
+    def _fused_adjust_key_at(self, iteration: int):
+        return jax.random.PRNGKey(self.config.bagging_seed * 65537 +
+                                  iteration)

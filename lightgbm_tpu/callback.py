@@ -40,6 +40,10 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
             result = "\t".join(_fmt(x) for x in env.evaluation_result_list)
             log_info(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
+    # a pure no-op without evaluation results, so fused multi-round blocks
+    # (engine.py blockable) may skip its per-iteration invocations — blocks
+    # only engage when there are no eval producers at all
+    _callback.block_safe = True
     return _callback
 
 

@@ -135,6 +135,13 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
         # per-rank JSONL event logs land next to the worker logs; the
         # supervisor rolls them up into telemetry_summary.json on exit
         params["telemetry_dir"] = os.path.join(tmp, "telemetry")
+    if max_restarts > 0 and not params.get("aot_bundle_dir"):
+        # relaunched workers recompile everything a fresh process needs;
+        # a job-shared AOT bundle (lightgbm_tpu/aot/) lets the restart
+        # deserialize the fused training programs the first attempt
+        # compiled instead — the bundle lives next to the checkpoints,
+        # so on a multi-host pod both ride the same shared storage
+        params["aot_bundle_dir"] = os.path.join(tmp, "aot_bundle")
     if max_restarts > 0 and not params.get("checkpoint_dir"):
         # restarts without checkpoints would replay the whole run; give
         # the job a private checkpoint directory so resume is automatic.

@@ -51,5 +51,15 @@ def maybe_enable_compilation_cache(config) -> bool:
         log_warning(f"could not enable the JAX persistent compilation "
                     f"cache at {cache_dir!r}: {exc}")
         return False
+    try:
+        # jax binds its cache object lazily on the FIRST compile and never
+        # re-reads the dir config afterwards — if anything compiled before
+        # this call (backend probe, another library), the update above is
+        # silently ignored until the cache handle is reset
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass   # private-API drift: the dir update alone still covers the
+        #        compile-before-first-use-free case
     _active_dir = cache_dir
     return True

@@ -45,7 +45,8 @@ _PARAMS: List[ParamSpec] = [
     # ---- Core ----
     _p("config", str, "", ("config_file",), desc="path to a config file (CLI)"),
     _p("task", str, "train", ("task_type",),
-       check="in:train|predict|convert_model|refit|save_binary|serve"),
+       check="in:train|predict|convert_model|refit|save_binary|serve"
+             "|precompile"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss"),
        desc="objective name, see objectives.py"),
@@ -269,6 +270,21 @@ _PARAMS: List[ParamSpec] = [
        desc="enable the JAX persistent compilation cache at this directory; "
             "repeat runs with identical shapes/configs skip XLA recompiles "
             "of the grower/predict programs (empty = off)"),
+    _p("fused_rounds", int, 8, (), ">0",
+       "run up to this many boosting rounds as ONE compiled program "
+       "(lax.scan over rounds, lightgbm_tpu/aot/) when nothing observes "
+       "per-iteration state — no valid sets, per-iteration callbacks, "
+       "telemetry, or custom objective; configs the fused body can't "
+       "express fall back to per-round steps automatically.  1 disables "
+       "multi-round fusing"),
+    _p("aot_bundle_dir", str, "", (),
+       desc="directory holding an AOT program bundle (manifest + "
+            "serialized XLA executables, lightgbm_tpu/aot/): training and "
+            "serving load matching programs instead of compiling, and "
+            "save freshly compiled ones back on a signature mismatch "
+            "(logged).  task=precompile populates it ahead of time so "
+            "trainers, restarted workers, and serving replicas start warm "
+            "(empty = off)"),
     _p("grow_strategy", str, "compact", (),
        "in:compact|dense",
        "compact = partition-order segments + histogram subtraction "

@@ -215,7 +215,50 @@ def train(params: Dict[str, Any], train_set: Dataset,
     finished_early = False
     evaluation_result_list = ([tuple(x) for x in eval_history[-1]]
                               if eval_history else [])
-    for it in range(begin_iteration, nbr):
+
+    # ---- fused multi-round blocks (lightgbm_tpu/aot/) -----------------
+    # When nothing observes per-iteration state, K rounds run as ONE
+    # compiled scan program (GBDT.train_block).  Anything that needs
+    # per-round host boundaries keeps the per-iteration path: callbacks
+    # that aren't no-ops without eval results, valid-set evaluation,
+    # profiling/fault hooks, and configs the fused body can't express
+    # (the booster itself falls back for those).  Blocks never straddle a
+    # checkpoint boundary, so saves land at the same iterations either way.
+    fused_rounds = int(getattr(run_cfg, "fused_rounds", 1) or 1)
+    blockable = (fused_rounds > 1
+                 and fobj is None
+                 and not cbs_before
+                 and all(getattr(cb, "block_safe", False) for cb in cbs_after)
+                 and not booster._valid_names and not train_in_valid
+                 and not profile_iters
+                 and not fault_armed
+                 and booster.supports_fused_blocks())
+
+    it = begin_iteration
+    while it < nbr:
+        block_k = 1
+        if blockable:
+            to_boundary = (ckpt_freq - (it % ckpt_freq)
+                           if manager is not None else nbr - it)
+            if nbr - it >= fused_rounds and to_boundary >= fused_rounds:
+                block_k = fused_rounds
+        if block_k > 1:
+            ran, should_stop = booster.update_block(block_k)
+            if ran == 0:
+                break               # already-stumped model: nothing ran
+            it += ran
+            if manager is not None:
+                # no eval producers under a block (blockable guarantees
+                # it) — record the empty per-iteration history the resume
+                # replay expects
+                eval_history.extend([[] for _ in range(ran)])
+                if (it % ckpt_freq == 0 or it == nbr or should_stop) \
+                        and manager.is_writer():
+                    manager.save(capture_train_state(booster, eval_history),
+                                 it)
+            if should_stop:
+                break
+            continue
         if fault_armed:
             from .checkpoint.fault import maybe_inject_fault
             maybe_inject_fault(it)
@@ -274,6 +317,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 tele_emitted += 1
         if should_stop:
             break
+        it += 1
     if manager is not None:
         booster._checkpoint_manager = manager
     if tele_log is not None:

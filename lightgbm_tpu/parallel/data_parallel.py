@@ -20,13 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
 from ..tree_learner import GrowerConfig, SerialTreeLearner, grow_tree
-from .mesh import build_mesh
+from .mesh import build_mesh, compat_shard_map
 
 __all__ = ["DataParallelTreeLearner"]
 
@@ -139,17 +135,20 @@ class DataParallelTreeLearner(SerialTreeLearner):
         ax = self.AXIS
         mp = self.multiprocess
 
+        # compat_shard_map probes the replication-check kwarg spelling
+        # (check_rep -> check_vma across jax versions) instead of pinning
+        # one — the pinned spelling was the pre-existing cause of every
+        # shard_map test failing at decoration on this container's jax
         @functools.partial(jax.jit, static_argnames=())
         @functools.partial(
-            shard_map,
+            compat_shard_map,
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
                       P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
                       P()),                              # hist_layout
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
-            )._replace(row_leaf=P() if mp else P(ax)),
-            check_vma=False)
+            )._replace(row_leaf=P() if mp else P(ax)))
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
                     bmap, igroups, gscale, gpen, hlayout):
             from ..tree_learner import grow_tree_compact

@@ -22,13 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
 from ..tree_learner import SerialTreeLearner
-from .mesh import build_mesh
+from .mesh import build_mesh, compat_shard_map
 
 __all__ = ["FeatureParallelTreeLearner"]
 
@@ -116,14 +112,15 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         out_specs = TreeState(**{name: P() for name in TreeState._fields})
         forced = self.forced   # closed over: constant across iterations
 
+        # compat_shard_map: replication-check kwarg spelling probed across
+        # jax versions (see data_parallel.py note)
         @jax.jit
         @functools.partial(
-            shard_map, mesh=self.mesh,
+            compat_shard_map, mesh=self.mesh,
             in_specs=(P(None, ax), P(), P(), P(),        # bins, g, h, mask
                       P(ax), P(ax), P(ax), P(ax), P(), P(ax),
                       P(), P(ax), P(ax), P()),  # igroups_g, gscale, gpen, mono_g
-            out_specs=out_specs,
-            check_vma=False)
+            out_specs=out_specs)
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
                     igroups_g, gscale, gpen, mono_g):
             return grow_tree_compact(cfg, bins, grad, hess, mask, nbf, hmf,

@@ -29,10 +29,9 @@ def compat_shard_map(f, mesh, in_specs, out_specs):
     ``check_vma`` (and the entry point moved from jax.experimental to
     jax.*); probing by TypeError works on whichever jax the container
     ships instead of pinning one spelling.  Used by the telemetry
-    collective probe; the parallel learners keep the pinned spelling on
-    purpose — auto-adapting them here was measured to add ~3 minutes of
-    previously-skipped shard_map work to the tier-1 suite, which has no
-    budget headroom (their compat migration is an open ROADMAP item)."""
+    collective probe AND all parallel tree learners (data/voting/feature
+    — their previously-pinned spelling made every shard_map test fail at
+    decoration on jax versions with the other kwarg)."""
     try:
         from jax import shard_map as _shard_map
     except ImportError:

@@ -179,6 +179,103 @@ class CompiledPredictor:
         return fn
 
     # ------------------------------------------------------------------
+    # AOT bundles (lightgbm_tpu/aot/): the executable cache as an artifact.
+    # Predict programs take the StackedTrees as an ARGUMENT, so a bundled
+    # executable is tied to tree-array shapes + config, not to one model's
+    # weights — any model with the same (padded) tree geometry reuses it.
+    def _program_name(self, key) -> str:
+        bucket, nfeat, dtype_str, s, e, kind = key
+        return f"serve_predict_{kind}_b{bucket}_f{nfeat}_{dtype_str}_i{s}-{e}"
+
+    def _program_signature(self, key):
+        from ..aot.bundle import runtime_signature
+        bucket, nfeat, dtype_str, s, e, kind = key
+        st_avals = [[list(map(int, a.shape)), str(a.dtype)]
+                    if hasattr(a, "shape") else ["static", repr(a)]
+                    for a in jax.tree_util.tree_leaves(self._stacked)]
+        return {"kind": "serve_predict", "bucket": int(bucket),
+                "num_feature": int(nfeat), "dtype": dtype_str,
+                "start": int(s), "end": int(e), "output": kind,
+                "num_class": int(self.num_class),
+                "objective": self._objective,
+                "average_output": bool(self._average_output),
+                "stacked_avals": st_avals,
+                **runtime_signature()}
+
+    def save_bundle(self, bundle_dir: str) -> int:
+        """Serialize every cached executable into an AOT bundle; returns
+        the number of programs saved.  Typically called after warmup() —
+        task=precompile does exactly that (aot/precompile.py).
+
+        An executable whose serialization doesn't verify (it was itself a
+        jax persistent-cache hit — see aot.bundle.serializable_compiles)
+        is rebuilt once with that cache off and the fresh program is
+        saved (and swapped into the live cache; same program, so serving
+        results are unaffected and compile_count stays honest)."""
+        from ..aot.bundle import ProgramBundle, serializable_compiles
+        bundle = ProgramBundle(str(bundle_dir))
+        with self._lock:
+            items = list(self._cache.items())
+        for key, fn in items:
+            name, sig = self._program_name(key), self._program_signature(key)
+            try:
+                bundle.save_program(name, sig, fn)
+            except Exception:
+                with timed("serving::compile"), serializable_compiles():
+                    fn = self._build(key)
+                with self._lock:
+                    self._cache[key] = fn
+                bundle.save_program(name, sig, fn)
+        return len(items)
+
+    def load_bundle(self, bundle_dir: str, kinds=("prob", "raw"),
+                    start_iteration: int = 0, num_iteration: int = -1,
+                    buckets=None) -> int:
+        """Fill the executable cache from an AOT bundle without compiling.
+
+        Signature-mismatched or missing entries are skipped (reason logged
+        once) and fall back to normal lazy compilation; ``compile_count``
+        is untouched, so a replica started from a complete bundle reports
+        zero compiles in steady state."""
+        from ..aot.bundle import ProgramBundle
+        from ..log import log_info
+        bundle = ProgramBundle(str(bundle_dir))
+        s, e = self._iter_range(start_iteration, num_iteration)
+        if e <= s:
+            return 0
+        try:
+            manifest = bundle.manifest()   # one read for the whole ladder
+        except Exception:
+            manifest = {"programs": {}}
+        loaded, misses = 0, []
+        for bucket in (buckets or self.buckets):
+            for kind in kinds:
+                key = (int(bucket), self.num_feature, str(self.dtype),
+                       s, e, kind)
+                with self._lock:
+                    if key in self._cache:
+                        continue
+                fn, reason = bundle.load_program(
+                    self._program_name(key), self._program_signature(key),
+                    manifest=manifest)
+                if fn is None:
+                    misses.append(reason)
+                    continue
+                with self._lock:
+                    if key not in self._cache:
+                        self._cache[key] = fn
+                        loaded += 1
+        if misses:
+            from ..log import log_warning
+            log_warning(f"aot: {len(misses)} predict program(s) not "
+                        f"loadable from {bundle_dir!r} (will compile "
+                        f"lazily); first reason: {misses[0]}")
+        if loaded:
+            log_info(f"aot: loaded {loaded} predict program(s) from "
+                     f"bundle {bundle_dir!r}")
+        return loaded
+
+    # ------------------------------------------------------------------
     def warmup(self, kinds=("prob",), start_iteration: int = 0,
                num_iteration: int = -1, buckets=None) -> int:
         """Pre-compile the bucket ladder for the given output kinds.

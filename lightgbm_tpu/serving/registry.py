@@ -65,12 +65,17 @@ class ModelRegistry:
     def publish(self, name: str, booster=None, predictor=None,
                 model_str: Optional[str] = None,
                 model_file: Optional[str] = None,
-                warmup: bool = True) -> int:
+                warmup: bool = True,
+                aot_bundle_dir: Optional[str] = None) -> int:
         """Install a new version of `name` and make it current.
 
         Exactly one model source must be given.  With warmup=True (the
         default) the bucket ladder is pre-compiled BEFORE the swap, so the
         first requests on the new version don't eat its compile latency.
+        ``aot_bundle_dir`` loads matching serialized executables from an
+        AOT bundle FIRST (lightgbm_tpu/aot/, task=precompile), so a cold
+        replica warms by deserializing instead of compiling; warmup then
+        only compiles whatever the bundle didn't cover.
         Returns the published version number."""
         sources = [s for s in (booster, predictor, model_str, model_file)
                    if s is not None]
@@ -86,6 +91,8 @@ class ModelRegistry:
                        if self._metrics is not None else None)
             predictor = CompiledPredictor(booster, buckets=self._buckets,
                                           dtype=self._dtype, metrics=metrics)
+        if aot_bundle_dir:
+            predictor.load_bundle(aot_bundle_dir)
         if warmup:
             predictor.warmup()
         with self._lock:

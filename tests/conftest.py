@@ -28,6 +28,28 @@ os.environ["XLA_FLAGS"] = (_flags +
 import jax
 jax.config.update("jax_platforms", "cpu")
 
+# Warm persistent compile cache for the whole suite: the tier-1 budget is
+# dominated by XLA compiles of the same grower/predict programs on every
+# run, so point jax's persistent cache at the SAME stable directory the
+# package default uses (lightgbm_tpu/__init__.py) — in-process tests and
+# CLI/cluster test subprocesses then share one warm cache, and a repeat
+# suite run skips the compiles entirely.  reset_cache() makes the dir
+# update stick even if something compiled before this line (jax binds the
+# cache object lazily on first compile and never re-reads the config).
+_cache_dir = os.environ.get(
+    "LIGHTGBM_TPU_COMPILE_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "lightgbm_tpu",
+                 "jax_cache"))
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    from jax._src import compilation_cache as _cc
+    _cc.reset_cache()
+except Exception:
+    pass   # cache is best-effort; never block the suite
+
 import numpy as np
 import pytest
 
@@ -118,6 +140,19 @@ def rank_data():
     half = n_q // 2
     tr = sizes[:half].sum()
     return (X[:tr], y[:tr], sizes[:half], X[tr:], y[tr:], sizes[half:])
+
+
+@pytest.fixture(scope="session")
+def binary_model(binary_data):
+    """One standard trained binary booster, shared by every test that only
+    needs SOME trained model (save/load round-trip, importances, plotting):
+    one 10-round training per session instead of one per test.  Tests must
+    treat it as read-only — mutating tests train their own."""
+    import lightgbm_tpu as lgb
+    X_train, y_train, _, _ = binary_data
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+    return lgb.train(params, lgb.Dataset(X_train, y_train),
+                     num_boost_round=10)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,289 @@
+"""AOT subsystem tests: fused multi-round parity, program bundles,
+signature-mismatch fallback, and the zero-compile cold start."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.aot import (ProgramBundle, default_bundle_dir,
+                              precompile_predictor, precompile_training)
+from lightgbm_tpu.aot.bundle import (BUNDLE_VERSION, describe_mismatch,
+                                     signature_fingerprint)
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(7)
+    X = rng.randn(1500, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + 0.4 * rng.randn(1500) > 0).astype(np.float32)
+    return X, y
+
+
+BASE = {"objective": "binary", "verbosity": -1, "num_leaves": 7,
+        "min_data_in_leaf": 20, "max_bin": 31}
+
+
+def _trees(model_str: str) -> str:
+    """Model text minus the header (shared across configs by construction;
+    the trees are what parity is about)."""
+    return model_str.split("\n\n", 1)[1]
+
+
+# ---------------------------------------------------------------------------
+# fused(K) vs per-round parity
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("extra", [
+    {},                                                     # plain
+    {"bagging_freq": 2, "bagging_fraction": 0.6},           # bagging
+    {"boosting": "goss", "learning_rate": 0.5},             # goss
+], ids=["plain", "bagging", "goss"])
+def test_fused_blocks_bit_identical(xy, extra):
+    X, y = xy
+    params = dict(BASE, **extra)
+    per_round = lgb.train(dict(params, fused_rounds=1),
+                          lgb.Dataset(X, y), num_boost_round=8)
+    fused = lgb.train(dict(params, fused_rounds=4),
+                      lgb.Dataset(X, y), num_boost_round=8)
+    assert _trees(fused.model_to_string()) == \
+        _trees(per_round.model_to_string())
+
+
+def test_blocks_fall_back_with_observers(xy):
+    """Anything observing per-iteration state (valid sets here) must keep
+    the per-round path — and produce the same model either way."""
+    X, y = xy
+    def run(fused_rounds):
+        res = {}
+        bst = lgb.train(dict(BASE, fused_rounds=fused_rounds),
+                        lgb.Dataset(X, y), num_boost_round=6,
+                        valid_sets=[lgb.Dataset(X[:300], y[:300])],
+                        evals_result=res)
+        # every iteration evaluated -> the per-round path really ran
+        assert len(res["valid_0"]["binary_logloss"]) == 6
+        return bst
+    a, b = run(4), run(1)
+    assert _trees(a.model_to_string()) == _trees(b.model_to_string())
+
+
+# ---------------------------------------------------------------------------
+# program bundles
+# ---------------------------------------------------------------------------
+def test_bundle_roundtrip_and_warm_train(xy, tmp_path):
+    """precompile -> train-with-bundle loads (not compiles) the fused
+    programs and produces the identical model."""
+    X, y = xy
+    bundle = str(tmp_path / "bundle")
+    ds = lgb.Dataset(X, y)
+    out = precompile_training(dict(BASE, fused_rounds=4), ds, bundle)
+    assert out["supported"] and out["programs"] == 2       # K=4 and K=1
+    man = ProgramBundle(bundle).manifest()
+    assert man["bundle_version"] == BUNDLE_VERSION
+    assert len(man["programs"]) == 2
+
+    # 10 rounds = two K=4 blocks + two singles: BOTH bundled programs load
+    warm = lgb.train(dict(BASE, fused_rounds=4, aot_bundle_dir=bundle),
+                     lgb.Dataset(X, y), num_boost_round=10)
+    assert warm._gbdt.aot_stats.get("loaded", 0) == 2
+    assert warm._gbdt.aot_stats.get("compiled", 0) == 0
+    cold = lgb.train(dict(BASE, fused_rounds=4), lgb.Dataset(X, y),
+                     num_boost_round=10)
+    assert _trees(warm.model_to_string()) == _trees(cold.model_to_string())
+
+
+def test_bundle_roundtrip_inmemory_scheme(xy):
+    """Bundles go through the io/file_io scheme registry end to end: a
+    registered in-memory backend hosts precompile AND the warm load."""
+    import io as _io
+
+    from lightgbm_tpu.io import file_io
+
+    store, dirs = {}, set()
+
+    class _W(_io.BytesIO):
+        def __init__(self, path, text):
+            super().__init__()
+            self._path, self._text = path, text
+
+        def close(self):
+            store[self._path] = self.getvalue()
+            super().close()
+
+    def opener(path, mode):
+        if "w" in mode:
+            w = _W(path, "b" not in mode)
+            return _io.TextIOWrapper(w) if "b" not in mode else w
+        data = store[path]
+        return (_io.BytesIO(data) if "b" in mode
+                else _io.StringIO(data.decode()))
+
+    file_io.register_scheme(
+        "aotmem", opener,
+        rename=lambda s, d: store.__setitem__(d, store.pop(s)),
+        remove=lambda p: store.pop(p),
+        listdir=lambda p: [k.rsplit("/", 1)[1] for k in store
+                           if k.startswith(p.rstrip("/") + "/")],
+        makedirs=lambda p: dirs.add(p),
+        exists=lambda p: p in store)
+    try:
+        X, y = xy
+        bundle = "aotmem://bundles/run1"
+        out = precompile_training(dict(BASE, fused_rounds=4),
+                                  lgb.Dataset(X, y), bundle)
+        assert out["supported"]
+        assert any(k.endswith("MANIFEST.json") for k in store)
+        assert not any(".tmp" in k for k in store)          # all committed
+        warm = lgb.train(dict(BASE, fused_rounds=4, aot_bundle_dir=bundle),
+                         lgb.Dataset(X, y), num_boost_round=10)
+        assert warm._gbdt.aot_stats.get("loaded", 0) == 2
+    finally:
+        file_io._SCHEMES.pop("aotmem", None)
+
+
+def test_signature_mismatch_falls_back_with_reason(xy, tmp_path):
+    """A bundle built for another config must not load: training recompiles
+    and the log names the differing signature keys."""
+    X, y = xy
+    bundle = str(tmp_path / "bundle")
+    precompile_training(dict(BASE, fused_rounds=4), lgb.Dataset(X, y),
+                        bundle)
+    lines = []
+    lgb.register_log_callback(lines.append)
+    try:
+        other = lgb.train(dict(BASE, num_leaves=15, verbosity=0,
+                               fused_rounds=4, aot_bundle_dir=bundle),
+                          lgb.Dataset(X, y), num_boost_round=8)
+    finally:
+        lgb.register_log_callback(None)
+    assert other._gbdt.aot_stats.get("loaded", 0) == 0
+    assert other._gbdt.aot_stats.get("compiled", 0) == 1
+    text = "".join(lines)
+    assert "bundle miss" in text and "grower_cfg" in text
+    assert other.num_trees() == 8
+    # ...and the recompiled program was saved back under the new
+    # signature: a second run with THIS config now loads
+    again = lgb.train(dict(BASE, num_leaves=15, fused_rounds=4,
+                           aot_bundle_dir=bundle),
+                      lgb.Dataset(X, y), num_boost_round=8)
+    assert again._gbdt.aot_stats.get("loaded", 0) == 1
+
+
+def test_signature_covers_sampling_params(xy, tmp_path):
+    """Params baked into the traced program as constants but invisible to
+    shapes/GrowerConfig (GOSS top_rate here) must invalidate the bundle —
+    a stale executable would silently sample at the OLD rate."""
+    X, y = xy
+    gp = dict(BASE, boosting="goss", learning_rate=0.5, fused_rounds=4)
+    bundle = str(tmp_path / "bundle")
+    precompile_training(dict(gp, top_rate=0.2), lgb.Dataset(X, y), bundle)
+    other = lgb.train(dict(gp, top_rate=0.4, aot_bundle_dir=bundle),
+                      lgb.Dataset(X, y), num_boost_round=8)
+    assert other._gbdt.aot_stats.get("loaded", 0) == 0
+    # ...and the recompile was saved back: the changed config now loads
+    # (one config per bundle at a time, like checkpoints)
+    again = lgb.train(dict(gp, top_rate=0.4, aot_bundle_dir=bundle),
+                      lgb.Dataset(X, y), num_boost_round=8)
+    assert again._gbdt.aot_stats.get("loaded", 0) >= 1
+    assert again._gbdt.aot_stats.get("compiled", 0) == 0
+
+
+def test_bundle_version_gate(tmp_path):
+    bundle = str(tmp_path / "bundle")
+    import os
+    os.makedirs(bundle)
+    with open(os.path.join(bundle, "MANIFEST.json"), "w") as fh:
+        json.dump({"bundle_version": BUNDLE_VERSION + 1,
+                   "programs": {"x": {"file": "x.xprog",
+                                      "fingerprint": "f"}}}, fh)
+    assert ProgramBundle(bundle).program_names() == []
+
+
+def test_describe_mismatch_names_keys():
+    a = {"rows": 100, "backend": "cpu"}
+    b = {"rows": 200, "backend": "cpu"}
+    msg = describe_mismatch(a, b)
+    assert "rows" in msg and "backend" not in msg
+    assert signature_fingerprint(a) != signature_fingerprint(b)
+    assert signature_fingerprint(a) == signature_fingerprint(dict(a))
+
+
+def test_default_bundle_dir():
+    assert default_bundle_dir("model.txt") == "model.txt.aot"
+
+
+def test_cli_precompile_validates():
+    from lightgbm_tpu.application import Application
+    with pytest.raises(ValueError, match="task=precompile requires"):
+        Application(["task=precompile"]).run()
+
+
+def test_cli_precompile_serve_bundle(xy, tmp_path):
+    """task=precompile input_model=... populates a bundle next to the
+    model; a warm predictor then loads it with zero compiles."""
+    X, y = xy
+    bst = lgb.train(BASE, lgb.Dataset(X, y), num_boost_round=3)
+    model = str(tmp_path / "model.txt")
+    bst.save_model(model)
+    from lightgbm_tpu.application import Application
+    Application([f"task=precompile", f"input_model={model}",
+                 "verbosity=-1"]).run()
+    import os
+    assert os.path.isdir(model + ".aot")
+    loaded = lgb.Booster(model_file=model)
+    pred = loaded.to_compiled()
+    assert pred.load_bundle(model + ".aot") > 0
+    assert pred.compile_count == 0
+
+
+# ---------------------------------------------------------------------------
+# zero-compile cold start (train + serve)
+# ---------------------------------------------------------------------------
+def test_precompiled_cold_start_zero_compiles(xy, tmp_path):
+    """The acceptance bar: with a populated bundle, a fresh booster's
+    whole training run performs ZERO XLA backend compiles (asserted via
+    the telemetry compile-counter listener), and the fused programs
+    demonstrably came from the bundle."""
+    from lightgbm_tpu.telemetry.training import compile_tracker
+    compile_tracker.install()
+    X, y = xy
+    bundle = str(tmp_path / "bundle")
+    params = dict(BASE, fused_rounds=4, aot_bundle_dir=bundle)
+    ds = lgb.Dataset(X, y)
+    # first run: compiles everything once (and saves the bundle) — also
+    # warms the in-process caches of every auxiliary program
+    lgb.train(params, ds, num_boost_round=10)
+    before = compile_tracker.snapshot()[0]
+    warm = lgb.train(params, ds, num_boost_round=10)
+    assert warm.num_trees() == 10
+    assert warm._gbdt.aot_stats.get("loaded", 0) == 2      # from the bundle
+    steady = compile_tracker.snapshot()[0] - before
+    assert steady == 0, f"expected 0 steady-state compiles, got {steady}"
+
+
+def test_predictor_bundle_cold_start(xy, tmp_path):
+    """Serve half: warmup -> save_bundle -> a fresh predictor loads the
+    ladder with compile_count == 0 and serves identical outputs."""
+    X, y = xy
+    bst = lgb.train(BASE, lgb.Dataset(X, y), num_boost_round=5)
+    bundle = str(tmp_path / "serve_bundle")
+    out = precompile_predictor(bst, bundle, buckets=(8, 32))
+    assert out["programs"] == out["compiled"] > 0
+
+    cold = bst.to_compiled(buckets=(8, 32))
+    loaded = cold.load_bundle(bundle, buckets=(8, 32))
+    assert loaded == out["programs"]
+    assert cold.compile_count == 0
+    got = cold.predict(X[:20])
+    np.testing.assert_allclose(got, bst.predict(X[:20]), rtol=1e-6)
+    assert cold.compile_count == 0                          # still zero
+
+    # registry publish warms from the bundle the same way
+    from lightgbm_tpu.serving import ModelRegistry
+    reg = ModelRegistry(buckets=(8, 32))
+    reg.publish("m", booster=bst, warmup=False, aot_bundle_dir=bundle)
+    assert reg.compile_counts()["m"] == 0
+    np.testing.assert_allclose(reg.predict("m", X[:10]), bst.predict(X[:10]),
+                               rtol=1e-6)
+    assert reg.compile_counts()["m"] == 0

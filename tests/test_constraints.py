@@ -187,6 +187,9 @@ def test_forced_splits_fatal_with_data_parallel(tmp_path):
         lgb.train(params, lgb.Dataset(X, y), num_boost_round=1)
 
 
+@pytest.mark.slow   # heaviest monotone coverage: full stale-leaf rescan
+# compiles per method (~2 min); the fast constraints-hold tests above keep
+# tier-1 monotone coverage
 @pytest.mark.parametrize("method", ["intermediate", "advanced"])
 def test_monotone_stale_leaf_recompute(method):
     """The scenario the reference's leaves_to_update machinery exists for

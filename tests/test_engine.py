@@ -41,6 +41,7 @@ def test_binary():
         ll, rel=1e-4)
 
 
+@pytest.mark.slow   # long AUC-threshold run; test_binary covers the path
 def test_binary_example_data_quality(binary_data):
     """On the reference examples' HIGGS-subset data, match the quality the
     reference reaches (test AUC ~0.8 at 50 iters with default params)."""
@@ -63,6 +64,7 @@ def test_regression(regression_data):
     assert mse < 0.85 * base  # clearly better than predicting the mean
 
 
+@pytest.mark.slow   # many-iteration quality curve; overlaps test_binary
 def test_training_improves_over_iterations(binary_data):
     X_train, y_train, X_test, y_test = binary_data
     params = {"objective": "binary", "metric": "binary_logloss",
@@ -142,10 +144,9 @@ def test_custom_feval(binary_data):
     assert res["valid_0"]["my_err"][-1] < 0.4
 
 
-def test_model_save_load_roundtrip(binary_data, tmp_path):
-    X_train, y_train, X_test, y_test = binary_data
-    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
-    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=10)
+def test_model_save_load_roundtrip(binary_data, binary_model, tmp_path):
+    _, _, X_test, y_test = binary_data
+    bst = binary_model          # shared session model (read-only here)
     p_orig = bst.predict(X_test)
     path = str(tmp_path / "model.txt")
     bst.save_model(path)
@@ -198,10 +199,9 @@ def test_rollback_one_iter(binary_data):
     assert not np.allclose(p4, p5)
 
 
-def test_feature_importance(binary_data):
-    X_train, y_train, _, _ = binary_data
-    params = {"objective": "binary", "verbosity": -1}
-    bst = lgb.train(params, lgb.Dataset(X_train, y_train), num_boost_round=10)
+def test_feature_importance(binary_data, binary_model):
+    X_train = binary_data[0]
+    bst = binary_model          # shared session model (read-only here)
     imp_split = bst.feature_importance("split")
     imp_gain = bst.feature_importance("gain")
     assert imp_split.shape == (X_train.shape[1],)

@@ -58,6 +58,7 @@ def test_regressor(regression_data):
     assert "l2" in reg.evals_result_["valid_0"]
 
 
+@pytest.mark.slow   # engine test_early_stopping covers the path in tier-1
 def test_regressor_early_stopping(regression_data):
     X_train, y_train, X_test, y_test = regression_data
     reg = lgb.LGBMRegressor(n_estimators=100, learning_rate=0.3)
@@ -79,6 +80,7 @@ def test_ranker(rank_data):
         lgb.LGBMRanker().fit(X_train, y_train)  # no group
 
 
+@pytest.mark.slow   # engine test_custom_objective_fobj covers fobj in tier-1
 def test_custom_objective(regression_data):
     X_train, y_train, _, _ = regression_data
 
