@@ -8,6 +8,7 @@ the C++ boosting stack.  Run as ``python -m lightgbm_tpu config=train.conf``.
 
 from __future__ import annotations
 
+import itertools
 import os
 import sys
 from typing import Dict, List, Optional
@@ -196,23 +197,73 @@ class Application:
         log_info(f"Finished prediction; results saved to {path}")
 
     def _serve(self) -> None:
-        """task=serve: publish input_model into a registry and run the
-        HTTP inference front-end (lightgbm_tpu/serving/).  With an
-        ``aot_bundle_dir`` (populated by task=precompile) the replica
-        warms by deserializing the bundled predict programs instead of
-        compiling them."""
-        from .serving.server import ServingApp, serve
+        """task=serve: three roles (lightgbm_tpu/fleet/).
+
+        - default (fleet_role empty, fleet_replicas=0): single-process
+          server — publish input_model(s) into a registry and run the
+          HTTP inference front-end (lightgbm_tpu/serving/).
+        - ``fleet_replicas=N``: full fleet launch — spawn N supervised
+          replica processes (each this same CLI with
+          ``fleet_role=replica``) and run the SLO-aware router in front.
+        - ``fleet_role=router``: router only, over externally managed
+          replicas (``fleet_replica_urls``).
+
+        With an ``aot_bundle_dir`` (populated by task=precompile) each
+        replica warms by deserializing the bundled predict programs
+        instead of compiling them — which is what makes N-replica
+        cold-start affordable.  Multiple models: comma-separate
+        ``input_model`` (and optionally ``serving_model_name``); with a
+        bundle dir, model k loads from ``<dir>/<name_k>`` when that
+        subdirectory exists (per-model bundles), else from the dir
+        itself."""
         cfg = self.config
+        if cfg.fleet_role == "router":
+            from .fleet import serve_router
+            serve_router(cfg)
+            return
+        if cfg.fleet_role == "" and cfg.fleet_replicas > 0:
+            if not cfg.input_model:
+                raise ValueError("task=serve requires input_model=FILE")
+            from .fleet import serve_fleet
+            serve_fleet(self.raw_params, cfg)
+            return
+        # single server / replica role
+        from .serving.server import ServingApp, serve
         if not cfg.input_model:
             raise ValueError("task=serve requires input_model=FILE")
         app = ServingApp(max_batch=cfg.serving_max_batch,
                          max_wait_ms=cfg.serving_max_wait_ms,
-                         max_queue_rows=cfg.serving_max_queue_rows)
-        version = app.registry.publish(
-            cfg.serving_model_name, model_file=cfg.input_model,
-            aot_bundle_dir=cfg.aot_bundle_dir or None)
-        log_info(f"serving {cfg.input_model} as "
-                 f"{cfg.serving_model_name!r} v{version}")
+                         max_queue_rows=cfg.serving_max_queue_rows,
+                         continuous=bool(cfg.serving_continuous_batching))
+        models = [m for m in str(cfg.input_model).split(",") if m]
+        names = [n for n in str(cfg.serving_model_name).split(",") if n]
+        if len(names) > len(models):
+            raise ValueError(
+                f"serving_model_name lists {len(names)} names for "
+                f"{len(models)} input_model file(s)")
+        if not names and len(models) == 1:
+            names = ["default"]
+        auto = (f"model{i}" for i in itertools.count(len(names)))
+        while len(names) < len(models):
+            # generated defaults must dodge user-supplied names: filling
+            # slot 1 with "model1" when the user already named one model
+            # "model1" would reject a perfectly workable config below
+            names.append(next(n for n in auto if n not in names))
+        if len(set(names)) != len(names):
+            # a duplicate would silently publish the later file as v2 of
+            # the same name, shadowing the earlier one
+            raise ValueError(f"duplicate serving model names: {names}")
+        for path, name in zip(models, names):
+            bundle = cfg.aot_bundle_dir or None
+            if bundle:
+                # per-model bundle layout (<dir>/<name>) wins when it
+                # exists; otherwise the dir itself is the bundle (the
+                # task=precompile single-model layout)
+                sub = os.path.join(bundle, name)
+                bundle = sub if os.path.isdir(sub) else bundle
+            version = app.registry.publish(name, model_file=path,
+                                           aot_bundle_dir=bundle)
+            log_info(f"serving {path} as {name!r} v{version}")
         serve(app, host=cfg.serving_host, port=cfg.serving_port)
 
     def _precompile(self) -> None:

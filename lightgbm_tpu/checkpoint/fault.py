@@ -6,7 +6,14 @@ test (or a chaos-engineering harness) schedule one fault:
 
     LGBM_TPU_FAULT_ITER=<k>     fire when training reaches iteration k
                                 (0-based, BEFORE the iteration runs)
-    LGBM_TPU_FAULT_RANK=<r>     only on this rank (default 0)
+    LGBM_TPU_FAULT_REQUEST=<n>  fire when a serving replica has ADMITTED
+                                its n-th predict request (1-based, BEFORE
+                                serving it — the in-flight request is
+                                lost with the process, which is exactly
+                                the case the fleet router's retry must
+                                absorb)
+    LGBM_TPU_FAULT_RANK=<r>     only on this rank (default 0; training
+                                faults only — replicas are single-process)
     LGBM_TPU_FAULT_MODE=exit    die like a preempted worker: os._exit,
                                 no cleanup, no atexit (default)
     LGBM_TPU_FAULT_MODE=raise   raise InjectedWorkerFault instead — the
@@ -14,10 +21,13 @@ test (or a chaos-engineering harness) schedule one fault:
     LGBM_TPU_FAULT_EXIT_CODE    exit status for mode=exit (default 43)
 
 The engine's training loop calls ``maybe_inject_fault(it)`` each
-iteration; with no LGBM_TPU_FAULT_ITER set this is a single dict lookup.
-The cluster supervisor (cluster.train_distributed) strips LGBM_TPU_FAULT_*
-from worker environments on restart attempts, modelling a TRANSIENT fault
-(a preemption that does not recur) so the relaunched job can finish.
+iteration and the serving front-end calls its own
+``RequestFaultLatch.maybe_inject(count)`` per admitted predict; with no
+fault env set each is a single dict lookup.  Both supervisors (cluster.py's
+training supervisor and fleet/supervisor.py's replica supervisor) strip
+LGBM_TPU_FAULT_* from child environments on restart attempts, modelling a
+TRANSIENT fault (a preemption that does not recur) so the relaunched
+job/replica can finish.
 """
 
 from __future__ import annotations
@@ -27,14 +37,16 @@ import sys
 from typing import Optional
 
 __all__ = ["InjectedWorkerFault", "fault_spec", "maybe_inject_fault",
+           "request_fault_spec", "RequestFaultLatch",
            "FAULT_ENV_VARS", "DEFAULT_FAULT_EXIT_CODE"]
 
 FAULT_ITER_ENV = "LGBM_TPU_FAULT_ITER"
+FAULT_REQUEST_ENV = "LGBM_TPU_FAULT_REQUEST"
 FAULT_RANK_ENV = "LGBM_TPU_FAULT_RANK"
 FAULT_MODE_ENV = "LGBM_TPU_FAULT_MODE"
 FAULT_EXIT_CODE_ENV = "LGBM_TPU_FAULT_EXIT_CODE"
-FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_RANK_ENV, FAULT_MODE_ENV,
-                  FAULT_EXIT_CODE_ENV)
+FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_REQUEST_ENV, FAULT_RANK_ENV,
+                  FAULT_MODE_ENV, FAULT_EXIT_CODE_ENV)
 DEFAULT_FAULT_EXIT_CODE = 43
 
 
@@ -74,3 +86,52 @@ def maybe_inject_fault(iteration: int) -> None:
     sys.stderr.flush()
     # a preempted TPU worker gets no goodbye: skip atexit, GC, flushes
     os._exit(spec["exit_code"])
+
+
+def request_fault_spec() -> Optional[dict]:
+    """Parse the serving-side fault env; None when none is scheduled."""
+    raw = os.environ.get(FAULT_REQUEST_ENV)
+    if raw is None or raw == "":
+        return None
+    return {
+        "request": int(raw),
+        "mode": os.environ.get(FAULT_MODE_ENV, "exit") or "exit",
+        "exit_code": int(os.environ.get(FAULT_EXIT_CODE_ENV,
+                                        str(DEFAULT_FAULT_EXIT_CODE))),
+    }
+
+
+# mode=raise survives the "death": latch per scheduled count so ONE fault
+# fires per schedule (the contract), not one per subsequent request —
+# otherwise an in-process replica would fail every predict forever while
+# still answering health polls, flapping instead of dying once.  The
+# latch lives PER CONSUMER (each ServingApp owns one, like its admitted-
+# request counter): a module-global latch re-armed at every app
+# construction would make an already-fired sibling fire again, since the
+# ``>=`` schedule keeps matching every later count.
+class RequestFaultLatch:
+    """One-shot state for mode=raise; each ServingApp is an independent
+    consumer of the schedule with its own request counter and latch."""
+
+    def __init__(self) -> None:
+        self._fired: Optional[int] = None
+
+    def maybe_inject(self, count: int) -> None:
+        """Die (or raise) if a fault is scheduled for this predict-request
+        count.  ``>=`` rather than ``==``: concurrent admissions may skip
+        past the exact count between the increment and this check, and a
+        scheduled kill must not be lost to that race."""
+        spec = request_fault_spec()
+        if spec is None or count < spec["request"]:
+            return
+        if spec["mode"] == "raise":
+            if self._fired == spec["request"]:
+                return
+            self._fired = spec["request"]
+            raise InjectedWorkerFault(
+                f"injected fault at serving request {count}")
+        sys.stderr.write(f"LGBM_TPU_FAULT: killing replica at request "
+                         f"{count}\n")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(spec["exit_code"])

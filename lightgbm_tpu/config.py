@@ -220,6 +220,46 @@ _PARAMS: List[ParamSpec] = [
     _p("serving_max_batch", int, 1024, ("max_batch",), ">0"),
     _p("serving_max_wait_ms", float, 2.0, ("max_wait_ms",), ">=0"),
     _p("serving_max_queue_rows", int, 16384, ("max_queue_rows",), ">0"),
+    _p("serving_continuous_batching", bool, True, ("continuous_batching",),
+       desc="admit requests into the next in-flight padded batch while "
+            "the device is busy (launch the moment it frees) instead of "
+            "flush-and-wait; bit-identical results, same bucket ladder"),
+    # ---- Fleet serving (task=serve + fleet_*; lightgbm_tpu/fleet/) ----
+    _p("fleet_role", str, "", (), "in:|replica|router",
+       "task=serve role: empty = single server (or full fleet launch "
+       "when fleet_replicas>0), replica = one supervised worker, "
+       "router = front door over fleet_replica_urls"),
+    _p("fleet_replicas", int, 0, (), ">=0",
+       "spawn this many supervised replica processes and run the router "
+       "in front of them (0 = single-process serving)"),
+    _p("fleet_base_port", int, 0, (), ">=0",
+       "first replica port, replica i listens on fleet_base_port+i "
+       "(0 = pick free ports)"),
+    _p("fleet_replica_urls", str, "",
+       ("fleet_replica_endpoints", "replica_urls"),
+       desc="comma-separated host:port list of externally managed "
+            "replicas (fleet_role=router)"),
+    _p("fleet_slo_p99_ms", float, 0.0, (), ">=0",
+       "shed/reroute when a replica's p99 latency gauge exceeds this "
+       "for fleet_breach_polls consecutive polls (0 = don't check p99)"),
+    _p("fleet_slo_queue_rows", int, 0, (), ">=0",
+       "shed/reroute when a replica's queued rows exceed this for "
+       "fleet_breach_polls consecutive polls (0 = don't check queue)"),
+    _p("fleet_breach_polls", int, 3, (), ">0",
+       "consecutive breaching health polls before a replica is shed"),
+    _p("fleet_recover_polls", int, 5, (), ">0",
+       "consecutive healthy polls before a shed replica serves again"),
+    _p("fleet_poll_ms", float, 100.0, (), ">=0",
+       "router health-poll interval (0 = poll only on demand)"),
+    _p("fleet_ready_timeout_s", float, 180.0, (), ">0",
+       "how long the fleet launcher waits for every replica's first "
+       "/healthz (covers jax import + model load + bundle deserialize)"),
+    _p("fleet_max_restarts", int, 2, (), ">=0",
+       "per-replica supervised restart budget (cluster.py-style bounded "
+       "backoff; fault env stripped on relaunch)"),
+    _p("fleet_restart_backoff_s", float, 0.5, (), ">=0",
+       "base backoff before relaunching a dead replica (doubles per "
+       "restart)"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),

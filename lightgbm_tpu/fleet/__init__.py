@@ -1,0 +1,101 @@
+"""Fleet serving tier: router + N replica workers + supervision.
+
+PR 1 built a single-process inference server; this package turns it into
+a topology that plausibly fronts heavy traffic:
+
+- ``FleetRouter`` (router.py) — one front door routing each predict to
+  the least-loaded replica, rerouting around failures, shedding at the
+  door when no replica is within SLO, and broadcasting publish/rollback
+  fleet-wide.  Transport-free ``handle`` contract, ServingApp-compatible.
+- ``SLOPolicy`` / ``ReplicaSLO`` (slo.py) — the per-replica
+  breach→shed→recover state machine fed by each replica's telemetry
+  gauges (p99, queue depth, in-flight batch fill).
+- ``FleetSupervisor`` (supervisor.py) — spawns one serving process per
+  replica, restarts the dead ones with bounded backoff (fault env
+  stripped, cluster.py-style), each replica cold-starting warm from the
+  shared AOT bundle.
+
+CLI: ``task=serve fleet_replicas=N`` launches the whole fleet
+(replicas on ``fleet_base_port..+N-1``, router on ``serving_port``);
+``task=serve fleet_role=router fleet_replica_urls=...`` runs just a
+router over externally managed replicas; ``fleet_role=replica`` is the
+single-process server (what the supervisor spawns).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .router import FleetRouter, HttpReplica, ReplicaTransportError
+from .slo import DOWN, HEALTHY, SHED, ReplicaSLO, SLOPolicy
+from .supervisor import FleetSupervisor, default_replica_argv
+
+__all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError",
+           "SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN",
+           "FleetSupervisor", "default_replica_argv",
+           "policy_from_config", "serve_fleet", "serve_router"]
+
+
+def policy_from_config(config) -> SLOPolicy:
+    return SLOPolicy(p99_ms=config.fleet_slo_p99_ms,
+                     queue_rows=config.fleet_slo_queue_rows,
+                     breach_polls=config.fleet_breach_polls,
+                     recover_polls=config.fleet_recover_polls)
+
+
+def _make_router(config, urls) -> FleetRouter:
+    return FleetRouter([HttpReplica(u) for u in urls],
+                       policy=policy_from_config(config),
+                       poll_interval_ms=config.fleet_poll_ms)
+
+
+def serve_router(config, urls: Optional[list] = None) -> None:
+    """Blocking router over externally managed replicas
+    (task=serve fleet_role=router fleet_replica_urls=host:p1,host:p2)."""
+    from ..log import LightGBMError
+    from ..serving.server import serve
+    urls = urls if urls is not None else [
+        u for u in str(config.fleet_replica_urls).split(",") if u.strip()]
+    if not urls:
+        raise LightGBMError(
+            "fleet_role=router requires fleet_replica_urls=host:port,...")
+    router = _make_router(config, urls)
+    serve(router, host=config.serving_host, port=config.serving_port)
+
+
+def serve_fleet(raw_params: dict, config) -> None:
+    """Blocking full-fleet launch: spawn fleet_replicas serving processes
+    (supervised, warm from the shared AOT bundle), then run the router in
+    THIS process on serving_port."""
+    import signal
+
+    from ..cluster import find_open_ports
+    from ..log import log_info
+    from ..serving.server import serve
+    # SIGTERM's default action skips every finally: the launcher dies and
+    # ORPHANS its replica processes.  Convert it to a normal unwind so
+    # serve()'s cleanup and stop_all() below run (SIGINT already raises).
+    def _terminate(signum, frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    n = int(config.fleet_replicas)
+    if config.fleet_base_port > 0:
+        ports = [config.fleet_base_port + i for i in range(n)]
+    else:
+        ports = find_open_ports(n, host=config.serving_host)
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(raw_params, port),
+        ports, host=config.serving_host,
+        max_restarts=config.fleet_max_restarts,
+        restart_backoff_s=config.fleet_restart_backoff_s)
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=config.fleet_ready_timeout_s)
+        sup.start_watching()
+        router = _make_router(config, sup.urls)
+        log_info(f"fleet: {n} replicas ready on ports {ports}; router on "
+                 f"http://{config.serving_host}:{config.serving_port}")
+        serve(router, host=config.serving_host, port=config.serving_port)
+    finally:
+        sup.stop_all()

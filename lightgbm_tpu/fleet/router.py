@@ -1,0 +1,649 @@
+"""FleetRouter: one front door for N replica workers.
+
+The router is the fleet's only stateful coordination point, and it holds
+no model state at all — replicas own the models (each a full ServingApp
+warmed from the shared AOT bundle), the router owns *placement*:
+
+- **routing**: each predict goes to the routable replica with the fewest
+  queued+in-flight rows as of its last health poll (cheapest useful load
+  signal; ties break round-robin so equally-idle replicas share warmup
+  traffic);
+- **rerouting**: a forwarding failure (connection refused/reset — the
+  killed-replica case) marks the replica down IMMEDIATELY and retries the
+  request on the next-best peer, so one replica dying mid-soak loses zero
+  requests; a replica's own 429 (its bounded queue overflowed between
+  polls) is treated the same way — the load reroutes instead of
+  surfacing a retryable error to the client;
+- **shedding**: when no replica is routable (all breached/down per
+  fleet/slo.py) the router answers 503 at the front door — SLO-aware
+  backpressure instead of the old queue-full-only cliff;
+- **broadcast**: publish/rollback fan out to EVERY reachable replica so a
+  hot-swap lands fleet-wide in one call.
+
+``FleetRouter.handle(method, path, body)`` keeps the same transport-free
+contract as ``ServingApp.handle`` — ``serving.server.make_server`` wraps
+either, tests drive the router without sockets by injecting fake replica
+endpoints, and the router's own gauges (per-replica state/load, forwards,
+reroutes, sheds, router-side latency) live in a telemetry
+``MetricsRegistry`` rendered at ``GET /v1/metrics/prometheus``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..log import LightGBMError, log_info, log_warning
+from ..serving.metrics import LatencyWindow
+from ..telemetry.registry import MetricsRegistry
+from .slo import ReplicaSLO, SLOPolicy
+
+__all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError"]
+
+
+class ReplicaTransportError(LightGBMError):
+    """The replica could not be reached at all (vs. an HTTP error it
+    returned): the router may safely retry elsewhere."""
+
+
+class HttpReplica:
+    """Minimal stdlib HTTP client for one replica endpoint.
+
+    Connections are pooled per (thread, replica) — keep-alive matters at
+    soak rates, where a fresh TCP connect per forwarded predict is real
+    overhead.  Any socket-level failure drops the pooled connection and
+    surfaces as ``ReplicaTransportError`` so the router can distinguish
+    "replica gone" (retry elsewhere) from "replica answered an error"
+    (forward it); a restarted replica just gets a fresh connection on the
+    next call."""
+
+    def __init__(self, url: str, timeout_s: float = 30.0):
+        # accept "host:port" or "http://host:port"
+        url = url.strip()
+        if url.startswith("http://"):
+            url = url[len("http://"):]
+        url = url.rstrip("/")
+        if ":" not in url:
+            raise LightGBMError(f"replica url needs host:port, got {url!r}")
+        host, port = url.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.name = f"{self.host}:{self.port}"
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+        # bumped via invalidate_pool() when the router learns the replica
+        # died or restarted: pooled keep-alive sockets from before then
+        # are stale, and a non-retried POST (publish/rollback — retrying
+        # could double-apply) written to one fails with a broken pipe
+        # even though the replica is back and healthy
+        self._gen = 0
+
+    def invalidate_pool(self) -> None:
+        """Presume every pooled connection stale; reconnect on next use."""
+        self._gen += 1
+
+    def _conn(self, timeout_s: float):
+        import http.client
+        import socket
+        conn = getattr(self._local, "conn", None)
+        if conn is not None and getattr(self._local, "gen", -1) != self._gen:
+            self._drop_conn()
+            conn = None
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=timeout_s)
+            conn.connect()
+            # TCP_NODELAY: a forwarded predict is one small write per
+            # direction — Nagle + delayed ACK otherwise turns each hop
+            # into tens of ms of idle waiting
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.conn = conn
+            self._local.gen = self._gen
+        else:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                timeout_s: Optional[float] = None) -> Tuple[int, dict]:
+        import http.client
+        payload = None if body is None else json.dumps(body).encode()
+        headers = ({"Content-Type": "application/json"}
+                   if payload is not None else {})
+        # one retry on a fresh connection: a pooled keep-alive socket the
+        # server closed between calls fails with a reset/EOF that says
+        # nothing about the replica's health; a FRESH connect failing is
+        # the replica genuinely unreachable — no retry.  Only requests
+        # that are safe to EXECUTE TWICE auto-retry: a publish/rollback
+        # the replica may have already processed before the socket died
+        # would double-apply (two version bumps — a later rollback then
+        # lands on the duplicate); predicts are pure per-row functions.
+        retry_safe = method == "GET" or path.endswith(":predict")
+        for attempt in (0, 1):
+            reused = getattr(self._local, "conn", None) is not None
+            try:
+                conn = self._conn(timeout_s or self.timeout_s)
+                conn.request(method, path, payload, headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    self._drop_conn()
+                try:
+                    return resp.status, json.loads(data)
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    # e.g. the Prometheus text route
+                    return resp.status, {"text": data.decode(errors="replace")}
+            except (OSError, http.client.HTTPException) as exc:
+                self._drop_conn()
+                if not reused or attempt == 1 or not retry_safe:
+                    raise ReplicaTransportError(
+                        f"replica {self.name}: {type(exc).__name__}: "
+                        f"{exc}") from exc
+
+    def health(self, timeout_s: float = 2.0) -> Optional[Dict]:
+        """The replica's SLO gauges, or None when unreachable/unhealthy."""
+        try:
+            status, body = self.request("GET", "/v1/fleet/health",
+                                        timeout_s=timeout_s)
+        except ReplicaTransportError:
+            return None
+        if status != 200:
+            return None
+        return body.get("gauges", {})
+
+
+class _Replica:
+    """Router-side record: endpoint + SLO state + last-known load."""
+
+    def __init__(self, endpoint, slo: ReplicaSLO):
+        self.endpoint = endpoint
+        self.slo = slo
+        self.load_rows = 0        # queued + in-flight rows at last poll
+        # rows forwarded by THIS router and not yet answered: the live
+        # complement to load_rows, which refreshes only at poll time —
+        # without it every request between two polls ranks the same
+        # replica first and herds onto it for a full poll interval
+        self.router_inflight_rows = 0
+        self.last_poll_s = 0.0
+        # restart evidence gating publish replay, so a transient
+        # health-poll blip doesn't trigger a redundant publish that
+        # desynchronizes version counters fleet-wide.  Primary signal:
+        # the replica's boot_s gauge (a restarted replica is a fresh
+        # process with a new boot time — works even before it serves
+        # its first request).  Fallback for gauge sources without
+        # boot_s: a rejoining replica reporting FEWER cumulative
+        # requests than this high-water mark was genuinely restarted.
+        self.boot_s: Optional[float] = None
+        self.requests_high = 0
+
+
+class FleetRouter:
+    def __init__(self, replicas: List, policy: Optional[SLOPolicy] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 poll_interval_ms: float = 100.0,
+                 request_timeout_s: float = 30.0,
+                 health_timeout_s: float = 2.0,
+                 autostart: bool = True):
+        if not replicas:
+            raise LightGBMError("FleetRouter needs at least one replica")
+        policy = policy or SLOPolicy()
+        self._replicas = [_Replica(ep, ReplicaSLO(policy))
+                          for ep in replicas]
+        self.policy = policy
+        self.registry = registry or MetricsRegistry()
+        self.poll_interval_s = float(poll_interval_ms) / 1e3
+        self.request_timeout_s = float(request_timeout_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self._lock = threading.Lock()
+        self._rr = 0                      # round-robin tie-breaker
+        self._next_demand_poll_s = 0.0    # rate limit for pollless mode
+        self._started = False
+        self._closed = False
+        # last successful publish body per model name: replayed to a
+        # replica that comes back from DOWN, because a supervised restart
+        # respawns it from its ORIGINAL argv — without the replay it
+        # would rejoin serving the pre-hot-swap model indefinitely
+        self._published: Dict[str, dict] = {}
+        from concurrent.futures import ThreadPoolExecutor
+        # SEPARATE pools for health sweeps and publish broadcasts: a
+        # publish occupies a worker for up to request_timeout_s per
+        # replica (model load + warmup), and health probes queued behind
+        # broadcasts would time out and flap perfectly healthy replicas
+        # down fleet-wide — no shared sizing is safe against two
+        # overlapping broadcasts, so the sweep gets its own workers
+        self._health_pool = ThreadPoolExecutor(
+            max_workers=max(len(replicas), 2),
+            thread_name_prefix="lgbm-tpu-fleet-health")
+        self._bcast_pool = ThreadPoolExecutor(
+            max_workers=max(len(replicas), 2),
+            thread_name_prefix="lgbm-tpu-fleet-bcast")
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self.latency = LatencyWindow()
+        # router-side observables, labeled per replica where meaningful
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "lgbm_fleet_requests_total", "predict requests at the router")
+        self._m_shed = reg.counter(
+            "lgbm_fleet_shed_total",
+            "requests shed because no replica was within SLO")
+        self._m_reroutes = reg.counter(
+            "lgbm_fleet_reroutes_total",
+            "forwards retried on another replica after a failure")
+        self._m_errors = reg.counter(
+            "lgbm_fleet_errors_total",
+            "requests that failed on every routable replica")
+        self._m_latency = reg.histogram(
+            "lgbm_fleet_request_latency_seconds",
+            "router-side end-to-end predict latency")
+        self._m_forwarded = [reg.counter(
+            "lgbm_fleet_forwarded_total", "predicts forwarded",
+            replica=r.endpoint.name) for r in self._replicas]
+        self._m_up = [reg.gauge(
+            "lgbm_fleet_replica_up",
+            "1 routable / 0 shed or down", replica=r.endpoint.name)
+            for r in self._replicas]
+        self._m_load = [reg.gauge(
+            "lgbm_fleet_replica_load_rows",
+            "queued+in-flight rows at last poll",
+            replica=r.endpoint.name) for r in self._replicas]
+        self._m_p99 = [reg.gauge(
+            "lgbm_fleet_replica_p99_ms", "replica p99 at last poll",
+            replica=r.endpoint.name) for r in self._replicas]
+        self._m_fill = [reg.gauge(
+            "lgbm_fleet_replica_batch_fill",
+            "replica in-flight batch fill at last poll",
+            replica=r.endpoint.name) for r in self._replicas]
+        for g in self._m_up:
+            g.set(1)                       # optimistic, like ReplicaSLO
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        self._started = True
+        if self._poll_thread is None and self.poll_interval_s > 0:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="lgbm-tpu-fleet-poll",
+                daemon=True)
+            self._poll_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10.0)
+            self._poll_thread = None
+        self._health_pool.shutdown(wait=False)
+        self._bcast_pool.shutdown(wait=False)
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    def poll_once(self) -> None:
+        """One health sweep: refresh every replica's SLO state + gauges.
+        Public so tests (and a pollless router) can drive it directly.
+
+        Health requests go out IN PARALLEL (persistent worker pool, so
+        the per-thread connection pooling still applies): each can block
+        up to health_timeout_s, and one hung replica must not stretch
+        every other replica's detection/recovery hysteresis by its
+        timeout."""
+        futures = [self._health_pool.submit(rep.endpoint.health,
+                                            self.health_timeout_s)
+                   for rep in self._replicas]
+        for i, rep in enumerate(self._replicas):
+            try:
+                gauges = futures[i].result(self.health_timeout_s + 5.0)
+            except Exception:
+                gauges = None
+            with self._lock:
+                before = rep.slo.state
+                state = rep.slo.observe(gauges)
+                rep.last_poll_s = time.time()
+                requests = (int(gauges.get("requests", 0))
+                            if gauges is not None else 0)
+                # replay only on evidence of a real restart (a transient
+                # poll blip must not trigger a redundant publish):
+                # boot_s changed when available — never-seen counts as
+                # changed, a down replica we know nothing about may have
+                # missed a publish — else the requests-drop heuristic
+                if gauges is not None and "boot_s" in gauges:
+                    restarted = gauges["boot_s"] != rep.boot_s
+                else:
+                    restarted = requests < rep.requests_high
+                replay = (before == "down" and gauges is not None
+                          and bool(self._published) and restarted)
+                published = dict(self._published) if replay else None
+                if gauges is None or restarted:
+                    # every pooled keep-alive socket predating a death /
+                    # restart is stale; reconnect lazily (publishes are
+                    # not retried on stale sockets — see HttpReplica)
+                    invalidate = getattr(rep.endpoint, "invalidate_pool",
+                                         None)
+                    if invalidate is not None:
+                        invalidate()
+                if gauges is not None:
+                    rep.boot_s = gauges.get("boot_s", rep.boot_s)
+                    if replay:
+                        rep.requests_high = requests
+                    else:
+                        rep.requests_high = max(rep.requests_high,
+                                                requests)
+                    rep.load_rows = (int(gauges.get("queue_rows", 0))
+                                     + int(gauges.get("inflight_rows", 0)))
+                    self._m_load[i].set(rep.load_rows)
+                    self._m_p99[i].set(float(gauges.get("p99_ms", 0.0)))
+                    self._m_fill[i].set(float(gauges.get("batch_fill", 0.0)))
+                self._m_up[i].set(1 if rep.slo.routable else 0)
+            if replay:
+                # back from the dead: a supervised restart reloaded the
+                # replica's ORIGINAL models, so hot-swaps it missed must
+                # be replayed before it takes real traffic (it is still
+                # in shed for recover_polls polls — the replay usually
+                # wins that race, and a lost race only serves the old
+                # version briefly, same as before the swap landed)
+                threading.Thread(target=self._replay_publishes,
+                                 args=(rep, published), daemon=True,
+                                 name="lgbm-tpu-fleet-replay").start()
+            if state != before:
+                (log_warning if state != "healthy" else log_info)(
+                    f"fleet: replica {rep.endpoint.name} {before} -> "
+                    f"{state} ({'; '.join(rep.slo.last_reasons) or 'ok'})")
+
+    def _replay_publishes(self, rep, published: Dict[str, dict]) -> None:
+        for name in published:
+            # re-read the cache at send time, and re-send if a concurrent
+            # fleet-wide publish moved it while our replay was in flight —
+            # otherwise the replay could land AFTER a newer broadcast and
+            # pin this one replica on the older version until its next
+            # restart.  Bounded: a live system converges in one pass.
+            for _ in range(3):
+                with self._lock:
+                    body = self._published.get(name)
+                if body is None:          # rolled back meanwhile
+                    break
+                try:
+                    status, _ = rep.endpoint.request(
+                        "POST", f"/v1/models/{name}:publish", body,
+                        timeout_s=self.request_timeout_s)
+                    (log_info if status == 200 else log_warning)(
+                        f"fleet: replayed publish of {name!r} to rejoined "
+                        f"replica {rep.endpoint.name} (status {status})")
+                except ReplicaTransportError as exc:
+                    log_warning(f"fleet: publish replay of {name!r} to "
+                                f"{rep.endpoint.name} failed: {exc}")
+                    break
+                with self._lock:
+                    if self._published.get(name) == body:
+                        break             # cache unchanged: we sent latest
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception as exc:     # a poll bug must not kill routing
+                log_warning(f"fleet: health poll failed: {exc!r}")
+
+    # ------------------------------------------------------------------
+    _DEMAND_POLL_MIN_INTERVAL_S = 1.0
+
+    def _maybe_poll_inline(self) -> None:
+        """fleet_poll_ms=0 runs no poll thread, so health state refreshes
+        ON DEMAND here instead: recovery (down -> shed -> healthy) only
+        happens inside ReplicaSLO.observe, which only poll_once calls —
+        without this, one transport blip would shed a replica forever.
+        Rate-limited so a down replica costs at most one health sweep per
+        interval, not one per request.  Only active on a STARTED router:
+        an unstarted one (autostart=False, tests) is under manual
+        poll_once control."""
+        if (not self._started or self._poll_thread is not None
+                or self._closed):
+            return
+        now = time.time()
+        with self._lock:
+            need = any(not rep.slo.routable or rep.last_poll_s == 0.0
+                       for rep in self._replicas)
+            if not need or now < self._next_demand_poll_s:
+                return
+            self._next_demand_poll_s = now + self._DEMAND_POLL_MIN_INTERVAL_S
+        self.poll_once()
+
+    def _ranked(self) -> List[int]:
+        """Routable replica indices, least-loaded first (round-robin among
+        equals so idle replicas share traffic).  Load is the replica's
+        last-polled queue+in-flight rows PLUS rows this router has
+        forwarded since and not yet heard back about — the live term is
+        what spreads concurrent requests between polls."""
+        self._maybe_poll_inline()
+        with self._lock:
+            self._rr += 1
+            order = [(rep.load_rows + rep.router_inflight_rows,
+                      (i + self._rr) % len(self._replicas), i)
+                     for i, rep in enumerate(self._replicas)
+                     if rep.slo.routable]
+        return [i for _, _, i in sorted(order)]
+
+    def _mark_down(self, idx: int, reason: str) -> None:
+        rep = self._replicas[idx]
+        with self._lock:
+            rep.slo.mark_down(reason)
+            self._m_up[idx].set(0)
+        invalidate = getattr(rep.endpoint, "invalidate_pool", None)
+        if invalidate is not None:
+            invalidate()
+        log_warning(f"fleet: replica {rep.endpoint.name} marked down "
+                    f"({reason})")
+
+    def _forward_predict(self, name: str, body: dict) -> Tuple[int, dict]:
+        self._m_requests.inc()
+        t0 = time.perf_counter()
+        rows = body.get("rows")
+        # a flat 1-D body is ONE row of n_features (ServingApp reshapes
+        # it), not n_features rows — miscounting it would make the
+        # serving replica look features-times busier than it is
+        nrows = (len(rows) if isinstance(rows, list) and rows
+                 and isinstance(rows[0], (list, tuple)) else 1)
+        attempts = 0
+        candidates = self._ranked()
+        tried = set()
+        last_err: Optional[str] = None
+        while candidates:
+            idx = candidates[0]
+            tried.add(idx)
+            rep = self._replicas[idx]
+            attempts += 1
+            with self._lock:
+                rep.router_inflight_rows += nrows
+            try:
+                status, payload = rep.endpoint.request(
+                    "POST", f"/v1/models/{name}:predict", body,
+                    timeout_s=self.request_timeout_s)
+            except ReplicaTransportError as exc:
+                self._mark_down(idx, str(exc))
+                last_err = str(exc)
+                self._m_reroutes.inc()
+                candidates = [i for i in self._ranked() if i not in tried]
+                continue
+            finally:
+                with self._lock:
+                    rep.router_inflight_rows -= nrows
+            if status == 429 or status >= 500:
+                # 429: the replica's own bounded queue overflowed between
+                # polls; 5xx: it is draining for shutdown/restart — both
+                # are load to reroute, not errors to forward
+                last_err = payload.get("error", f"replica status {status}")
+                self._m_reroutes.inc()
+                candidates = [i for i in self._ranked() if i not in tried]
+                continue
+            elapsed = time.perf_counter() - t0
+            self.latency.observe(elapsed)
+            self._m_latency.observe(elapsed)
+            self._m_forwarded[idx].inc()
+            if isinstance(payload, dict):
+                payload.setdefault("replica", rep.endpoint.name)
+                if attempts > 1:
+                    payload.setdefault("rerouted", attempts - 1)
+            return status, payload
+        if last_err is None:
+            # nothing was routable to begin with: SLO shedding
+            self._m_shed.inc()
+            states = self.replica_states()
+            return 503, {"error": "fleet shedding load: no replica within "
+                                  "SLO", "replicas": states}
+        self._m_errors.inc()
+        return 503, {"error": f"no replica could serve the request; "
+                              f"last: {last_err}"}
+
+    def _broadcast(self, method: str, path: str, body: dict,
+                   name: str, verb: str) -> Tuple[int, dict]:
+        """publish/rollback fan-out: try every replica (even shed ones —
+        a recovering replica must not come back serving a stale model),
+        IN PARALLEL — a publish pays model load + bundle deserialize +
+        warmup per replica, and a fleet-wide hot-swap should cost one
+        replica's worth of wall clock, not N.  Succeeds if every
+        REACHABLE replica succeeded."""
+        def _one(rep):
+            try:
+                status, payload = rep.endpoint.request(
+                    method, path, body, timeout_s=self.request_timeout_s)
+                return {"status": status, **(
+                    payload if isinstance(payload, dict) else {})}
+            except ReplicaTransportError as exc:
+                # a socket TIMEOUT is not "unreachable": the replica is
+                # alive (health polls keep passing, so it never restarts
+                # and the rejoin replay never fires) and the publish may
+                # still land after we stop waiting — an UNKNOWN outcome
+                # that must fail the broadcast like the pool-level
+                # timeout below, not be excluded from the success
+                # computation.  Only a refused/reset connection (replica
+                # genuinely gone; it republishes from its argv or the
+                # replay cache on rejoin) is safe to exclude.
+                if isinstance(exc.__cause__, TimeoutError):
+                    return {"status": -1,
+                            "error": f"publish outcome unknown: {exc}"}
+                return {"status": 0, "error": str(exc)}
+
+        # the persistent broadcast pool, not ad-hoc threads: its workers'
+        # thread-local connections get reused across broadcasts instead
+        # of leaking one fresh socket per replica per publish (and it is
+        # NOT the health pool — see __init__ on starvation)
+        futures = [self._bcast_pool.submit(_one, rep)
+                   for rep in self._replicas]
+        results: Dict[str, Dict] = {}
+        for rep, fut in zip(self._replicas, futures):
+            try:
+                results[rep.endpoint.name] = fut.result(
+                    self.request_timeout_s + 5.0)
+            except Exception:
+                # a publish that outlived its timeout has an UNKNOWN
+                # outcome — that must fail the broadcast, not be
+                # silently excluded from the success computation
+                results[rep.endpoint.name] = {
+                    "status": -1,
+                    "error": "publish still in flight (timed out)"}
+        ok = sum(r["status"] == 200 for r in results.values())
+        reachable = [r for r in results.values() if r["status"] != 0]
+        all_ok = bool(reachable) and all(r["status"] == 200
+                                         for r in reachable)
+        if all_ok:
+            # maintain the rejoin-replay cache: a fleet-wide publish is
+            # remembered (replayed to replicas that restart with their
+            # original models), and a fleet-wide ROLLBACK withdraws the
+            # memory — replaying a rolled-back publish to a rejoining
+            # replica would resurrect the withdrawn version on one
+            # replica only
+            if verb == "publish":
+                with self._lock:
+                    self._published[name] = dict(body)
+            elif verb == "rollback":
+                with self._lock:
+                    self._published.pop(name, None)
+        return (200 if all_ok else 502), {"replicas": results,
+                                          "succeeded": ok}
+
+    # ------------------------------------------------------------------
+    def replica_states(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                rep.endpoint.name: {
+                    "state": rep.slo.state,
+                    "load_rows": rep.load_rows,
+                    "reasons": list(rep.slo.last_reasons),
+                    "transitions": rep.slo.transitions,
+                }
+                for rep in self._replicas
+            }
+
+    def handle(self, method: str, path: str,
+               body: Optional[dict] = None) -> Tuple[int, dict]:
+        """Transport-free request handler, ServingApp.handle-compatible."""
+        try:
+            return self._route(method.upper(), path.rstrip("/") or "/",
+                               body or {})
+        except ReplicaTransportError as exc:
+            return 502, {"error": str(exc)}
+        except LightGBMError as exc:
+            return 400, {"error": str(exc)}
+        except (KeyError, ValueError, TypeError) as exc:
+            return 400, {"error": f"{type(exc).__name__}: {exc}"}
+        except Exception as exc:
+            # same contract as ServingApp.handle: an escaped exception
+            # tears the connection down, which an upstream load balancer
+            # cannot distinguish from a dead router — always answer
+            log_warning(f"fleet: unhandled router error for {method} "
+                        f"{path}: {exc!r}")
+            return 500, {"error": f"internal: {type(exc).__name__}: {exc}"}
+
+    def _route(self, method: str, path: str, body: dict) -> Tuple[int, dict]:
+        if self._closed:
+            return 503, {"error": "router is closed"}
+        if method == "GET" and path == "/healthz":
+            states = self.replica_states()
+            routable = sum(s["state"] == "healthy" for s in states.values())
+            return 200, {"status": "ok" if routable else "shedding",
+                         "role": "router", "routable": routable,
+                         "replicas": states}
+        if method == "GET" and path == "/v1/fleet/replicas":
+            return 200, {"replicas": self.replica_states()}
+        if method == "GET" and path == "/v1/metrics":
+            out = {"router": self.registry.snapshot(),
+                   "replicas": self.replica_states()}
+            out["router"]["p_ms"] = self.latency.percentiles()
+            return 200, out
+        if method == "GET" and path == "/v1/metrics/prometheus":
+            from ..telemetry import prometheus_text
+            return 200, prometheus_text(self.registry)
+        if method == "GET" and path == "/v1/models":
+            for idx in self._ranked():
+                try:
+                    return self._replicas[idx].endpoint.request(
+                        "GET", path, None, timeout_s=self.request_timeout_s)
+                except ReplicaTransportError as exc:
+                    self._mark_down(idx, str(exc))
+            return 503, {"error": "no routable replica"}
+        if path.startswith("/v1/models/") and ":" in path and method == "POST":
+            rest = path[len("/v1/models/"):]
+            name, _, verb = rest.rpartition(":")
+            if name and verb == "predict":
+                return self._forward_predict(name, body)
+            if name and verb in ("publish", "rollback"):
+                return self._broadcast(method, path, body, name, verb)
+        return 404, {"error": f"no route for {method} {path}"}

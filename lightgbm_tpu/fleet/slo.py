@@ -1,0 +1,145 @@
+"""SLO-aware backpressure: the router-side breach→shed→recover machine.
+
+PR 1's only backpressure was ``QueueFullError`` — a replica had to be
+LITERALLY full before anyone reacted, by which point its latency tail was
+already blown.  Fleet routing instead watches each replica's telemetry
+gauges (p99 latency, queued rows, in-flight batch fill — the replica
+exposes them on ``GET /v1/fleet/health``) against explicit SLO targets
+and reacts BEFORE the queue-full cliff:
+
+- a replica whose gauges breach the targets for ``breach_polls``
+  CONSECUTIVE polls is marked ``shed``: the router stops routing new load
+  to it (reroute to healthy peers) until it has been back under target
+  for ``recover_polls`` consecutive polls — hysteresis on both edges so a
+  single noisy poll neither sheds a healthy replica nor restores a sick
+  one;
+- a replica whose health poll fails outright (connection refused, timed
+  out — the killed-replica case) is ``down`` immediately, no hysteresis:
+  there is nothing to be gentle with, and every poll it misses would be a
+  routed request lost;
+- when NO replica is routable the router itself sheds (HTTP 503) — load
+  the fleet cannot serve within SLO is rejected at the front door where
+  the client can back off, instead of queueing into a latency collapse.
+
+The machine is deliberately transport-free — ``observe`` takes a plain
+gauges dict (or None for an unreachable replica), so tier-1 tests drive
+every transition with injected values and no sockets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN"]
+
+HEALTHY = "healthy"   # routable
+SHED = "shed"         # reachable but over SLO: no new load until recovered
+DOWN = "down"         # unreachable: no new load until it polls ok again
+
+
+class SLOPolicy:
+    """SLO targets plus the hysteresis widths.
+
+    A target of 0 (or negative) disables that gauge's check, so a
+    deployment can shed on queue depth alone, p99 alone, or both.
+    """
+
+    def __init__(self, p99_ms: float = 0.0, queue_rows: int = 0,
+                 breach_polls: int = 3, recover_polls: int = 5):
+        self.p99_ms = float(p99_ms)
+        self.queue_rows = int(queue_rows)
+        self.breach_polls = max(int(breach_polls), 1)
+        self.recover_polls = max(int(recover_polls), 1)
+
+    def breaches(self, gauges: Dict) -> List[str]:
+        """Which targets this gauge snapshot violates (empty = within SLO)."""
+        out = []
+        if self.p99_ms > 0 and float(gauges.get("p99_ms", 0.0)) > self.p99_ms:
+            out.append(f"p99_ms {float(gauges['p99_ms']):.1f} > "
+                       f"{self.p99_ms:g}")
+        if (self.queue_rows > 0
+                and int(gauges.get("queue_rows", 0)) > self.queue_rows):
+            out.append(f"queue_rows {int(gauges['queue_rows'])} > "
+                       f"{self.queue_rows}")
+        return out
+
+
+class ReplicaSLO:
+    """One replica's breach→shed→recover state, fed by health polls.
+
+    Not self-locking: the router mutates it only under its own lock (one
+    poll loop, plus ``mark_down`` from forwarding threads).
+    """
+
+    def __init__(self, policy: Optional[SLOPolicy] = None):
+        self.policy = policy or SLOPolicy()
+        self.state = HEALTHY          # optimistic before the first poll
+        self.last_gauges: Optional[Dict] = None
+        self.last_reasons: List[str] = []
+        self._breach_streak = 0
+        self._ok_streak = 0
+        self._last_requests: Optional[int] = None
+        self.transitions = 0          # state changes ever (observability)
+
+    @property
+    def routable(self) -> bool:
+        return self.state == HEALTHY
+
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.transitions += 1
+
+    def mark_down(self, reason: str = "transport error") -> None:
+        """Immediate demotion on a forwarding failure: the next request
+        must not wait for the poll loop to notice a dead replica."""
+        self.last_reasons = [reason]
+        self._breach_streak = 0
+        self._ok_streak = 0
+        self._set_state(DOWN)
+
+    def observe(self, gauges: Optional[Dict]) -> str:
+        """Feed one health poll (None = unreachable); returns the state."""
+        self.last_gauges = gauges
+        if gauges is None:
+            self.mark_down("health poll failed")
+            self._last_requests = None
+            return self.state
+        reasons = self.policy.breaches(gauges)
+        # staleness guard: the replica's p99 gauge is a ring of PAST
+        # request latencies — once shed, the replica gets no traffic, the
+        # ring never refreshes, and a p99 breach would hold forever (a
+        # permanent shed, fleet-wide 503 if correlated).  A poll that saw
+        # no new requests and an empty queue cannot RE-prove a latency
+        # breach, so drop the p99 reason and let the recovery hysteresis
+        # run; if the replica is still slow, real traffic re-sheds it
+        # after breach_polls — bounded probing instead of a death spiral.
+        requests = gauges.get("requests")
+        idle = (requests is not None and requests == self._last_requests
+                and int(gauges.get("queue_rows", 0)) == 0
+                and int(gauges.get("inflight_rows", 0)) == 0)
+        if idle:
+            reasons = [r for r in reasons if not r.startswith("p99_ms")]
+        self._last_requests = requests
+        self.last_reasons = reasons
+        if reasons:
+            self._ok_streak = 0
+            self._breach_streak += 1
+            if self.state == DOWN:
+                # reachable again but over target: straight to shed — a
+                # restarted replica drowning in backlog is not routable
+                self._set_state(SHED)
+            elif (self.state == HEALTHY
+                    and self._breach_streak >= self.policy.breach_polls):
+                self._set_state(SHED)
+        else:
+            self._breach_streak = 0
+            self._ok_streak += 1
+            if self.state == DOWN:
+                # back from the dead: hold in shed until it proves itself
+                # for recover_polls like any other recovering replica
+                self._set_state(SHED)
+            if (self.state == SHED
+                    and self._ok_streak >= self.policy.recover_polls):
+                self._set_state(HEALTHY)
+        return self.state

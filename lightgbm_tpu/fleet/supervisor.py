@@ -1,0 +1,256 @@
+"""Replica supervision: spawn N serving processes, restart the dead ones.
+
+cluster.py supervises TRAINING workers under a synchronous-SPMD fault
+model (one death fails the job, recovery = relaunch everyone from a
+checkpoint).  Serving replicas are the opposite: independent, stateless
+(their state is a model file plus an AOT bundle on disk), so the right
+recovery is per-replica — when one dies, the other replicas keep serving
+(the router routes around the corpse) and only the dead one is relaunched,
+with the same bounded exponential backoff and restart budget as the
+training supervisor.  A relaunched replica cold-starts warm: it reloads
+its models from the same files and deserializes its predict programs from
+the shared AOT bundle, so it rejoins with zero compiles.
+
+Fault injection follows the LGBM_TPU_FAULT_ITER pattern
+(checkpoint/fault.py): ``fault_env={"LGBM_TPU_FAULT_REQUEST": "500"}`` on
+one replica makes it kill itself mid-soak, and — like cluster.py — the
+fault env is STRIPPED on restart attempts, modelling a transient
+preemption.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..checkpoint.fault import FAULT_ENV_VARS
+from ..log import log_info, log_warning
+
+__all__ = ["FleetSupervisor", "ReplicaProc"]
+
+
+class ReplicaProc:
+    """One supervised replica slot (the process may be reincarnated)."""
+
+    def __init__(self, idx: int, port: int):
+        self.idx = idx
+        self.port = port
+        self.proc: Optional[subprocess.Popen] = None
+        self.attempt = 0              # spawn generation (0 = first launch)
+        self.restarts = 0
+        self.next_spawn_at = 0.0      # backoff deadline for the respawn
+        self.log_paths: List[str] = []
+        self.gave_up = False
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class FleetSupervisor:
+    """Spawn + babysit one serving process per replica slot.
+
+    ``make_argv(idx, port) -> List[str]`` builds each replica's command
+    line (the CLI fleet path passes ``task=serve fleet_role=replica``
+    plus the shared model/bundle params).  ``watch()`` is the supervision
+    step — poll it from a loop (or let ``start_watching`` run it on a
+    thread): dead replicas respawn after ``restart_backoff_s * 2**n``
+    with fault env stripped, up to ``max_restarts`` per replica, after
+    which the slot is abandoned (logged; the router keeps it marked
+    down).
+    """
+
+    def __init__(self, make_argv: Callable[[int, int], List[str]],
+                 ports: Sequence[int], host: str = "127.0.0.1",
+                 env: Optional[Dict[str, str]] = None,
+                 fault_env: Optional[Dict[int, Dict[str, str]]] = None,
+                 log_dir: Optional[str] = None,
+                 max_restarts: int = 2, restart_backoff_s: float = 0.5):
+        self.make_argv = make_argv
+        self.host = host
+        self.env = dict(env or os.environ)
+        self.fault_env = dict(fault_env or {})   # idx -> env overlay
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="lgbm_tpu_fleet_")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.replicas = [ReplicaProc(i, p) for i, p in enumerate(ports)]
+        self._watch_thread = None
+        self._watch_stop = None
+
+    @property
+    def urls(self) -> List[str]:
+        return [f"{self.host}:{r.port}" for r in self.replicas]
+
+    # ------------------------------------------------------------------
+    def _spawn(self, rep: ReplicaProc) -> None:
+        argv = self.make_argv(rep.idx, rep.port)
+        env = dict(self.env)
+        if rep.attempt == 0:
+            env.update(self.fault_env.get(rep.idx, {}))
+        else:
+            # transient-fault model (cluster.py): an injected fault does
+            # not recur on the relaunch
+            for var in FAULT_ENV_VARS:
+                env.pop(var, None)
+        log_path = os.path.join(
+            self.log_dir, f"replica_{rep.idx}_a{rep.attempt}.log")
+        rep.log_paths.append(log_path)
+        log_info(f"fleet: replica {rep.idx} (port {rep.port}, attempt "
+                 f"{rep.attempt}) log: {log_path}")
+        log_fh = open(log_path, "w")
+        rep.proc = subprocess.Popen(argv, env=env, stdout=log_fh,
+                                    stderr=subprocess.STDOUT, text=True)
+        log_fh.close()                # the child keeps its own handle
+
+    def spawn_all(self) -> None:
+        for rep in self.replicas:
+            if rep.proc is None:
+                self._spawn(rep)
+
+    # ------------------------------------------------------------------
+    def wait_ready(self, timeout_s: float = 120.0,
+                   poll_s: float = 0.25) -> None:
+        """Block until every live replica answers /healthz (a replica
+        process pays its jax import + model load + bundle deserialize
+        before binding the port).  Raises on timeout or if a replica dies
+        before ever becoming ready."""
+        # the router's HTTP client, not a hand-rolled http.client loop:
+        # one transport implementation per package (keep-alive pooling,
+        # connection cleanup on error, transport-vs-HTTP error split)
+        from .router import HttpReplica, ReplicaTransportError
+        probes = {idx: HttpReplica(url)
+                  for idx, url in enumerate(self.urls)}
+        deadline = time.time() + timeout_s
+        pending = set(range(len(self.replicas)))
+        while pending:
+            for idx in sorted(pending):
+                rep = self.replicas[idx]
+                if not rep.alive:
+                    # a corpse the running watcher will respawn (budget
+                    # permitting) is still "pending", not a failure —
+                    # callers waiting out a restart rely on the timeout;
+                    # without a watcher nothing will ever revive it, so
+                    # fail fast with the log tail
+                    if self._watch_thread is not None and not rep.gave_up:
+                        continue
+                    from ..cluster import _tail
+                    log = rep.log_paths[-1] if rep.log_paths else "?"
+                    raise RuntimeError(
+                        f"fleet: replica {idx} died before ready "
+                        f"(rc={rep.proc.poll() if rep.proc else None}); "
+                        f"log: {log}\n--- tail ---\n{_tail(log)}")
+                try:
+                    status, _ = probes[idx].request("GET", "/healthz",
+                                                    timeout_s=2.0)
+                    if status == 200:
+                        pending.discard(idx)
+                except ReplicaTransportError:
+                    pass
+            if pending:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"fleet: replicas {sorted(pending)} not ready "
+                        f"within {timeout_s:.0f}s")
+                time.sleep(poll_s)
+
+    # ------------------------------------------------------------------
+    def watch(self) -> None:
+        """One supervision step: respawn dead replicas whose backoff has
+        elapsed and whose restart budget remains."""
+        now = time.time()
+        for rep in self.replicas:
+            if rep.alive or rep.gave_up or rep.proc is None:
+                continue
+            rc = rep.proc.poll()
+            if rep.next_spawn_at == 0.0:
+                # first sight of this corpse: schedule the respawn
+                if rep.restarts >= self.max_restarts:
+                    rep.gave_up = True
+                    log_warning(
+                        f"fleet: replica {rep.idx} died (rc={rc}) and its "
+                        f"restart budget ({self.max_restarts}) is spent; "
+                        f"abandoning the slot (log: {rep.log_paths[-1]})")
+                    continue
+                delay = self.restart_backoff_s * (2.0 ** rep.restarts)
+                rep.next_spawn_at = now + delay
+                log_warning(
+                    f"fleet: replica {rep.idx} died (rc={rc}); relaunching "
+                    f"in {delay:.1f}s (restart "
+                    f"{rep.restarts + 1}/{self.max_restarts})")
+            if now >= rep.next_spawn_at:
+                rep.attempt += 1
+                rep.restarts += 1
+                rep.next_spawn_at = 0.0
+                self._spawn(rep)
+
+    def start_watching(self, interval_s: float = 0.2):
+        """Run watch() on a daemon thread until stop_all()."""
+        import threading
+        if self._watch_thread is None:
+            self._watch_stop = threading.Event()
+
+            def _loop():
+                while not self._watch_stop.wait(interval_s):
+                    try:
+                        self.watch()
+                    except Exception as exc:   # never kill supervision
+                        log_warning(f"fleet: watch step failed: {exc!r}")
+
+            self._watch_thread = threading.Thread(
+                target=_loop, name="lgbm-tpu-fleet-supervisor", daemon=True)
+            self._watch_thread.start()
+        return self
+
+    # ------------------------------------------------------------------
+    def kill(self, idx: int) -> None:
+        """SIGKILL one replica (chaos switch for tests/benches that want
+        an external kill instead of env-driven fault injection)."""
+        rep = self.replicas[idx]
+        if rep.alive:
+            rep.proc.kill()
+            rep.proc.wait()
+
+    def stop_all(self) -> None:
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10.0)
+            self._watch_thread = None
+        for rep in self.replicas:
+            if rep.alive:
+                rep.proc.terminate()
+        deadline = time.time() + 5.0
+        for rep in self.replicas:
+            if rep.proc is None:
+                continue
+            while rep.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if rep.proc.poll() is None:
+                rep.proc.kill()
+                rep.proc.wait()
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop_all()
+
+
+def default_replica_argv(raw_params: Dict[str, str], port: int) -> List[str]:
+    """Build a replica's CLI command from the fleet launcher's params:
+    same param surface, forced into the single-process replica role.
+    fleet_* keys are stripped (the replica must not recurse into a fleet)
+    and the port is per-replica."""
+    drop = {"task", "serving_port", "config"}
+    argv = [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+            "fleet_role=replica", f"serving_port={port}"]
+    for k, v in raw_params.items():
+        if k in drop or k == "fleet_role" or k.startswith("fleet_"):
+            continue
+        argv.append(f"{k}={v}")
+    return argv

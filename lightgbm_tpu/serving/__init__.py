@@ -7,7 +7,11 @@ a production inference service:
 - ``CompiledPredictor`` (compiled.py) — device-resident stacked trees plus
   a shape-bucketed AOT-compile cache: zero XLA recompiles after warmup.
 - ``MicroBatcher`` (batcher.py) — coalesces concurrent small requests into
-  padded device batches with bounded-queue backpressure.
+  padded device batches with bounded-queue backpressure; continuous
+  batching by default (the next batch launches the moment the device
+  frees, bit-identical to flush-and-wait), and close() drains every
+  admitted request.  The fleet tier (lightgbm_tpu/fleet/) puts a router
+  with SLO-aware shedding in front of N replica processes.
 - ``ModelRegistry`` (registry.py) — name/version routing with atomic
   hot-swap, refcounted retirement, and instant rollback.
 - ``ServingMetrics`` (metrics.py) — per-model counters + latency
@@ -16,12 +20,12 @@ a production inference service:
   ``python -m lightgbm_tpu.serving model=path`` runs it end to end.
 """
 
-from .batcher import MicroBatcher, QueueFullError
+from .batcher import MicroBatcher, QueueFullError, ServingClosedError
 from .compiled import CompiledPredictor
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 from .server import ServingApp, make_server, serve
 
 __all__ = ["CompiledPredictor", "MicroBatcher", "QueueFullError",
-           "ModelRegistry", "ServingMetrics", "ServingApp", "make_server",
-           "serve"]
+           "ServingClosedError", "ModelRegistry", "ServingMetrics",
+           "ServingApp", "make_server", "serve"]

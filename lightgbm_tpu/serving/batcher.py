@@ -12,6 +12,19 @@ The batcher is a bounded queue plus one flush worker:
 - the worker coalesces whatever is queued into one padded device batch,
   flushing when ``max_batch`` rows are ready or the oldest request has
   waited ``max_wait_ms`` (latency cap), whichever comes first;
+- **continuous batching** (default): requests keep landing in the queue
+  while a flush executes, and any request that arrived while the device
+  was busy has already "waited" useful wall-clock — so the next batch
+  launches the moment the device frees instead of parking that request
+  behind a fresh ``max_wait_ms`` coalescing window.  Under sustained
+  load the device never idles while requests wait (the paper's
+  keep-the-device-saturated rule applied to inference); the wait window
+  only ever delays requests that arrive at an IDLE device, where it buys
+  coalescing at no throughput cost.  ``continuous=False`` restores the
+  flush-and-wait schedule.  Because batches ride the same power-of-two
+  bucket ladder either way, the schedule changes WHEN rows are grouped,
+  never WHAT any row computes: results are bit-identical between modes
+  and no new programs compile;
 - results are scattered back to the per-request futures by row slice;
 - admission control is a hard row bound: when ``max_queue_rows`` worth of
   requests are already waiting, ``submit`` raises ``QueueFullError``
@@ -36,13 +49,19 @@ import numpy as np
 from ..log import LightGBMError
 from ..timer import timed
 
-__all__ = ["MicroBatcher", "QueueFullError"]
+__all__ = ["MicroBatcher", "QueueFullError", "ServingClosedError"]
 
 _NO_META = object()  # sentinel: predictor returned a bare array (no meta)
 
 
 class QueueFullError(LightGBMError):
     """Raised by submit() when the bounded request queue is at capacity."""
+
+
+class ServingClosedError(LightGBMError):
+    """Raised when a request reaches a batcher/app that is shutting
+    down — mapped to HTTP 503 (the fleet router reroutes it), never to a
+    client-error 4xx."""
 
 
 class _Request:
@@ -69,19 +88,21 @@ class MicroBatcher:
     def __init__(self, predictor, max_batch: int = 1024,
                  max_wait_ms: float = 2.0, max_queue_rows: int = 16384,
                  metrics=None, predict_kwargs: Optional[dict] = None,
-                 autostart: bool = True):
+                 autostart: bool = True, continuous: bool = True):
         self.predictor = predictor
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue_rows = int(max_queue_rows)
         self.metrics = metrics
         self.predict_kwargs = dict(predict_kwargs or {})
+        self.continuous = bool(continuous)
         self._q: deque = deque()
         self._queued_rows = 0
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
         self._discard = False   # close(drain=False): worker stops flushing
+        self._last_flush_end = 0.0   # perf_counter of the last flush's end
         self._thread: Optional[threading.Thread] = None
         if autostart:
             self.start()
@@ -111,7 +132,7 @@ class MicroBatcher:
         n = rows.shape[0]
         with self._lock:
             if self._closed:
-                raise LightGBMError("MicroBatcher is closed")
+                raise ServingClosedError("MicroBatcher is closed")
             if self._q and self._queued_rows + n > self.max_queue_rows:
                 if self.metrics is not None:
                     self.metrics.record_rejection()
@@ -136,6 +157,11 @@ class MicroBatcher:
         with self._lock:
             return self._queued_rows
 
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     # ------------------------------------------------------------------
     def _take_batch(self):
         """Block until requests are ready, then pop up to max_batch rows.
@@ -150,8 +176,17 @@ class MicroBatcher:
                 return None  # close(drain=False): leave the backlog to close
             if not self._q:
                 return None  # closed and drained
+            # continuous batching: a request enqueued while the previous
+            # flush was still on the device has already waited out device
+            # work — launch its batch NOW (with whatever rode along) rather
+            # than holding the freed device behind a coalescing window.
+            # Only requests that arrive at an idle device wait, and only
+            # then does waiting buy coalescing for free.
+            immediate = (self.continuous
+                         and self._q[0].t_enqueue <= self._last_flush_end)
             deadline = self._q[0].t_enqueue + self.max_wait_s
-            while (self._queued_rows < self.max_batch
+            while (not immediate
+                   and self._queued_rows < self.max_batch
                    and not self._closed):
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
@@ -181,6 +216,8 @@ class MicroBatcher:
             # worker thread
             X = (batch[0].rows if len(batch) == 1
                  else np.concatenate([r.rows for r in batch], axis=0))
+            if self.metrics is not None:
+                self.metrics.record_inflight(X.shape[0])
             with timed("serving::batch"):
                 out = self.predictor.predict(X, **self.predict_kwargs)
         except BaseException as exc:
@@ -189,6 +226,8 @@ class MicroBatcher:
             # model's feature count mid-queue): retry each request SOLO and
             # let only the genuinely bad ones fail.  Depth is bounded — the
             # single-request path below scatters the exception directly.
+            if self.metrics is not None:
+                self.metrics.record_inflight(0)
             if len(batch) > 1:
                 for req in batch:
                     self._flush([req])
@@ -221,7 +260,21 @@ class MicroBatcher:
                 self.metrics.record_request(req.rows.shape[0],
                                             latency_s=t_done - req.t_enqueue)
         if self.metrics is not None:
-            self.metrics.record_batch(len(batch), X.shape[0], device_s)
+            self.metrics.record_inflight(0)
+            self.metrics.record_batch(len(batch), X.shape[0], device_s,
+                                      fill=self._bucket_fill(X.shape[0]))
+
+    def _bucket_fill(self, n_rows: int) -> float:
+        """Real rows over the padded bucket actually dispatched — the
+        device-utilization gauge the fleet router's SLO logic reads.  The
+        predictor's own ladder wins when it exposes one; the default
+        ladder matches CompiledPredictor's."""
+        from ..ops.predict import row_bucket
+        ladder = getattr(self.predictor, "buckets", None)
+        try:
+            return n_rows / max(row_bucket(n_rows, ladder), 1)
+        except Exception:
+            return 0.0
 
     def _loop(self) -> None:
         while True:
@@ -229,6 +282,8 @@ class MicroBatcher:
             if batch is None:
                 return
             self._flush(batch)
+            with self._lock:
+                self._last_flush_end = time.perf_counter()
 
     # ------------------------------------------------------------------
     def close(self, drain: bool = True) -> None:

@@ -23,6 +23,7 @@ training engine uses, so one flag profiles both halves of the system.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 from ..telemetry.registry import MetricsRegistry
@@ -31,6 +32,13 @@ from ..timer import global_timer, timers_enabled
 __all__ = ["LatencyWindow", "ModelMetrics", "ServingMetrics"]
 
 _PCTS = (50.0, 95.0, 99.0)
+
+# fleet_gauges: a model is "recently active" for this long after its last
+# request — stale-evidence gating must be TIME-based, not a read-and-reset
+# requests delta, because /v1/fleet/health has more than one consumer (the
+# router's SLO polls plus any monitoring scrape) and a delta consumed by
+# one reader would zero the p99/fill evidence for the next
+FLEET_ACTIVE_WINDOW_S = 5.0
 
 
 class LatencyWindow:
@@ -101,6 +109,13 @@ class ModelMetrics:
         self._queue_depth = reg.gauge(
             "lgbm_serving_queue_depth", "rows waiting in the micro-batch "
             "queue", **lab)
+        self._inflight_rows = reg.gauge(
+            "lgbm_serving_inflight_rows", "real rows in the batch currently "
+            "executing on the device (0 when idle)", **lab)
+        self._batch_fill = reg.gauge(
+            "lgbm_serving_batch_fill", "last flush's real rows over its "
+            "padded bucket (device utilization of the in-flight batch)",
+            **lab)
         self._queue_rejections = reg.counter(
             "lgbm_serving_queue_rejections_total",
             "requests rejected by queue backpressure", **lab)
@@ -111,6 +126,7 @@ class ModelMetrics:
             "lgbm_serving_compile_count", "XLA programs compiled for this "
             "model (all versions)", **lab)
         self.latency = LatencyWindow()
+        self.last_active_s = 0.0   # wall time of the last user request
         # keeps the batch triple (batches, batched_requests, batched_rows)
         # mutually consistent between record_batch and the ratio reads in
         # snapshot — the per-counter locks alone allow a flush to land
@@ -161,6 +177,7 @@ class ModelMetrics:
         record_device, so coalesced traffic isn't double-counted."""
         self._requests.inc()
         self._rows.inc(int(rows))
+        self.last_active_s = time.time()
         if error:
             self._errors.inc()
         if latency_s is not None:
@@ -173,17 +190,22 @@ class ModelMetrics:
         self._device_rows.inc(int(rows))
 
     def record_batch(self, n_requests: int, n_rows: int,
-                     device_s: float) -> None:
+                     device_s: float, fill: Optional[float] = None) -> None:
         """One coalesced device call serving `n_requests` requests."""
         with self._batch_lock:
             self._batches.inc()
             self._batched_requests.inc(int(n_requests))
             self._batched_rows.inc(int(n_rows))
+        if fill is not None:
+            self._batch_fill.set(float(fill))
         if timers_enabled():
             global_timer.add("serving::batch_predict", device_s)
 
     def record_queue(self, depth: int) -> None:
         self._queue_depth.set(int(depth))
+
+    def record_inflight(self, rows: int) -> None:
+        self._inflight_rows.set(int(rows))
 
     def record_rejection(self) -> None:
         self._queue_rejections.inc()
@@ -202,6 +224,8 @@ class ModelMetrics:
             "device_rows": self.device_rows,
             "queue_depth": self.queue_depth,
             "queue_rejections": self.queue_rejections,
+            "inflight_rows": int(self._inflight_rows.value),
+            "batch_fill": round(float(self._batch_fill.value), 4),
             # >1 means the micro-batcher is actually coalescing:
             # device calls are amortized over multiple requests
             "batch_fill_ratio": (batched_requests / batches
@@ -225,6 +249,12 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
         self.registry = registry if registry is not None else MetricsRegistry()
+        # construction wall time, exported in fleet_gauges: the router's
+        # publish-replay logic uses a CHANGED boot_s as its restart
+        # evidence (a restarted replica is a fresh process with a fresh
+        # ServingMetrics; cumulative counters alone can't distinguish
+        # "restarted before first traffic" from a transient poll blip)
+        self.boot_s = time.time()
 
     def model(self, name: str) -> ModelMetrics:
         with self._lock:
@@ -239,3 +269,37 @@ class ServingMetrics:
             names = list(self._models.items())
         return {name: m.snapshot(compile_counts.get(name))
                 for name, m in names}
+
+    def fleet_gauges(self) -> Dict:
+        """Replica-level aggregate of the gauges the fleet router's SLO
+        logic reads (fleet/slo.py): queue depth and in-flight rows SUM
+        over models (they share the process's device); p99 and batch
+        fill are the worst RECENTLY-ACTIVE model's (an SLO is only met
+        when every model meets it — but a model that served nothing
+        within FLEET_ACTIVE_WINDOW_S only offers stale ring-buffer
+        evidence, and counting it would let one old burst report a
+        breached-and-saturated replica forever).  The activity gate is
+        a wall-clock window, not a requests delta, so the route stays
+        safe for MULTIPLE consumers (router polls + monitoring
+        scrapes) — reads have no side effects."""
+        with self._lock:
+            models = list(self._models.items())
+        out = {"queue_rows": 0, "inflight_rows": 0, "p99_ms": 0.0,
+               "batch_fill": 0.0, "requests": 0, "errors": 0,
+               "queue_rejections": 0, "boot_s": self.boot_s}
+        now = time.time()
+        for name, m in models:
+            out["queue_rows"] += m.queue_depth
+            out["inflight_rows"] += int(m._inflight_rows.value)
+            active = (m.queue_depth > 0
+                      or int(m._inflight_rows.value) > 0
+                      or now - m.last_active_s < FLEET_ACTIVE_WINDOW_S)
+            if active:
+                out["p99_ms"] = max(out["p99_ms"],
+                                    m.latency.percentiles()["p99_ms"])
+                out["batch_fill"] = max(out["batch_fill"],
+                                        float(m._batch_fill.value))
+            out["requests"] += m.requests
+            out["errors"] += m.errors
+            out["queue_rejections"] += m.queue_rejections
+        return out

@@ -13,6 +13,9 @@ Two layers, deliberately separable:
 Routes (JSON bodies):
 
 - ``GET  /healthz``                     liveness
+- ``GET  /v1/fleet/health``             liveness + the SLO gauges the
+                                        fleet router polls (queue depth,
+                                        in-flight rows, p99, batch fill)
 - ``GET  /v1/models``                   registry listing
 - ``GET  /v1/metrics``                  ServingMetrics snapshot (JSON)
 - ``GET  /v1/metrics/prometheus``       Prometheus text exposition
@@ -33,6 +36,7 @@ scores) bypass batching and go straight through the registry.
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -40,8 +44,9 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..checkpoint.fault import RequestFaultLatch
 from ..log import LightGBMError
-from .batcher import MicroBatcher, QueueFullError
+from .batcher import MicroBatcher, QueueFullError, ServingClosedError
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 
@@ -61,16 +66,19 @@ class _RegistryDispatch:
     def __init__(self, registry: ModelRegistry, name: str):
         self._registry = registry
         self._name = name
-        # advisory width for the server's pre-coalesce check, refreshed at
-        # every flush so the hot path never takes the registry lock just
-        # to read it; staleness across a hot-swap is safe — a genuinely
-        # mismatched batch falls back to per-request isolation
+        # advisory width + bucket ladder for the server's pre-coalesce
+        # check and the batcher's fill gauge, refreshed at every flush so
+        # the hot path never takes the registry lock just to read them;
+        # staleness across a hot-swap is safe — a genuinely mismatched
+        # batch falls back to per-request isolation
         with registry.acquire(name) as (pred, _):
             self.num_feature = pred.num_feature
+            self.buckets = pred.buckets
 
     def predict(self, X):
         with self._registry.acquire(self._name) as (pred, version):
             self.num_feature = pred.num_feature
+            self.buckets = pred.buckets
             return pred.predict(X), version
 
 
@@ -78,18 +86,34 @@ class ServingApp:
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  metrics: Optional[ServingMetrics] = None,
                  max_batch: int = 1024, max_wait_ms: float = 2.0,
-                 max_queue_rows: int = 16384, batching: bool = True):
+                 max_queue_rows: int = 16384, batching: bool = True,
+                 continuous: bool = True):
         self.metrics = metrics or ServingMetrics()
         self.registry = registry or ModelRegistry(metrics=self.metrics)
         self.batching = batching
         self._batch_cfg = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
-                               max_queue_rows=max_queue_rows)
+                               max_queue_rows=max_queue_rows,
+                               continuous=continuous)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
+        self._closed = False
+        # admitted predict-request counter, feeding env-driven fault
+        # injection (LGBM_TPU_FAULT_REQUEST, checkpoint/fault.py) — the
+        # fleet soak's kill-a-replica-mid-traffic switch.  Counter and
+        # mode=raise one-shot latch are both per-app, so each app is an
+        # independent consumer of the schedule and a sibling app's
+        # construction cannot re-arm one that already fired
+        self._fault_latch = RequestFaultLatch()
+        self._served = itertools.count(1)
 
     # ------------------------------------------------------------------
     def _batcher(self, name: str) -> MicroBatcher:
         with self._lock:
+            if self._closed:
+                # close() drained and dropped every batcher; minting a new
+                # one here would leak an undrained worker thread whose
+                # futures nobody resolves at teardown
+                raise ServingClosedError("ServingApp is closed")
             b = self._batchers.get(name)
             if b is None:
                 # a batcher owns a worker thread and is kept for the app's
@@ -103,7 +127,13 @@ class ServingApp:
             return b
 
     def close(self) -> None:
+        """Stop admitting requests, then DRAIN: every request already
+        admitted (queued or in flight in some batcher) resolves its
+        Future before close returns.  Idempotent and safe under
+        concurrent submitters — a request that races past the closed
+        check into a batcher is in the dict we drain."""
         with self._lock:
+            self._closed = True
             batchers, self._batchers = dict(self._batchers), {}
         for b in batchers.values():
             b.close()
@@ -119,6 +149,10 @@ class ServingApp:
                                body or {})
         except QueueFullError as exc:
             return 429, {"error": str(exc)}
+        except ServingClosedError as exc:
+            # a request that raced past the closed check into a closing
+            # batcher is still a shutdown refusal, not a 4xx
+            return 503, {"error": str(exc)}
         except LightGBMError as exc:
             return 404 if "no model published" in str(exc) else 400, \
                 {"error": str(exc)}
@@ -126,10 +160,32 @@ class ServingApp:
             # OSError: e.g. publish with a nonexistent model_file must be
             # the client's 400, not an escaped FileNotFoundError
             return 400, {"error": f"{type(exc).__name__}: {exc}"}
+        except Exception as exc:
+            # anything else must still produce an HTTP response: an
+            # escaped exception tears the connection down mid-request,
+            # which a fleet router cannot distinguish from a dead replica
+            # — one poisoned request retried around the fleet would walk
+            # every replica into "down".  A 500 keeps it a per-request
+            # failure (the router reroutes 5xx without marking down).
+            # Injected faults (mode=raise) must keep propagating — they
+            # simulate process death, not a request error.
+            from ..checkpoint.fault import InjectedWorkerFault
+            if isinstance(exc, InjectedWorkerFault):
+                raise
+            from ..log import log_warning
+            log_warning(f"serving: unhandled error for {method} {path}: "
+                        f"{exc!r}")
+            return 500, {"error": f"internal: {type(exc).__name__}: {exc}"}
 
     def _route(self, method: str, path: str, body: dict) -> Tuple[int, dict]:
+        if self._closed:
+            # drained at close(): refuse fast instead of minting batchers
+            # whose futures would outlive the app
+            return 503, {"error": "ServingApp is closed"}
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok"}
+        if method == "GET" and path == "/v1/fleet/health":
+            return 200, self._fleet_health()
         if method == "GET" and path == "/v1/models":
             return 200, {"models": self.registry.models()}
         if method == "GET" and path == "/v1/metrics":
@@ -150,6 +206,19 @@ class ServingApp:
         return 404, {"error": f"no route for {method} {path}"}
 
     # ------------------------------------------------------------------
+    def _fleet_health(self) -> dict:
+        """One CHEAP poll target for the fleet router: liveness plus the
+        replica-level SLO gauges (fleet/slo.py reads exactly these
+        keys).  Polled 10-20x/s per replica, so no per-model snapshot and
+        no registry-lock compile_counts here — detail lives on
+        /v1/metrics for callers that want it."""
+        return {
+            "status": "ok",
+            "role": "replica",
+            "gauges": self.metrics.fleet_gauges(),
+        }
+
+    # ------------------------------------------------------------------
     def _prometheus(self) -> str:
         """Prometheus text dump: this app's serving registry plus the
         process-wide telemetry registry (training stats when colocated).
@@ -165,10 +234,17 @@ class ServingApp:
             name,
             model_str=body.get("model_str"),
             model_file=body.get("model_file"),
-            warmup=bool(body.get("warmup", True)))
+            warmup=bool(body.get("warmup", True)),
+            # hot-swaps can ship their AOT bundle too, so a fleet-wide
+            # publish warms every replica by deserializing, not compiling
+            aot_bundle_dir=body.get("aot_bundle_dir"))
         return 200, {"name": name, "version": version}
 
     def _predict(self, name: str, body: dict) -> Tuple[int, dict]:
+        # fault injection BEFORE serving: a killed replica loses this
+        # in-flight request with the process — the case the fleet
+        # router's reroute-and-retry must absorb for zero failed requests
+        self._fault_latch.maybe_inject(next(self._served))
         rows = np.asarray(body["rows"], dtype=np.float64)
         if rows.ndim == 1:
             rows = rows[None, :]
@@ -218,6 +294,11 @@ def make_server(app: ServingApp, host: str = "127.0.0.1", port: int = 8080):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
+        # small request/response pairs per connection: Nagle + delayed
+        # ACK otherwise adds tens of ms of idle latency per round trip
+        disable_nagle_algorithm = True
+        protocol_version = "HTTP/1.1"   # keep-alive for pooled clients
+
         def _respond(self, method):
             body = None
             length = int(self.headers.get("Content-Length") or 0)

@@ -1,0 +1,606 @@
+"""Fleet serving tier tests (lightgbm_tpu/fleet/).
+
+Tier-1 coverage is transport-free: the SLO breach→shed→recover machine is
+driven with injected gauge values, and the router is driven through
+``handle`` against in-process fake replica endpoints — no sockets, no
+subprocesses.  The end-to-end topology (real replica processes, a real
+SIGKILL, supervised restart) lives in one slow-marked test.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import (FleetRouter, FleetSupervisor, ReplicaSLO,
+                                SLOPolicy, default_replica_argv)
+from lightgbm_tpu.fleet.router import ReplicaTransportError
+
+RNG = np.random.RandomState(11)
+
+OK = {"p99_ms": 1.0, "queue_rows": 0, "inflight_rows": 0, "batch_fill": 0.5}
+
+
+def _gauges(**kw):
+    g = dict(OK)
+    g.update(kw)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# SLO state machine (satellite: unit tests with injected gauges, no sockets)
+# ---------------------------------------------------------------------------
+def test_slo_breach_needs_consecutive_polls():
+    s = ReplicaSLO(SLOPolicy(p99_ms=50, breach_polls=3, recover_polls=2))
+    assert s.observe(_gauges(p99_ms=10)) == "healthy"
+    # two breaches then a healthy poll: the streak resets, no shed
+    s.observe(_gauges(p99_ms=99))
+    s.observe(_gauges(p99_ms=99))
+    assert s.observe(_gauges(p99_ms=10)) == "healthy"
+    # three consecutive breaches: shed
+    s.observe(_gauges(p99_ms=99))
+    s.observe(_gauges(p99_ms=99))
+    assert s.observe(_gauges(p99_ms=99)) == "shed"
+    assert not s.routable and "p99_ms" in s.last_reasons[0]
+
+
+def test_slo_recover_needs_consecutive_polls():
+    s = ReplicaSLO(SLOPolicy(queue_rows=100, breach_polls=1, recover_polls=3))
+    assert s.observe(_gauges(queue_rows=500)) == "shed"
+    # recovery interrupted by a breach: streak resets
+    s.observe(_gauges(queue_rows=1))
+    s.observe(_gauges(queue_rows=1))
+    assert s.observe(_gauges(queue_rows=500)) == "shed"
+    s.observe(_gauges(queue_rows=1))
+    s.observe(_gauges(queue_rows=1))
+    assert s.observe(_gauges(queue_rows=1)) == "healthy"
+
+
+def test_slo_down_is_immediate_and_recovers_via_shed():
+    s = ReplicaSLO(SLOPolicy(p99_ms=50, breach_polls=3, recover_polls=2))
+    # a failed poll needs no hysteresis — the replica is GONE
+    assert s.observe(None) == "down"
+    # back from the dead: held in shed until it proves itself
+    assert s.observe(_gauges()) == "shed"
+    assert s.observe(_gauges()) == "healthy"
+    # a restarted replica drowning in backlog goes to shed, not healthy
+    s.observe(None)
+    assert s.observe(_gauges(p99_ms=999)) == "shed"
+
+
+def test_slo_mark_down_from_forwarding_failure():
+    s = ReplicaSLO(SLOPolicy())
+    assert s.routable
+    s.mark_down("connection refused")
+    assert s.state == "down" and not s.routable
+
+
+def test_slo_shed_on_p99_can_recover_without_traffic():
+    """Regression: the replica's p99 gauge is a ring of PAST latencies,
+    and a shed replica gets no traffic — so a p99 breach must not hold
+    forever on stale evidence.  Polls that saw no new requests and an
+    empty queue count toward recovery; fresh traffic re-proving the
+    breach sheds again."""
+    s = ReplicaSLO(SLOPolicy(p99_ms=50, breach_polls=1, recover_polls=2))
+    assert s.observe(_gauges(p99_ms=99, requests=10)) == "shed"
+    # same stale p99, but requests frozen + queue empty: recovery runs
+    assert s.observe(_gauges(p99_ms=99, requests=10)) == "shed"
+    assert s.observe(_gauges(p99_ms=99, requests=10)) == "healthy"
+    # traffic returns and the breach is REAL: fresh evidence re-sheds
+    assert s.observe(_gauges(p99_ms=99, requests=25)) == "shed"
+    # but a breach with queued work is never treated as stale
+    s2 = ReplicaSLO(SLOPolicy(p99_ms=50, breach_polls=1, recover_polls=1))
+    s2.observe(_gauges(p99_ms=99, requests=5, queue_rows=10))
+    assert s2.observe(_gauges(p99_ms=99, requests=5,
+                              queue_rows=10)) == "shed"
+
+
+def test_slo_zero_targets_disable_checks():
+    s = ReplicaSLO(SLOPolicy(p99_ms=0, queue_rows=0, breach_polls=1))
+    assert s.observe(_gauges(p99_ms=1e9, queue_rows=10**9)) == "healthy"
+
+
+# ---------------------------------------------------------------------------
+# Router against fake in-process replicas
+# ---------------------------------------------------------------------------
+class FakeReplica:
+    """In-process replica endpoint: scripted gauges + canned predicts."""
+
+    def __init__(self, name, gauges=None, version=1):
+        self.name = name
+        self.gauges = dict(gauges or OK)
+        self.version = version
+        self.boot = 1.0        # bumped to simulate a process restart
+        self.dead = False
+        self.served = 0
+        self.published = []
+
+    def health(self, timeout_s=2.0):
+        if self.dead:
+            return None
+        g = dict(self.gauges)
+        g.setdefault("boot_s", self.boot)   # real replicas always export it
+        return g
+
+    def request(self, method, path, body=None, timeout_s=None):
+        if self.dead:
+            raise ReplicaTransportError(f"replica {self.name}: dead")
+        if path.endswith(":predict"):
+            self.served += 1
+            n = len(body["rows"])
+            return 200, {"name": "m", "version": self.version,
+                         "predictions": [float(self.version)] * n}
+        if path.endswith(":publish"):
+            self.version += 1
+            self.published.append(body)
+            return 200, {"name": "m", "version": self.version}
+        if path == "/v1/models":
+            return 200, {"models": {"m": {"current": self.version}}}
+        return 404, {"error": "no route"}
+
+
+def _router(replicas, **kw):
+    kw.setdefault("policy", SLOPolicy(p99_ms=50, queue_rows=100,
+                                      breach_polls=1, recover_polls=1))
+    # poll only on demand: tests drive poll_once() deterministically
+    return FleetRouter(replicas, poll_interval_ms=0, autostart=False, **kw)
+
+
+def test_router_routes_to_least_loaded():
+    a = FakeReplica("a", _gauges(queue_rows=500))
+    b = FakeReplica("b", _gauges(queue_rows=0))
+    r = _router([a, b], policy=SLOPolicy())   # no SLO: load-only routing
+    r.poll_once()
+    for _ in range(4):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "b"
+    assert (a.served, b.served) == (0, 4)
+
+
+def test_router_sheds_breached_replica_and_recovers():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    r.poll_once()
+    a.gauges = _gauges(p99_ms=500)        # a breaches (breach_polls=1)
+    r.poll_once()
+    assert r.replica_states()["a"]["state"] == "shed"
+    for _ in range(6):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "b"
+    assert a.served == 0                  # shed replica got nothing
+    a.gauges = _gauges()                  # back under target
+    r.poll_once()
+    assert r.replica_states()["a"]["state"] == "healthy"
+    served_before = a.served
+    for _ in range(8):
+        assert r.handle("POST", "/v1/models/m:predict",
+                        {"rows": [[0.0]]})[0] == 200
+    assert a.served > served_before       # traffic returned
+
+
+def test_router_sheds_at_the_door_when_no_replica_routable():
+    a, b = FakeReplica("a", _gauges(queue_rows=900)), \
+        FakeReplica("b", _gauges(queue_rows=900))
+    r = _router([a, b])
+    r.poll_once()
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    assert status == 503 and "shedding" in body["error"]
+    assert (a.served, b.served) == (0, 0)
+    snap = r.registry.snapshot()
+    assert snap["lgbm_fleet_shed_total"]["_"] == 1
+    status, health = r.handle("GET", "/healthz")
+    assert status == 200 and health["status"] == "shedding"
+
+
+def test_router_reroutes_around_dead_replica_with_zero_failures():
+    """Satellite acceptance (in-process half): kill one replica mid-
+    traffic — every request still succeeds, the corpse is marked down
+    immediately (no waiting for a poll), and reroutes are counted."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    r.poll_once()
+    failed = 0
+    for i in range(40):
+        if i == 10:
+            a.dead = True
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        failed += status != 200
+    assert failed == 0
+    assert r.replica_states()["a"]["state"] == "down"
+    assert a.served + b.served == 40
+    snap = r.registry.snapshot()
+    assert snap["lgbm_fleet_errors_total"]["_"] == 0
+    # the kill surfaced as reroutes, not failures
+    assert snap["lgbm_fleet_reroutes_total"]["_"] >= 1
+    # revive: the next polls walk it down->shed->healthy (recover_polls=1)
+    a.dead = False
+    r.poll_once()
+    assert r.replica_states()["a"]["state"] == "healthy"
+
+
+def test_router_treats_replica_429_and_5xx_as_reroute_not_death():
+    """A 429 (queue overflow between polls) or a 500 (one bad request)
+    is load to reroute — the replica answered, so it must NOT be marked
+    down (one poisoned request retried fleet-wide would otherwise walk
+    every replica into 'down')."""
+    class Full(FakeReplica):
+        def __init__(self, name, status):
+            super().__init__(name)
+            self.status = status
+
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                return self.status, {"error": "nope"}
+            return super().request(method, path, body, timeout_s)
+
+    for bad_status in (429, 500):
+        full, ok = Full("full", bad_status), FakeReplica("ok")
+        r = _router([full, ok], policy=SLOPolicy())
+        r.poll_once()
+        for _ in range(4):
+            status, body = r.handle("POST", "/v1/models/m:predict",
+                                    {"rows": [[0.0]]})
+            assert status == 200 and body["replica"] == "ok"
+        assert r.replica_states()["full"]["state"] == "healthy"
+
+
+def test_router_demand_polls_when_pollless_and_started():
+    """fleet_poll_ms=0 is documented as 'poll only on demand': a STARTED
+    router with no poll thread must refresh health state inline, so a
+    replica marked down by one forwarding failure can still recover —
+    without it the mark_down is permanent (recovery only happens inside
+    ReplicaSLO.observe, which only poll_once calls) and every replica's
+    first transient failure walks the fleet to a permanent 503."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    r.start()                             # pollless mode, but started
+    assert r._poll_thread is None         # interval 0: no thread
+    a.dead = True                         # dies before any traffic
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    assert status == 200 and body["replica"] == "b"
+    assert r.replica_states()["a"]["state"] == "down"
+    a.dead = False                        # supervised restart brings it back
+    for _ in range(3):                    # down -> shed -> healthy
+        r._next_demand_poll_s = 0.0       # collapse the rate limit
+        assert r.handle("POST", "/v1/models/m:predict",
+                        {"rows": [[0.0]]})[0] == 200
+    assert r.replica_states()["a"]["state"] == "healthy"
+    r.close()
+
+
+def test_router_inflight_requests_spread_between_polls():
+    """Least-loaded ranking adds rows the router has in flight RIGHT NOW
+    to each replica's last-polled load: while a slow request occupies a
+    replica, a concurrent request must go to a peer even though no poll
+    has refreshed the loads — otherwise every request between two polls
+    herds onto whichever replica looked idlest at the last poll."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    class Slow(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                entered.set()
+                assert release.wait(10.0)
+            return super().request(method, path, body, timeout_s)
+
+    a, b = Slow("a"), FakeReplica("b", _gauges(queue_rows=10))
+    r = _router([a, b], policy=SLOPolicy())   # load-only routing
+    r.poll_once()                         # polled loads: a=0, b=10
+    t = threading.Thread(target=r.handle, args=(
+        "POST", "/v1/models/m:predict", {"rows": [[0.0]] * 50}))
+    t.start()
+    assert entered.wait(10.0)             # 50 rows now in flight on a
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    release.set()
+    t.join(10.0)
+    assert status == 200 and body["replica"] == "b"
+    assert (a.served, b.served) == (1, 1)
+
+
+def test_router_broadcast_publish_hits_every_replica():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 200 and body["succeeded"] == 2
+    assert len(a.published) == len(b.published) == 1
+    # a dead replica doesn't fail the broadcast (it re-publishes from its
+    # CLI model files on supervised restart), but is reported
+    b.dead = True
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 200 and body["succeeded"] == 1
+    assert body["replicas"]["b"]["status"] == 0
+
+
+def test_router_broadcast_timeout_fails_not_excluded():
+    """A publish that TIMES OUT at the socket level on a live replica has
+    an UNKNOWN outcome (it may still land after we stop waiting), and the
+    replica keeps passing health polls so it never restarts and the
+    rejoin replay never fires — reporting broadcast success there would
+    be a permanent version split-brain.  Only a refused/reset connection
+    (replica genuinely gone; it republishes on rejoin) is excluded from
+    the success computation."""
+    class TimingOut(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":publish"):
+                raise ReplicaTransportError(
+                    f"replica {self.name}: timed out"
+                ) from TimeoutError("read timed out")
+            return super().request(method, path, body, timeout_s)
+
+    a, slow = FakeReplica("a"), TimingOut("slow")
+    r = _router([a, slow])
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 502 and body["succeeded"] == 1
+    assert body["replicas"]["slow"]["status"] == -1
+    # the partial publish must NOT be remembered as fleet-wide success
+    # (the rejoin replay cache only holds publishes every reachable
+    # replica acknowledged)
+    assert "m" not in r._published
+
+
+def test_router_replays_publishes_to_rejoined_replica():
+    """Regression: a supervised restart respawns a replica from its
+    ORIGINAL argv, so a hot-swap it missed while dead must be replayed
+    when it rejoins — otherwise it serves the stale model forever."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    r.poll_once()
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "v2.txt"})
+    assert status == 200 and body["succeeded"] == 2
+    a.dead = True
+    r.poll_once()                         # a -> down
+    assert r.replica_states()["a"]["state"] == "down"
+    # ...restart: a fresh process (new boot_s) with its ORIGINAL model
+    a.dead = False
+    a.boot += 1
+    a.published = []
+    r.poll_once()                         # down -> shed + replay fires
+    deadline = time.time() + 10
+    while time.time() < deadline and not a.published:
+        time.sleep(0.02)
+    assert a.published and a.published[0]["model_file"] == "v2.txt"
+    # the broadcast to the live replica was not replayed twice
+    assert len(b.published) == 1
+
+
+def test_router_no_replay_on_poll_blip_without_restart():
+    """Regression: a transient health-poll failure (timeout under load)
+    walks a replica down and back WITHOUT a restart — its boot_s is
+    unchanged, so the publish replay must NOT fire: the replica already
+    applied the broadcast, and a redundant publish would desynchronize
+    its version counter from its peers, corrupting a later fleet-wide
+    rollback."""
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b])
+    r.poll_once()
+    assert r.handle("POST", "/v1/models/m:publish",
+                    {"model_file": "v2.txt"})[0] == 200
+    assert len(a.published) == 1
+    a.dead = True                         # one blown 2s health poll...
+    r.poll_once()
+    a.dead = False                        # ...same process answers again
+    r.poll_once()
+    time.sleep(0.2)                       # would-be replay thread window
+    assert len(a.published) == 1          # no redundant publish
+    assert a.version == b.version == 2
+
+
+def test_router_gauges_exported():
+    a, b = FakeReplica("a", _gauges(queue_rows=7, p99_ms=3.5)), \
+        FakeReplica("b")
+    r = _router([a, b])
+    r.poll_once()
+    r.handle("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    status, text = r.handle("GET", "/v1/metrics/prometheus")
+    assert status == 200 and isinstance(text, str)
+    assert 'lgbm_fleet_replica_load_rows{replica="a"} 7' in text
+    assert "lgbm_fleet_requests_total" in text
+    status, js = r.handle("GET", "/v1/metrics")
+    assert status == 200
+    assert js["router"]["lgbm_fleet_requests_total"]["_"] == 1
+    assert js["replicas"]["a"]["load_rows"] == 7
+
+
+def test_router_validates_and_404s():
+    r = _router([FakeReplica("a")])
+    assert r.handle("GET", "/nope")[0] == 404
+    status, body = r.handle("GET", "/v1/fleet/replicas")
+    assert status == 200 and "a" in body["replicas"]
+    with pytest.raises(lgb.LightGBMError):
+        FleetRouter([], autostart=False)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor plumbing (fast paths; the real spawn/kill e2e is slow-marked)
+# ---------------------------------------------------------------------------
+def test_default_replica_argv_strips_fleet_params():
+    argv = default_replica_argv(
+        {"task": "serve", "input_model": "m.txt", "fleet_replicas": "3",
+         "fleet_role": "", "fleet_slo_p99_ms": "50", "serving_port": "9",
+         "serving_max_batch": "256", "config": "x.conf"}, 8123)
+    assert "task=serve" in argv and "fleet_role=replica" in argv
+    assert "serving_port=8123" in argv
+    assert "input_model=m.txt" in argv and "serving_max_batch=256" in argv
+    assert not any(a.startswith("fleet_") and a != "fleet_role=replica"
+                   for a in argv)
+    assert not any(a.startswith("config=") for a in argv)
+
+
+def test_cli_router_role_requires_urls():
+    from lightgbm_tpu.application import Application
+    app = Application(["task=serve", "fleet_role=router"])
+    with pytest.raises(lgb.LightGBMError, match="fleet_replica_urls"):
+        app.run()
+
+
+def test_replica_fault_injection_raises_in_process(binary_data, monkeypatch):
+    """LGBM_TPU_FAULT_REQUEST (checkpoint/fault.py) fires on the n-th
+    admitted predict; mode=raise is the in-process variant (mode=exit is
+    what the slow e2e / soak uses to kill a real replica)."""
+    from lightgbm_tpu.checkpoint.fault import InjectedWorkerFault
+    from lightgbm_tpu.serving import ServingApp
+    X_train, y_train, _, _ = binary_data
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X_train, y_train), 2)
+    monkeypatch.setenv("LGBM_TPU_FAULT_REQUEST", "3")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    app = ServingApp(max_wait_ms=1)
+    app.registry.publish("m", booster=bst, warmup=False)
+    try:
+        rows = {"rows": [[0.0] * X_train.shape[1]]}
+        assert app.handle("POST", "/v1/models/m:predict", rows)[0] == 200
+        assert app.handle("POST", "/v1/models/m:predict", rows)[0] == 200
+        with pytest.raises(InjectedWorkerFault, match="request 3"):
+            app.handle("POST", "/v1/models/m:predict", rows)
+        # ONE fault per schedule: mode=raise survives the "death", and
+        # re-firing on every later request would flap the replica forever
+        assert app.handle("POST", "/v1/models/m:predict", rows)[0] == 200
+        # a SECOND app is a fresh consumer of the same schedule — its
+        # admitted count restarts, so the latch re-arms at construction
+        # (a process-global latch keyed on the count would silently
+        # swallow every later same-count schedule)
+        app2 = ServingApp(max_wait_ms=1)
+        app2.registry.publish("m", booster=bst, warmup=False)
+        try:
+            assert app2.handle("POST", "/v1/models/m:predict", rows)[0] == 200
+            assert app2.handle("POST", "/v1/models/m:predict", rows)[0] == 200
+            with pytest.raises(InjectedWorkerFault, match="request 3"):
+                app2.handle("POST", "/v1/models/m:predict", rows)
+        finally:
+            app2.close()
+    finally:
+        monkeypatch.delenv("LGBM_TPU_FAULT_REQUEST")
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# Static-analysis guard (satellite): the pinned check_vma spelling must not
+# return outside mesh.py — PR 6 migrated the learners onto
+# mesh.compat_shard_map precisely because jax renamed check_rep->check_vma
+# and a pinned kwarg breaks across versions.
+# ---------------------------------------------------------------------------
+def test_no_pinned_check_vma_outside_mesh():
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.relpath(path, pkg) == os.path.join("parallel",
+                                                          "mesh.py"):
+                continue   # the compat shim is the one allowed spelling
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    code = line.split("#", 1)[0]
+                    if "check_vma" in code or "check_rep" in code:
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "pinned shard_map check_vma/check_rep kwarg outside parallel/"
+        "mesh.py — use mesh.compat_shard_map instead:\n"
+        + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real replica processes, real kill, supervised restart.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_end_to_end_kill_one_replica_zero_failures(tmp_path):
+    """Two real replica processes behind an in-process router; SIGKILL one
+    mid-traffic.  Acceptance: zero failed requests (the router reroutes
+    around the corpse) and the supervisor restarts it."""
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.fleet import HttpReplica
+
+    X = RNG.randn(600, 6).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, y), 4)
+    model_path = str(tmp_path / "model.txt")
+    bst.save_model(model_path)
+    expect = bst.predict(X[:4])
+
+    ports = find_open_ports(2)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(
+            {"input_model": model_path, "verbosity": "-1",
+             "serving_max_wait_ms": "1"}, port),
+        ports, env=env, log_dir=str(tmp_path / "logs"),
+        max_restarts=2, restart_backoff_s=0.1)
+    router = None
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=120)
+        sup.start_watching(interval_s=0.1)
+        router = FleetRouter([HttpReplica(u) for u in sup.urls],
+                             policy=SLOPolicy(recover_polls=1),
+                             poll_interval_ms=50)
+        failures, done = [], threading.Event()
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            while not done.is_set():
+                lo = int(rng.randint(0, 4))
+                status, body = router.handle(
+                    "POST", "/v1/models/default:predict",
+                    {"rows": X[lo:lo + 2].tolist()})
+                if status != 200:
+                    failures.append((status, body))
+                else:
+                    np.testing.assert_allclose(
+                        body["predictions"], bst.predict(X[lo:lo + 2]),
+                        rtol=1e-6, atol=1e-7)
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        sup.kill(0)                       # SIGKILL mid-traffic
+        time.sleep(2.0)
+        done.set()
+        for t in threads:
+            t.join(60)
+        assert not failures, failures[:3]
+        # the supervisor brought the corpse back
+        deadline = time.time() + 60
+        while time.time() < deadline and not sup.replicas[0].alive:
+            time.sleep(0.2)
+        assert sup.replicas[0].alive and sup.replicas[0].restarts == 1
+        # and the router walks it back to routable
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            states = router.replica_states()
+            if states[sup.urls[0]]["state"] == "healthy":
+                break
+            time.sleep(0.2)
+        status, body = router.handle("POST", "/v1/models/default:predict",
+                                     {"rows": X[:4].tolist()})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"], expect,
+                                   rtol=1e-6, atol=1e-7)
+    finally:
+        if router is not None:
+            router.close()
+        sup.stop_all()

@@ -25,6 +25,7 @@ from lightgbm_tpu.ops.predict import (DEFAULT_BUCKET_LADDER, pad_rows,
 from lightgbm_tpu.serving import (CompiledPredictor, MicroBatcher,
                                   ModelRegistry, QueueFullError, ServingApp,
                                   ServingMetrics)
+from lightgbm_tpu.serving import metrics as serving_metrics
 
 RNG = np.random.RandomState(7)
 
@@ -403,6 +404,173 @@ def test_microbatcher_close_without_drain_cancels():
     time.sleep(0.05)
     mb2.close(drain=False)
     assert fut.cancelled() and calls == []
+
+
+def test_continuous_batching_bit_identical_to_flush_and_wait(binary_booster):
+    """Acceptance: the same request set through continuous batching and
+    flush-and-wait produces bit-identical per-request results with ZERO
+    new compiled programs — the schedule changes when rows are grouped,
+    never what any row computes (same bucket ladder either way)."""
+    pred = binary_booster.to_compiled(buckets=(8, 64, 512))
+    pred.warmup()
+    compiles_before = pred.compile_count
+    rng = np.random.RandomState(21)
+    reqs = [rng.randn(rng.randint(1, 9), 6).astype(np.float32)
+            for _ in range(40)]
+    outs = {}
+    for continuous in (True, False):
+        with MicroBatcher(pred, max_batch=512, max_wait_ms=5,
+                          continuous=continuous) as mb:
+            futs = [mb.submit(r) for r in reqs]
+            outs[continuous] = [f.result(timeout=30) for f in futs]
+    for got, ref in zip(outs[True], outs[False]):
+        np.testing.assert_array_equal(got, ref)
+    assert pred.compile_count == compiles_before
+
+
+def test_continuous_batching_launches_when_device_frees():
+    """The continuous property itself, deterministically: requests that
+    arrive while the device is busy must flush the moment it frees, NOT
+    wait out a fresh max_wait window.  With a 60 s window, the follow-up
+    requests resolving within seconds proves the window was skipped."""
+    release = threading.Event()
+    flushes = []
+
+    class Gated:
+        def predict(self, X):
+            flushes.append(X.shape[0])
+            if len(flushes) == 1:
+                release.wait(timeout=30)   # first flush: device "busy"
+            return X[:, 0]
+
+    with MicroBatcher(Gated(), max_batch=4, max_wait_ms=60_000,
+                      continuous=True) as mb:
+        first = mb.submit(np.zeros((4, 2)))   # == max_batch: flushes now
+        time.sleep(0.05)                      # worker is inside flush 1
+        late = [mb.submit(np.ones((1, 2))) for _ in range(3)]
+        release.set()
+        assert first.result(timeout=10).shape == (4,)
+        for f in late:
+            # would time out here if the 60 s window applied
+            assert f.result(timeout=10).shape == (1,)
+    # the three late requests rode ONE immediate batch behind the first
+    assert flushes == [4, 3]
+
+
+def test_microbatcher_close_drains_under_concurrent_submitters(
+        binary_booster):
+    """Satellite acceptance: shutdown mid-traffic must DRAIN — every
+    future handed out before close resolves with a result; late
+    submitters get a clean error at submit(), never a hung future."""
+    pred = binary_booster.to_compiled(buckets=(8, 64))
+    pred.warmup()
+    mb = MicroBatcher(pred, max_batch=64, max_wait_ms=50)
+    futures, rejected = [], []
+    flock = threading.Lock()
+    stop = threading.Event()
+
+    def submitter(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            rows = rng.randn(rng.randint(1, 5), 6).astype(np.float32)
+            try:
+                f = mb.submit(rows)
+            except lgb.LightGBMError:
+                rejected.append(1)     # closed: clean refusal is fine
+                return
+            with flock:
+                futures.append((rows.shape[0], f))
+
+    threads = [threading.Thread(target=submitter, args=(s,))
+               for s in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)                   # queue + in-flight work exists
+    mb.close()                         # drain, not drop
+    stop.set()
+    for t in threads:
+        t.join(30)
+    assert futures
+    for n, f in futures:
+        out = f.result(timeout=10)     # hangs/errors fail loudly here
+        assert out.shape == (n,) and not f.cancelled()
+
+
+def test_app_close_drains_and_refuses(binary_booster):
+    """ServingApp.close() under concurrent handle() traffic: in-flight
+    requests drain to 200s, post-close requests get 503 (and no new
+    batcher thread is minted after close — the leak that would strand
+    futures at teardown)."""
+    app = ServingApp(max_wait_ms=20)
+    app.registry.publish("m", booster=binary_booster, warmup=False)
+    X = RNG.randn(2, 6)
+    bad = []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            status, body = app.handle("POST", "/v1/models/m:predict",
+                                      {"rows": X.tolist()})
+            if status not in (200, 503):
+                bad.append((status, body))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    app.close()
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    assert not bad, bad[:3]
+    assert not app._batchers            # nothing minted after close
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X.tolist()})
+    assert status == 503 and "closed" in body["error"]
+    app.close()                         # idempotent
+
+
+def test_app_unhandled_error_is_a_500_response(app, monkeypatch):
+    """Regression: an exception the route code didn't expect must come
+    back as a 500 RESPONSE, not tear down the HTTP connection — a torn
+    connection is indistinguishable from a dead replica to the fleet
+    router, and one poisoned request retried fleet-wide would walk every
+    replica into 'down'."""
+    def boom(*a, **k):
+        raise RuntimeError("unexpected bug")
+    monkeypatch.setattr(app, "_predict", boom)
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": [[0.0] * 6]})
+    assert status == 500 and "RuntimeError" in body["error"]
+
+
+def test_fleet_health_route_exposes_slo_gauges(app, monkeypatch):
+    X = RNG.randn(5, 6)
+    assert app.handle("POST", "/v1/models/m:predict",
+                      {"rows": X.tolist()})[0] == 200
+    status, body = app.handle("GET", "/v1/fleet/health")
+    assert status == 200 and body["role"] == "replica"
+    g = body["gauges"]
+    for key in ("queue_rows", "inflight_rows", "p99_ms", "batch_fill",
+                "requests", "errors"):
+        assert key in g
+    assert g["requests"] >= 1 and 0.0 < g["batch_fill"] <= 1.0
+    # per-model detail deliberately NOT here (the route is polled
+    # 10-20x/s); it lives on /v1/metrics
+    assert "models" not in body
+    # reads are side-effect-free: a second consumer (monitoring scrape,
+    # HA router) sees the same evidence, it is not consumed by the first
+    g2 = app.handle("GET", "/v1/fleet/health")[1]["gauges"]
+    assert g2["p99_ms"] == g["p99_ms"] > 0.0
+    assert g2["batch_fill"] == g["batch_fill"]
+    # staleness gate: once the activity window expires with no new
+    # traffic, the old burst's p99/fill stop reading as live saturation
+    monkeypatch.setattr(serving_metrics, "FLEET_ACTIVE_WINDOW_S", 0.0)
+    g3 = app.handle("GET", "/v1/fleet/health")[1]["gauges"]
+    assert g3["p99_ms"] == 0.0 and g3["batch_fill"] == 0.0
+    status, metrics = app.handle("GET", "/v1/metrics")
+    assert status == 200 and metrics["m"]["requests"] >= 1
 
 
 def test_stacked_trees_cache_bounded():

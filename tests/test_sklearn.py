@@ -7,6 +7,9 @@ import pytest
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow   # tier-1 budget (104s): sklearn classifier API stays
+# covered by test_classifier_multiclass/string_labels/integration; binary
+# model quality by engine test_binary
 def test_classifier_binary(binary_data):
     X_train, y_train, X_test, y_test = binary_data
     clf = lgb.LGBMClassifier(n_estimators=30, num_leaves=31)
@@ -45,6 +48,9 @@ def test_classifier_string_labels():
     assert (pred == ys).mean() > 0.8
 
 
+@pytest.mark.slow   # tier-1 budget (85s): regression quality + eval_set
+# stay covered by engine test_regression/test_early_stopping; the sklearn
+# regressor API by test_clone_and_params + integration
 def test_regressor(regression_data):
     X_train, y_train, X_test, y_test = regression_data
     reg = lgb.LGBMRegressor(n_estimators=40, num_leaves=31)
