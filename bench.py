@@ -82,6 +82,24 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  the claims are topology claims.  Knobs:
                  BENCH_FLEET_{REPLICAS,MODELS,THREADS,SECONDS,TREES,
                  TRAIN_ROWS,MAX_REQ_ROWS,FAULT_REQUEST}.
+- continuous     train→serve chaos soak (run_continuous): one in-process
+                 continuous-boosting service (lightgbm_tpu/continuous/)
+                 with ALL persistence on the chaosio:// fault injector,
+                 serving predict traffic throughout while the soak
+                 injects a mid-cycle trainer kill + corrupted newest
+                 checkpoint, an armed transient IO error, a poisoned
+                 segment, and a quality-regressing segment.  Reported:
+                 rows/s served across the whole soak, vs_baseline =
+                 availability (successful / total predict requests; bar:
+                 1.0), served_only_gated (bar: true), rollbacks +
+                 rollback_in_history (bar: >=1/true — the regressing
+                 model was withdrawn), resumed_below_corrupt +
+                 resume_bit_identical (bars: true — recovery skipped the
+                 corrupt checkpoint and finished the cycle bit-identical
+                 to an uninterrupted control).  CPU by design: the
+                 claims are control-flow and persistence claims.  Knobs:
+                 BENCH_CONT_{ROUNDS,SEG_ROWS,THREADS,KILL_ITER,MIN_AUC,
+                 MAX_REQ_ROWS}.
 """
 
 import json
@@ -795,6 +813,263 @@ def run_fleet():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_continuous():
+    """Child body for BENCH_STAGE=continuous: the closed train→serve loop
+    under chaos (lightgbm_tpu/continuous/).
+
+    One in-process service (tail → train → gate → publish) with its
+    persistence on the ``chaosio://`` fault injector, serving predict
+    traffic THROUGHOUT from the in-process ServingApp while the soak
+    injects, in order: a mid-cycle trainer kill PLUS a corrupted newest
+    checkpoint (the retry must resume from the previous verifiable one),
+    one armed transient IO error (file_io retry must absorb it), a
+    poisoned segment (quarantine, never a crash), and a quality-regressing
+    segment (the drift watch must roll the registry back).  Bars: zero
+    failed predict requests, every served version gate-accepted, the
+    killed+corrupted cycle's model BIT-IDENTICAL to an uninterrupted
+    control replay.  Runs on CPU by design — the claims are control-flow
+    and persistence claims, not device claims."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    from lightgbm_tpu.continuous import (ContinuousService,
+                                         ContinuousTrainer, DataTail,
+                                         PublishGate)
+    from lightgbm_tpu.io import file_io
+    from lightgbm_tpu.io.chaos import register_chaos_scheme
+    from lightgbm_tpu.serving.server import ServingApp
+    from lightgbm_tpu.telemetry import MetricsRegistry
+
+    rounds = int(os.environ.get("BENCH_CONT_ROUNDS", 8))
+    seg_rows = int(os.environ.get("BENCH_CONT_SEG_ROWS", 2000))
+    n_threads = int(os.environ.get("BENCH_CONT_THREADS", 4))
+    kill_at = int(os.environ.get("BENCH_CONT_KILL_ITER",
+                                 max(rounds // 2, 2)))
+    floor = float(os.environ.get("BENCH_CONT_MIN_AUC", 0.55))
+    max_req = int(os.environ.get("BENCH_CONT_MAX_REQ_ROWS", 64))
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_cont_")
+    src = os.path.join(tmp, "src")
+    os.makedirs(src)
+    chaos = register_chaos_scheme("chaosio")
+    workdir = f"chaosio://{tmp}/work"       # ALL persistence rides chaos
+    file_io.makedirs(workdir)
+    prev_retries = file_io.configure_retries(attempts=3, backoff_s=0.01)
+
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1, "max_bin": MAX_BIN,
+              "min_data_in_leaf": 20, "seed": 7}
+
+    def write_segment(name, X, y, extra=()):
+        lines = [",".join([f"{y[i]:.0f}"]
+                          + [f"{v:.6f}" for v in X[i]])
+                 for i in range(len(y))]
+        lines.extend(extra)
+        tpath = os.path.join(src, f"_{name}.part")
+        with open(tpath, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tpath, os.path.join(src, name))
+
+    class KillOnce(ContinuousTrainer):
+        """The soak's double fault: at iteration ``kill_at`` of cycle 1
+        the newest checkpoint is torn mid-file AND the trainer dies."""
+
+        fired = False
+        corrupted_iteration = None
+
+        def _bomb(self, env):
+            if self.fired or env.iteration != kill_at:
+                return
+            KillOnce.fired = True
+            local = self._cycle_dir(self.cycle).split("://", 1)[-1]
+            newest = sorted(f for f in os.listdir(local)
+                            if f.endswith(".lgbckpt"))[-1]
+            KillOnce.corrupted_iteration = int(
+                newest.split("_")[1].split(".")[0])
+            path = os.path.join(local, newest)
+            data = open(path, "rb").read()
+            with open(path, "wb") as fh:
+                fh.write(data[:len(data) // 2])
+            raise RuntimeError("chaos: injected trainer death")
+
+        def train_cycle(self, callbacks=None):
+            cbs = list(callbacks or [])
+            if not KillOnce.fired and self.cycle == 1:
+                cbs.append(self._bomb)
+            return super().train_cycle(cbs)
+
+    app = ServingApp()
+    mreg = MetricsRegistry()
+    trainer = KillOnce(params, workdir, rounds_per_cycle=rounds)
+    gate = PublishGate(app.registry, "cont", min_auc=floor,
+                       max_regression=0.2, min_fresh_rows=50,
+                       metrics_registry=mreg)
+    tail = DataTail(src, num_features=N_FEATURES,
+                    quarantine_path=f"{workdir}/quarantine.jsonl",
+                    registry=mreg)
+    service = ContinuousService(tail, trainer, gate, poll_s=0.0,
+                                retry_backoff_s=0.0, metrics_registry=mreg)
+
+    stop = threading.Event()
+    failures = []
+    served_versions = set()
+    sent = [0] * n_threads
+    ok = [0] * n_threads
+    pool = np.random.RandomState(1).randn(4096, N_FEATURES) \
+        .astype(np.float64)
+
+    def client(i):
+        r = np.random.RandomState(100 + i)
+        while not stop.is_set():
+            n = int(r.randint(1, max_req + 1))
+            lo = int(r.randint(0, pool.shape[0] - n))
+            status, body = app.handle(
+                "POST", "/v1/models/cont:predict",
+                {"rows": pool[lo:lo + n].tolist()})
+            if status != 200:
+                failures.append((status, str(body)[:200]))
+            else:
+                sent[i] += n
+                ok[i] += 1
+                served_versions.add(body.get("version"))
+
+    result = {}
+    accepted = set()
+    threads = []
+    try:
+        # segment 0: clean → cycle 0 publishes; serving starts after it
+        X0, y0 = synth_binary(seg_rows, seed=20)
+        write_segment("seg000.csv", X0, y0)
+        s0 = service.step()
+        assert s0["decision"]["action"] == "publish", s0
+        accepted.add(s0["decision"]["version"])
+        setup_s = time.time() - t_start
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+
+        # segment 1: clean, but the trainer dies at iteration kill_at
+        # with the newest checkpoint corrupted; one transient IO error is
+        # armed so the retry also exercises file_io backoff
+        X1, y1 = synth_binary(seg_rows, seed=21)
+        write_segment("seg001.csv", X1, y1)
+        chaos.fail_writes(1)
+        s1 = service.step()
+        resumed = (trainer.resume_events[0]["iteration"]
+                   if trainer.resume_events else None)
+        if s1["decision"]["action"] == "publish":
+            accepted.add(s1["decision"]["version"])
+        chaos_model = trainer.model_str
+
+        # segment 2: poisoned — one third garbage rows
+        Xp, yp = synth_binary(seg_rows, seed=22)
+        poison = (["not,a,row"] * (seg_rows // 6)
+                  + ["1," + ",".join(["inf"] * N_FEATURES)]
+                  * (seg_rows // 6))
+        write_segment("seg002.csv", Xp, yp, extra=poison)
+        s2 = service.step()
+        if s2["decision"]["action"] == "publish":
+            accepted.add(s2["decision"]["version"])
+
+        # segment 3: the world inverts — the drift watch must roll back
+        Xi, yi = synth_binary(seg_rows, seed=23)
+        write_segment("seg003.csv", Xi, 1.0 - yi)
+        s3 = service.step()
+        if s3["decision"] and s3["decision"]["action"] == "publish":
+            accepted.add(s3["decision"]["version"])
+
+        stop.set()
+        for t in threads:
+            t.join(60)
+        elapsed = time.time() - t0
+
+        # bit-identity control: replay cycles 0-1 uninterrupted through
+        # the same tail pipeline (CSV-rounded bytes), compare cycle-1
+        # models.  Skipped (None) if the budget is nearly spent.
+        bit_identical = None
+        if deadline - time.time() > 60:
+            control = ContinuousTrainer(params,
+                                        os.path.join(tmp, "control"),
+                                        rounds_per_cycle=rounds)
+            ctail = DataTail(src, num_features=N_FEATURES)
+            replay = {b.name: b for b in ctail.poll()}
+            control.ingest(replay["seg000.csv"].X, replay["seg000.csv"].y)
+            c0 = control.train_cycle()
+            control.commit(c0["candidate_str"])
+            control.ingest(replay["seg001.csv"].X, replay["seg001.csv"].y)
+            bit_identical = (control.train_cycle()["candidate_str"]
+                             == chaos_model)
+
+        history = app.registry.history("cont")
+        rows_s = sum(sent) / max(elapsed, 1e-9)
+        n_ok = sum(ok)
+        availability = round(n_ok / max(n_ok + len(failures), 1), 6)
+        result = {
+            "metric": f"continuous_{rounds}rounds_{seg_rows}segrows_"
+                      f"{n_threads}threads",
+            "value": round(rows_s, 1),
+            "unit": "rows/s",
+            # the robustness bar expressed as a ratio: fraction of
+            # predict traffic served successfully across every injected
+            # fault (1.0 == zero failed requests)
+            "vs_baseline": availability,
+            "failed_requests": len(failures),
+            "served_versions": sorted(v for v in served_versions
+                                      if v is not None),
+            "accepted_versions": sorted(accepted),
+            "served_only_gated": served_versions <= accepted,
+            "publishes": int(gate.m_published.value),
+            "rejects": int(gate.m_rejected.value),
+            "rollbacks": int(gate.m_rollbacks.value),
+            "rollback_in_history": any(h["action"] == "rollback"
+                                       for h in history),
+            "quarantined_rows": int(tail.m_quarantined.value),
+            "cycle_retries": int(service.m_cycle_failures.value),
+            "corrupted_checkpoint_iteration": KillOnce.corrupted_iteration,
+            "resumed_from_iteration": resumed,
+            "resumed_below_corrupt": (
+                resumed is not None
+                and KillOnce.corrupted_iteration is not None
+                and resumed < KillOnce.corrupted_iteration),
+            "resume_bit_identical": bit_identical,
+            "transient_io_errors_injected":
+                chaos.counters["transient_errors"],
+            "gate_floor": floor,
+            "published_aucs": [round(e["auc"], 4) for e in gate.events
+                               if e["action"] == "publish"],
+            "soak_s": round(elapsed, 1),
+            "setup_s": round(setup_s, 1),
+            "backend": backend,
+        }
+        if failures:
+            result["first_failures"] = failures[:3]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+        try:
+            app.close()
+        finally:
+            file_io.configure_retries(*prev_retries)
+            chaos.calm()
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def run_hist():
     """Child body for BENCH_STAGE=hist: prove the bin-width-class histogram
     engine without the chip.
@@ -990,6 +1265,8 @@ if __name__ == "__main__":
             run_hist()
         elif stage == "fleet":
             run_fleet()
+        elif stage == "continuous":
+            run_continuous()
         else:
             run_training()
     else:

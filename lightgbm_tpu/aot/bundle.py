@@ -159,9 +159,18 @@ class ProgramBundle:
         # but an unrelated process racing the same bundle dir must at worst
         # lose a manifest entry, never interleave bytes in one tmp file
         tmp = self._manifest_path() + f".tmp.{os.getpid()}"
-        with file_io.open_writable(tmp) as fh:
-            json.dump(man, fh, indent=1, sort_keys=True, default=str)
-        file_io.rename(tmp, self._manifest_path())
+        try:
+            with file_io.open_writable(tmp) as fh:
+                json.dump(man, fh, indent=1, sort_keys=True, default=str)
+            file_io.rename(tmp, self._manifest_path())
+        except Exception:
+            # a torn/failed write must leave no .tmp litter and, because
+            # the rename never ran, no manifest change at all
+            try:
+                file_io.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     def program_names(self) -> list:
         return sorted(self.manifest()["programs"])
@@ -193,13 +202,27 @@ class ProgramBundle:
         se.deserialize_and_load(blob, in_tree, out_tree)
         file_io.makedirs(self.path)
         fname = f"{name}.xprog"
+        payload = pickle.dumps((blob, in_tree, out_tree),
+                               protocol=pickle.HIGHEST_PROTOCOL)
         tmp = _join(self.path, fname + f".tmp.{os.getpid()}")
-        with file_io.open_writable(tmp, binary=True) as fh:
-            pickle.dump((blob, in_tree, out_tree), fh)
-        file_io.rename(tmp, _join(self.path, fname))
+        try:
+            with file_io.open_writable(tmp, binary=True) as fh:
+                fh.write(payload)
+            file_io.rename(tmp, _join(self.path, fname))
+        except Exception:
+            try:
+                file_io.remove(tmp)
+            except OSError:
+                pass
+            raise
         man = self.manifest()
         man["programs"][name] = {
             "file": fname,
+            # content hash verified on every load: a flipped bit in a
+            # pickled executable blob deserializes into anything from a
+            # crash to a silently wrong program — the one failure mode the
+            # signature match cannot catch
+            "sha256": hashlib.sha256(payload).hexdigest(),
             "signature": _canonical(signature),
             "fingerprint": signature_fingerprint(signature),
             "saved_at": time.time(),
@@ -228,9 +251,19 @@ class ProgramBundle:
                                            entry.get("signature", {}))
         try:
             from jax.experimental import serialize_executable as se
-            with file_io.open_readable(_join(self.path, entry["file"]),
-                                       binary=True) as fh:
-                blob, in_tree, out_tree = pickle.load(fh)
+            payload = file_io.read_bytes(_join(self.path, entry["file"]))
+            want = entry.get("sha256")
+            if want is not None:
+                got = hashlib.sha256(payload).hexdigest()
+                if got != want:
+                    # never unpickle bytes that failed their hash —
+                    # corruption reduces to the recompile fallback, with
+                    # the reason logged like any other miss
+                    return None, (
+                        f"program {name!r} failed its sha256 check "
+                        f"(manifest {want[:12]}…, file {got[:12]}…): "
+                        "bundle file corrupt")
+            blob, in_tree, out_tree = pickle.loads(payload)
             return se.deserialize_and_load(blob, in_tree, out_tree), ""
         except Exception as exc:
             return None, (f"failed to deserialize {name!r} from "

@@ -82,6 +82,8 @@ class Application:
             self._serve()
         elif task == "precompile":
             self._precompile()
+        elif task == "continuous":
+            self._continuous()
         else:
             raise ValueError(f"unknown task {task!r}")
 
@@ -293,6 +295,80 @@ class Application:
             out = precompile_predictor(cfg.input_model, bundle_dir)
             log_info(f"precompile serve: {out}")
         log_info(f"Finished precompile; bundle at {bundle_dir}")
+
+    def _continuous(self) -> None:
+        """task=continuous: the closed train→serve loop
+        (lightgbm_tpu/continuous/).
+
+        Tails ``continuous_source`` for appended CSV segments, continues
+        boosting from the latest checkpoint each cycle, and publishes
+        gate-accepted models as ``serving_model_name`` into an in-process
+        registry — served over HTTP on ``serving_port`` while training
+        runs (port 0 = train/gate only, no server).  ``input_model``
+        seeds the registry (and the continuation base) so serving starts
+        from a known-good model before the first cycle completes."""
+        import threading
+
+        from .continuous import (ContinuousService, ContinuousTrainer,
+                                 DataTail, PublishGate)
+        from .serving.server import ServingApp, make_server
+        cfg = self.config
+        if not cfg.continuous_source:
+            raise ValueError("task=continuous requires continuous_source="
+                             "DIR (the append-only segment directory)")
+        workdir = cfg.continuous_dir or (
+            str(cfg.continuous_source).rstrip("/") + "_work")
+        app = ServingApp(max_batch=cfg.serving_max_batch,
+                         max_wait_ms=cfg.serving_max_wait_ms,
+                         max_queue_rows=cfg.serving_max_queue_rows,
+                         continuous=bool(cfg.serving_continuous_batching))
+        name = str(cfg.serving_model_name).split(",")[0] or "default"
+        bundle = cfg.aot_bundle_dir or None
+        tail = DataTail(
+            cfg.continuous_source,
+            quarantine_path=f"{workdir}/quarantine.jsonl",
+            allow_nan_features=bool(cfg.continuous_allow_nan_features))
+        trainer = ContinuousTrainer(
+            self.raw_params, workdir,
+            rounds_per_cycle=cfg.continuous_rounds,
+            holdout_fraction=cfg.continuous_holdout_fraction,
+            checkpoint_freq=max(cfg.checkpoint_freq, 1),
+            keep_checkpoints=cfg.keep_checkpoints)
+        gate = PublishGate(app.registry, name,
+                           min_auc=cfg.continuous_min_auc,
+                           max_regression=cfg.continuous_max_regression,
+                           aot_bundle_dir=bundle)
+        if cfg.input_model:
+            # seed: serving is live (and gated-good) before cycle 0 ends
+            from .io.file_io import read_text
+            seed = read_text(cfg.input_model)
+            version = app.registry.publish(name, model_str=seed,
+                                           aot_bundle_dir=bundle)
+            trainer.model_str = seed
+            log_info(f"continuous: seeded {name!r} v{version} from "
+                     f"{cfg.input_model}")
+        service = ContinuousService(tail, trainer, gate,
+                                    poll_s=cfg.continuous_poll_s)
+        from .io import file_io
+        file_io.makedirs(workdir)
+        httpd = None
+        if cfg.serving_port > 0:
+            httpd = make_server(app, host=cfg.serving_host,
+                                port=cfg.serving_port)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            log_info(f"continuous: serving {name!r} on "
+                     f"http://{cfg.serving_host}:{httpd.server_port}")
+        try:
+            stats = service.run(
+                max_cycles=cfg.continuous_max_cycles or None,
+                max_idle_polls=cfg.continuous_max_idle_polls or None)
+            log_info(f"Finished continuous: {stats}")
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+            app.close()
 
     def _convert_model(self) -> None:
         from .basic import Booster

@@ -26,13 +26,14 @@ from .fault import (DEFAULT_FAULT_EXIT_CODE, FAULT_ENV_VARS,
                     InjectedWorkerFault, fault_spec, maybe_inject_fault)
 from .manager import (CHECKPOINT_SUFFIX, CheckpointManager,
                       atomic_write_text, restore_barrier)
-from .state import (FORMAT_VERSION, TrainState, capture_train_state,
-                    dataset_fingerprint, restore_train_state,
-                    verify_fingerprint)
+from .state import (FORMAT_VERSION, CheckpointCorruptError, TrainState,
+                    capture_train_state, dataset_fingerprint,
+                    restore_train_state, verify_fingerprint)
 
 __all__ = [
     "TrainState", "capture_train_state", "restore_train_state",
     "dataset_fingerprint", "verify_fingerprint", "FORMAT_VERSION",
+    "CheckpointCorruptError",
     "CheckpointManager", "restore_barrier", "atomic_write_text",
     "CHECKPOINT_SUFFIX",
     "InjectedWorkerFault", "fault_spec", "maybe_inject_fault",

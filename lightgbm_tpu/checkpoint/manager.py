@@ -33,7 +33,7 @@ import numpy as np
 from ..io import file_io
 from ..log import LightGBMError, log_info, log_warning
 from ..timer import timed
-from .state import TrainState
+from .state import CheckpointCorruptError, TrainState
 
 __all__ = ["CheckpointManager", "restore_barrier", "atomic_write_text",
            "CHECKPOINT_SUFFIX"]
@@ -43,20 +43,46 @@ _NAME_RE = re.compile(r"^(?P<prefix>.+)_(?P<iter>\d{8})" +
                       re.escape(CHECKPOINT_SUFFIX) + "$")
 
 
+def _cleanup_tmp(tmp: str) -> None:
+    """Best-effort removal of a failed write's tmp file: a torn write
+    must not leave ``.tmp`` litter for operators to mistake for data
+    (the commit rename never ran, so the target is untouched either
+    way)."""
+    try:
+        file_io.remove(tmp)
+    except OSError:
+        pass
+
+
+def _atomic_write(path: str, data, binary: bool) -> None:
+    """tmp + rename through the scheme registry, retried as ONE unit on
+    transient backend errors (re-running a half-done tmp write is safe by
+    construction — the tmp is overwritten, the rename never happened)."""
+    tmp = path + ".tmp"
+
+    def _do():
+        # the UNRETRIED primitives (_open/_rename_once): the composite
+        # owns the single retry layer — open_writable/rename retry
+        # internally too, and nesting them under with_retry would square
+        # the configured attempt budget
+        try:
+            with file_io._open(tmp, "wb" if binary else "w") as fh:
+                fh.write(data)
+            file_io._rename_once(tmp, path)
+        except Exception:
+            _cleanup_tmp(tmp)
+            raise
+    file_io.with_retry(_do)
+
+
 def atomic_write_text(path: str, text: str) -> None:
     """tmp + rename text write through the file_io scheme registry — the
     shared primitive for model snapshots and the manifest."""
-    tmp = path + ".tmp"
-    with file_io.open_writable(tmp) as fh:
-        fh.write(text)
-    file_io.rename(tmp, path)
+    _atomic_write(path, text, binary=False)
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
-    with file_io.open_writable(tmp, binary=True) as fh:
-        fh.write(data)
-    file_io.rename(tmp, path)
+    _atomic_write(path, data, binary=True)
 
 
 def restore_barrier(iteration: int, timeout_s: float = 600.0) -> None:
@@ -205,23 +231,60 @@ class CheckpointManager:
                     f"{self.directory}/{ent['file']}"
         return sorted(out.items())
 
-    def latest(self) -> Optional[str]:
-        ckpts = self.checkpoints()
-        return ckpts[-1][1] if ckpts else None
+    def latest(self, verify: bool = False) -> Optional[str]:
+        """Newest checkpoint path, or None.
+
+        ``verify=True`` additionally proves the file LOADS (full read +
+        member sha256 + parse), walking back to the newest VERIFIABLE
+        checkpoint when the newest file is corrupt or truncated — the
+        manifest and directory listing only prove a name exists, and a
+        reader that trusts them resumes into a crash loop when the last
+        write was torn."""
+        if not verify:
+            ckpts = self.checkpoints()
+            return ckpts[-1][1] if ckpts else None
+        for _, path in self._verified_newest_first():
+            return path
+        return None
+
+    def _verified_newest_first(self):
+        """Yield ``(TrainState, path)`` newest-first, skipping (and
+        warning about) every checkpoint that fails to read or verify —
+        the single corrupt-fallback walk behind latest(verify=True) and
+        load_latest."""
+        for _, path in reversed(self.checkpoints()):
+            try:
+                yield self._load_verified(path), path
+            except (CheckpointCorruptError, OSError) as exc:
+                log_warning(
+                    f"skipping unusable checkpoint {path}: {exc} — "
+                    "falling back to the previous retained checkpoint")
+
+    def _load_verified(self, path: str) -> TrainState:
+        data = file_io.read_bytes(path)     # whole-read retried
+        return TrainState.from_bytes(data)  # checksum-verified
 
     def load(self, path: Optional[str] = None) -> TrainState:
+        """Load one checkpoint (the latest by default).  An EXPLICIT path
+        hard-fails on corruption — the caller asked for that file;
+        use load_latest() for the skip-corrupt fallback behavior."""
         path = path or self.latest()
         if path is None:
             raise LightGBMError(
                 f"no checkpoint found under {self.directory}")
-        with file_io.open_readable(path, binary=True) as fh:
-            data = fh.read()
-        state = TrainState.from_bytes(data)
+        state = self._load_verified(path)
         log_info(f"loaded checkpoint {path} (iteration {state.iteration})")
         return state
 
     def load_latest(self) -> Optional[TrainState]:
-        """Latest state or None when the directory holds no checkpoints
-        (the auto-resume probe)."""
-        path = self.latest()
-        return None if path is None else self.load(path)
+        """Newest VERIFIABLE state or None when the directory holds no
+        usable checkpoint (the auto-resume probe).  Corrupt or truncated
+        files — a torn write that somehow got committed, bit rot, a
+        half-synced remote store — are skipped with a warning instead of
+        failing the resume: an older good checkpoint re-trains a few
+        iterations; a crash loop re-trains nothing."""
+        for state, path in self._verified_newest_first():
+            log_info(f"loaded checkpoint {path} "
+                     f"(iteration {state.iteration})")
+            return state
+        return None

@@ -47,10 +47,21 @@ import numpy as np
 from ..log import LightGBMError
 from ..tree import Tree
 
-__all__ = ["TrainState", "dataset_fingerprint", "verify_fingerprint",
-           "capture_train_state", "restore_train_state", "FORMAT_VERSION"]
+__all__ = ["TrainState", "CheckpointCorruptError", "dataset_fingerprint",
+           "verify_fingerprint", "capture_train_state",
+           "restore_train_state", "FORMAT_VERSION", "CHECKSUMS_MEMBER"]
 
 FORMAT_VERSION = 1
+CHECKSUMS_MEMBER = "checksums.json"
+
+
+class CheckpointCorruptError(LightGBMError):
+    """The checkpoint bytes are damaged (truncated archive, failed member
+    checksum, unreadable payload) — as opposed to a VALID checkpoint that
+    doesn't match this run (fingerprint/meta mismatches stay plain
+    LightGBMErrors).  The distinction matters to readers: corruption is
+    recoverable by falling back to an older checkpoint; a mismatch means
+    the caller is resuming the wrong run and must stop."""
 
 
 # ----------------------------------------------------------------------
@@ -157,28 +168,68 @@ class TrainState:
         arrays = io.BytesIO()
         np.savez(arrays, train_score=np.asarray(self.train_score,
                                                 np.float32))
-        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("state.json", json.dumps(header,
-                                                 default=_json_scalar))
-            zf.writestr("arrays.npz", arrays.getvalue())
-            zf.writestr("trees.pkl", pickle.dumps(
+        members = {
+            "state.json": json.dumps(header,
+                                     default=_json_scalar).encode(),
+            "arrays.npz": arrays.getvalue(),
+            "trees.pkl": pickle.dumps(
                 {"trees": _clean_trees(self.trees), "extra": self.extra},
-                protocol=pickle.HIGHEST_PROTOCOL))
-            zf.writestr("model.txt", self._debug_model_text())
+                protocol=pickle.HIGHEST_PROTOCOL),
+            "model.txt": self._debug_model_text().encode(),
+        }
+        # per-member sha256, written LAST: verify-on-load catches silent
+        # byte corruption (bit rot, torn remote reads) that unzips fine —
+        # a truncated archive already fails at the zip layer, a flipped
+        # payload bit does not
+        sums = {"algo": "sha256",
+                "members": {name: hashlib.sha256(blob).hexdigest()
+                            for name, blob in members.items()}}
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for name, blob in members.items():
+                zf.writestr(name, blob)
+            zf.writestr(CHECKSUMS_MEMBER, json.dumps(sums, sort_keys=True))
         return buf.getvalue()
 
     @staticmethod
     def from_bytes(data: bytes) -> "TrainState":
-        with zipfile.ZipFile(io.BytesIO(data)) as zf:
-            header = json.loads(zf.read("state.json"))
-            if header.get("format_version") != FORMAT_VERSION:
-                raise LightGBMError(
-                    "unsupported checkpoint format_version "
-                    f"{header.get('format_version')!r} (this build reads "
-                    f"{FORMAT_VERSION})")
-            with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
-                train_score = np.asarray(npz["train_score"])
-            payload = pickle.loads(zf.read("trees.pkl"))
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                names = set(zf.namelist())
+                if CHECKSUMS_MEMBER in names:
+                    # verify BEFORE parsing: pickle/json must never see
+                    # corrupt bytes (a flipped bit in a pickle stream can
+                    # do anything from ValueError to a silently wrong
+                    # object)
+                    sums = json.loads(zf.read(CHECKSUMS_MEMBER))
+                    for member, want in sums.get("members", {}).items():
+                        if member not in names:
+                            raise CheckpointCorruptError(
+                                f"checkpoint member {member!r} listed in "
+                                "checksums but missing from the archive")
+                        got = hashlib.sha256(zf.read(member)).hexdigest()
+                        if got != want:
+                            raise CheckpointCorruptError(
+                                f"checkpoint member {member!r} failed its "
+                                f"sha256 check (stored {want[:12]}…, read "
+                                f"{got[:12]}…): the file is corrupt")
+                header = json.loads(zf.read("state.json"))
+                if header.get("format_version") != FORMAT_VERSION:
+                    raise LightGBMError(
+                        "unsupported checkpoint format_version "
+                        f"{header.get('format_version')!r} (this build "
+                        f"reads {FORMAT_VERSION})")
+                with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
+                    train_score = np.asarray(npz["train_score"])
+                payload = pickle.loads(zf.read("trees.pkl"))
+        except LightGBMError:
+            raise              # corrupt (already typed) or version gate
+        except Exception as exc:
+            # BadZipFile/zlib errors (truncation), KeyError (missing
+            # member), json/pickle decode failures: all one thing to a
+            # reader — these bytes are not a usable checkpoint
+            raise CheckpointCorruptError(
+                f"corrupt checkpoint archive: {type(exc).__name__}: "
+                f"{exc}") from exc
         return TrainState(
             iteration=int(header["iteration"]),
             trees=payload["trees"],

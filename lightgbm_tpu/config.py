@@ -46,7 +46,7 @@ _PARAMS: List[ParamSpec] = [
     _p("config", str, "", ("config_file",), desc="path to a config file (CLI)"),
     _p("task", str, "train", ("task_type",),
        check="in:train|predict|convert_model|refit|save_binary|serve"
-             "|precompile"),
+             "|precompile|continuous"),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss"),
        desc="objective name, see objectives.py"),
@@ -260,6 +260,43 @@ _PARAMS: List[ParamSpec] = [
     _p("fleet_restart_backoff_s", float, 0.5, (), ">=0",
        "base backoff before relaunching a dead replica (doubles per "
        "restart)"),
+    # ---- Continuous boosting service (task=continuous;
+    # lightgbm_tpu/continuous/) ----
+    _p("continuous_source", str, "",
+       desc="append-only segment directory the data tail polls (any "
+            "registered io scheme; producers add CSV segments via "
+            "tmp+rename, label first).  Required for task=continuous"),
+    _p("continuous_dir", str, "",
+       desc="service workdir: per-cycle checkpoint directories under "
+            "cycles/ and the quarantine JSONL (default: "
+            "<continuous_source>_work)"),
+    _p("continuous_rounds", int, 20, (), ">0",
+       "boosting rounds per continuation cycle (each cycle continues "
+       "the last ACCEPTED model via init_model and checkpoints every "
+       "checkpoint_freq iterations for mid-cycle crash resume)"),
+    _p("continuous_poll_s", float, 5.0, (), ">=0",
+       "seconds between polls of continuous_source when no new segment "
+       "arrived"),
+    _p("continuous_min_auc", float, 0.6, (), ">=0",
+       "publish gate absolute floor: a candidate below this held-out "
+       "AUC never reaches the serving registry"),
+    _p("continuous_max_regression", float, 0.05, (), ">=0",
+       "publish gate relative bound: reject a candidate more than this "
+       "below the best published AUC; post-publish, roll back a live "
+       "model that drops more than this below its publish-time AUC on "
+       "fresh data (lgbm_continuous_rollback_total alarm)"),
+    _p("continuous_holdout_fraction", float, 0.2, (), ">0",
+       "fraction of ingested rows held out (deterministically, by "
+       "global ingest index) for the gate's AUC"),
+    _p("continuous_max_cycles", int, 0, (), ">=0",
+       "stop the service after this many training cycles (0 = run "
+       "until killed)"),
+    _p("continuous_max_idle_polls", int, 0, (), ">=0",
+       "exit after this many consecutive empty polls (0 = keep "
+       "polling; soak/test harnesses set it to drain and stop)"),
+    _p("continuous_allow_nan_features", bool, False, (),
+       desc="admit NaN feature values as LightGBM missing values "
+            "instead of quarantining the row (Inf always quarantines)"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),

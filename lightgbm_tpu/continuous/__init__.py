@@ -1,0 +1,28 @@
+"""Continuous boosting service (ROADMAP: close the train→serve loop).
+
+A supervised, long-running pipeline that tails an append-only data
+source, continues boosting from the latest checkpoint, and publishes only
+validation-gated models into the serving registry — with auto-rollback on
+post-publish regression and corruption-hardened persistence underneath
+(checkpoint/bundle sha256 verify-on-load, ``chaosio://`` fault-injection
+coverage in tests).
+
+- :class:`DataTail` — validated ingest (quarantine, never crash)
+- :class:`ContinuousTrainer` — checkpointed continuation cycles
+- :class:`PublishGate` — AUC floor + regression bound + rollback alarm
+- :class:`ContinuousService` — the supervised composition (CLI
+  ``task=continuous``)
+"""
+
+from .gate import PublishGate
+from .service import ContinuousService
+from .tail import DataTail, SegmentBatch
+from .trainer import (ContinuousTrainer, checkpoint_prefix_matches,
+                      combine_model_strings, holdout_auc)
+
+__all__ = [
+    "DataTail", "SegmentBatch",
+    "ContinuousTrainer", "combine_model_strings", "holdout_auc",
+    "checkpoint_prefix_matches",
+    "PublishGate", "ContinuousService",
+]

@@ -1,0 +1,173 @@
+"""PublishGate: validation-gated publish with post-publish auto-rollback.
+
+The gate is the single owner of "what is allowed to serve":
+
+- **pre-publish** (``consider``): a candidate only reaches the
+  ``ModelRegistry`` when its held-out AUC clears BOTH an absolute floor
+  (``min_auc`` — below this, serving nothing new beats serving it) and a
+  relative regression bound against the best AUC a published model has
+  achieved (``max_regression`` — continued training must not quietly walk
+  quality downhill even while staying above the floor).  NaN AUC (empty
+  holdout) never publishes.
+- **post-publish** (``watch``): the cumulative holdout that admitted a
+  model cannot see the future; a model that gated fine can regress on the
+  NEXT data the world produces (drift, a poisoned upstream).  ``watch``
+  scores the CURRENTLY SERVING model on each fresh holdout window and, on
+  a confirmed regression (floor break or ``max_regression`` drop from its
+  publish-time AUC), rolls the registry back to the previous version and
+  bumps the ``lgbm_continuous_rollback_total`` alarm counter — the
+  operator's page-me signal.
+
+Publishes go through ``ModelRegistry.publish(..., aot_bundle_dir=)`` so
+replicas warm from serialized programs, and every decision is recorded in
+``gate.events`` (mirrored by counters) — the audit trail the chaos soak
+asserts against alongside ``registry.history()``.
+
+``min_fresh_rows`` guards the watch against statistical noise: a 5-row
+window scoring 0.4 AUC is weather, not regression; rollback fires only on
+windows big enough to mean something.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..log import LightGBMError, log_info, log_warning
+from ..telemetry import get_counter
+
+__all__ = ["PublishGate"]
+
+
+class PublishGate:
+    def __init__(self, registry, model_name: str = "default",
+                 min_auc: float = 0.6,
+                 max_regression: float = 0.05,
+                 min_fresh_rows: int = 30,
+                 aot_bundle_dir: Optional[str] = None,
+                 metrics_registry=None,
+                 publish_fn=None,
+                 rollback_fn=None):
+        """``registry`` is a serving ``ModelRegistry`` (or None when
+        ``publish_fn``/``rollback_fn`` are given — the fleet path, where
+        publish is an HTTP broadcast instead of an in-process call)."""
+        self.registry = registry
+        self.model_name = model_name
+        self.min_auc = float(min_auc)
+        self.max_regression = float(max_regression)
+        self.min_fresh_rows = int(min_fresh_rows)
+        self.aot_bundle_dir = aot_bundle_dir or None
+        self._publish_fn = publish_fn
+        self._rollback_fn = rollback_fn
+        self.best_auc: Optional[float] = None   # best PUBLISHED AUC ever
+        self.live_auc: Optional[float] = None   # publish-time AUC of current
+        self._live_model_str: Optional[str] = None
+        self.events: List[Dict] = []
+        self.m_published = get_counter(
+            metrics_registry, "lgbm_continuous_published_total",
+            "candidate models accepted by the publish gate")
+        self.m_rejected = get_counter(
+            metrics_registry, "lgbm_continuous_rejected_total",
+            "candidate models refused by the publish gate (floor or "
+            "regression bound)")
+        self.m_rollbacks = get_counter(
+            metrics_registry, "lgbm_continuous_rollback_total",
+            "ALARM: published models withdrawn after a post-publish "
+            "regression on fresh data")
+
+    # ------------------------------------------------------------------
+    def _record(self, event: Dict) -> Dict:
+        self.events.append(event)
+        return event
+
+    def consider(self, candidate_str: str, auc: float,
+                 cycle: int = -1) -> Dict:
+        """Gate one candidate.  Returns the decision event dict
+        (``action`` = "publish" | "reject", plus ``reason`` when
+        rejected); on publish it carries the registry ``version``."""
+        if auc is None or math.isnan(auc):
+            self.m_rejected.inc()
+            log_warning(f"continuous: cycle {cycle} candidate has no "
+                        "holdout AUC — refusing to publish blind")
+            return self._record({"action": "reject", "cycle": cycle,
+                                 "auc": None, "reason": "no-holdout"})
+        if auc < self.min_auc:
+            self.m_rejected.inc()
+            log_warning(
+                f"continuous: cycle {cycle} candidate REJECTED: AUC "
+                f"{auc:.4f} below the absolute floor {self.min_auc:.4f}")
+            return self._record({"action": "reject", "cycle": cycle,
+                                 "auc": auc, "reason": "floor"})
+        if (self.best_auc is not None
+                and auc < self.best_auc - self.max_regression):
+            self.m_rejected.inc()
+            log_warning(
+                f"continuous: cycle {cycle} candidate REJECTED: AUC "
+                f"{auc:.4f} regresses more than {self.max_regression:.4f} "
+                f"from the best published {self.best_auc:.4f}")
+            return self._record({"action": "reject", "cycle": cycle,
+                                 "auc": auc, "reason": "regression"})
+        version = self._publish(candidate_str)
+        self.best_auc = auc if self.best_auc is None \
+            else max(self.best_auc, auc)
+        self.live_auc = auc
+        self._live_model_str = candidate_str
+        self.m_published.inc()
+        log_info(f"continuous: cycle {cycle} candidate PUBLISHED as "
+                 f"{self.model_name!r} v{version} (holdout AUC {auc:.4f})")
+        return self._record({"action": "publish", "cycle": cycle,
+                             "auc": auc, "version": version})
+
+    def _publish(self, candidate_str: str) -> int:
+        if self._publish_fn is not None:
+            return self._publish_fn(candidate_str, self.aot_bundle_dir)
+        return self.registry.publish(self.model_name,
+                                     model_str=candidate_str,
+                                     aot_bundle_dir=self.aot_bundle_dir)
+
+    # ------------------------------------------------------------------
+    def watch(self, X: np.ndarray, y: np.ndarray) -> Optional[Dict]:
+        """Score the LIVE model on a fresh holdout window; on confirmed
+        regression roll the registry back (alarm counter + event).
+        Returns the rollback event, or None when the model held up (or
+        the window was too small / nothing is published)."""
+        if self.live_auc is None or len(y) < self.min_fresh_rows:
+            return None
+        if len(np.unique(np.asarray(y) > 0)) < 2:
+            return None                     # one-class window: AUC undefined
+        from .trainer import holdout_auc
+        # score the string this gate published (its registry 'current'):
+        # exact, transport-free, and immune to the predictor's weakref
+        # booster being collected
+        fresh = holdout_auc(self._live_model_str, np.asarray(X),
+                            np.asarray(y))
+        bound = max(self.min_auc, self.live_auc - self.max_regression)
+        if fresh >= bound:
+            return None
+        self.m_rollbacks.inc()
+        log_warning(
+            f"continuous: ALARM — live model {self.model_name!r} regressed "
+            f"on fresh data (AUC {fresh:.4f} < bound {bound:.4f}, "
+            f"published at {self.live_auc:.4f}); rolling back")
+        if self._rollback_fn is not None:
+            restored = self._rollback_fn()
+        else:
+            try:
+                restored = self.registry.rollback(self.model_name)
+            except LightGBMError as exc:
+                # the regressed model is the FIRST (and only) published
+                # version: there is nothing to restore, and unpublishing
+                # would turn a quality alarm into an outage.  Keep it
+                # serving — the alarm counter + event are the operator's
+                # signal — and reset the baseline so the next publish
+                # re-gates from scratch.
+                log_warning(
+                    f"continuous: cannot roll back {self.model_name!r} "
+                    f"({exc}); keeping the current version serving")
+                restored = None
+        self.live_auc = None        # unknown until the next publish
+        self._live_model_str = None
+        return self._record({"action": "rollback", "auc": fresh,
+                             "bound": bound, "restored_version": restored})

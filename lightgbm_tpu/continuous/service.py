@@ -1,0 +1,166 @@
+"""ContinuousService: the supervised tail → train → gate → publish loop.
+
+One ``step()`` is the whole closed loop the ROADMAP asks for:
+
+1. **tail** — poll the append-only source; per-record validation
+   quarantines bad rows (a poisoned segment costs its rows, not the
+   service).
+2. **watch** — BEFORE training on the fresh rows, score the live model on
+   their holdout slice; a post-publish regression rolls the registry back
+   to the previous version (alarm counter) and reverts the trainer's base
+   so the next cycle boosts from what is actually serving.
+3. **train** — one continuation cycle (engine resume + ``init_model``
+   refit) over everything ingested so far.  A trainer death mid-cycle is
+   caught here and retried with bounded exponential backoff; the retry
+   re-enters the SAME cycle and resumes from its newest verifiable
+   checkpoint, so the finished cycle is bit-identical to an uninterrupted
+   one and a corrupt checkpoint only costs the iterations since the one
+   before it.
+4. **gate** — publish the candidate only past the absolute floor +
+   relative regression bound; rejected candidates leave the registry and
+   the trainer's base untouched.
+
+The serving side never sees any of this machinery fail: the registry
+always holds the last gated-good model, and every failure mode above
+degrades to "keep serving it".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..log import LightGBMError, log_info, log_warning
+from ..telemetry import get_counter
+from .gate import PublishGate
+from .tail import DataTail
+from .trainer import ContinuousTrainer
+
+__all__ = ["ContinuousService"]
+
+
+class ContinuousService:
+    def __init__(self, tail: DataTail, trainer: ContinuousTrainer,
+                 gate: PublishGate,
+                 poll_s: float = 1.0,
+                 max_cycle_retries: int = 2,
+                 retry_backoff_s: float = 0.2,
+                 metrics_registry=None):
+        self.tail = tail
+        self.trainer = trainer
+        self.gate = gate
+        self.poll_s = float(poll_s)
+        self.max_cycle_retries = int(max_cycle_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.m_cycles = get_counter(
+            metrics_registry, "lgbm_continuous_cycles_total",
+            "training cycles completed (published or rejected)")
+        self.m_cycle_failures = get_counter(
+            metrics_registry, "lgbm_continuous_cycle_failures_total",
+            "training-cycle attempts that died and were retried from "
+            "the cycle's checkpoints")
+        self.events: List[Dict] = []
+
+    # ------------------------------------------------------------------
+    def step(self) -> Dict:
+        """One poll → watch → train → gate pass.  Returns a summary dict
+        (``new_rows``, ``trained``, ``decision``, ``rollback``)."""
+        batches = self.tail.poll()
+        new_rows = int(sum(len(b.y) for b in batches))
+        summary: Dict = {"new_rows": new_rows, "trained": False,
+                         "decision": None, "rollback": None}
+        if not batches:
+            return summary
+        fresh_hX, fresh_hy = [], []
+        for b in batches:
+            hx, hy = self.trainer.ingest(b.X, b.y)
+            if len(hy):
+                fresh_hX.append(hx)
+                fresh_hy.append(hy)
+        # drift watch FIRST: if the live model already regresses on the
+        # fresh window, roll back before training bakes the drift into a
+        # new candidate's comparison base
+        if fresh_hy:
+            import numpy as np
+            rb = self.gate.watch(np.concatenate(fresh_hX),
+                                 np.concatenate(fresh_hy))
+            if rb is not None:
+                summary["rollback"] = rb
+                self.trainer.revert()
+        if self.trainer.num_train_rows == 0:
+            return summary
+        result = self._train_cycle_supervised()
+        summary["trained"] = True
+        summary["resumed_from"] = result["resumed_from"]
+        decision = self.gate.consider(result["candidate_str"],
+                                      result["auc"], cycle=result["cycle"])
+        if decision["action"] == "publish":
+            self.trainer.commit(result["candidate_str"])
+        else:
+            self.trainer.discard()
+        self.m_cycles.inc()
+        summary["decision"] = decision
+        self.events.append(summary)
+        return summary
+
+    def _train_cycle_supervised(self) -> Dict:
+        """Run one cycle, retrying a crashed attempt from its checkpoints
+        with bounded exponential backoff — the in-process analog of
+        cluster.py's supervised restart (same budget semantics)."""
+        delay = self.retry_backoff_s
+        for attempt in range(self.max_cycle_retries + 1):
+            try:
+                return self.trainer.train_cycle()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as exc:
+                self.m_cycle_failures.inc()
+                if attempt == self.max_cycle_retries:
+                    raise LightGBMError(
+                        f"continuous: cycle {self.trainer.cycle} failed "
+                        f"{attempt + 1} times (last: {exc}); giving up — "
+                        "the registry keeps serving the last gated "
+                        "model") from exc
+                log_warning(
+                    f"continuous: cycle {self.trainer.cycle} attempt "
+                    f"{attempt + 1} died ({type(exc).__name__}: {exc}); "
+                    f"resuming from its checkpoints in {delay:.2f}s")
+                if delay > 0:
+                    time.sleep(delay)
+                delay *= 2
+
+    # ------------------------------------------------------------------
+    def run(self, max_cycles: Optional[int] = None,
+            max_idle_polls: Optional[int] = None,
+            stop=None) -> Dict:
+        """Poll until ``stop`` is set (threading.Event), ``max_cycles``
+        training cycles have completed, or ``max_idle_polls`` consecutive
+        polls saw no new segments (None = poll forever).  Returns a final
+        stats dict."""
+        cycles = 0
+        idle = 0
+        while True:
+            if stop is not None and stop.is_set():
+                break
+            summary = self.step()
+            if summary["trained"]:
+                cycles += 1
+                idle = 0
+            else:
+                idle += 1
+                if max_idle_polls is not None and idle >= max_idle_polls:
+                    break
+                if self.poll_s > 0:
+                    time.sleep(self.poll_s)
+            if max_cycles is not None and cycles >= max_cycles:
+                break
+        stats = {"cycles": cycles,
+                 "published": len([e for e in self.gate.events
+                                   if e["action"] == "publish"]),
+                 "rejected": len([e for e in self.gate.events
+                                  if e["action"] == "reject"]),
+                 "rollbacks": len([e for e in self.gate.events
+                                   if e["action"] == "rollback"]),
+                 "resumes": len(self.trainer.resume_events)}
+        log_info(f"continuous: service loop exiting: {stats}")
+        return stats

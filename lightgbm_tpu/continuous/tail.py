@@ -1,0 +1,193 @@
+"""DataTail: validated ingest from an append-only segment directory.
+
+The continuous trainer's data source is a directory that producers only
+ever ADD files to (the classic log-shipping contract: write the segment
+under a temp name, rename it in).  ``DataTail.poll()`` discovers new
+segments through the io/file_io scheme registry — so the source can live
+on any registered backend, including the ``chaosio://`` fault injector —
+and parses them with PER-RECORD validation:
+
+- **width**: every row must carry exactly ``1 + num_features`` fields
+  (label first, the CLI convention); the first clean segment pins the
+  width when the caller didn't.
+- **parse**: non-numeric fields quarantine the row, never raise.
+- **NaN/Inf**: non-finite features quarantine the row by default
+  (``allow_nan_features=True`` admits NaN as LightGBM missing values;
+  Inf always quarantines — no real feature pipeline emits it on
+  purpose).
+- **label**: non-finite labels always quarantine; ``label_kind="binary"``
+  additionally requires 0/1.
+
+Bad rows land in a quarantine JSONL (one ``{"segment", "row", "reason",
+"raw"}`` line each, append-mode so restarts keep history) and bump
+``lgbm_continuous_quarantined_total`` — a poisoned segment costs its bad
+rows, never the trainer.  An unreadable segment is logged and retried on
+the next poll; transient backend errors are already retried inside
+file_io.
+
+The tail itself is deliberately stateless on disk: a restarted service
+re-polls every segment from the top and rebuilds the same cumulative
+dataset (segment order is name order, validation is deterministic), which
+is the same replay-from-the-log recovery model the rest of the subsystem
+uses.  ``mark_seen()`` exists for callers that checkpoint their own
+ingest position.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List, NamedTuple, Optional, Set
+
+import numpy as np
+
+from ..io import file_io
+from ..log import log_info, log_warning
+from ..telemetry import get_counter
+
+__all__ = ["DataTail", "SegmentBatch"]
+
+
+class SegmentBatch(NamedTuple):
+    """One validated segment: clean rows only."""
+    name: str
+    X: np.ndarray            # [n, num_features] float64
+    y: np.ndarray            # [n] float64
+    quarantined: int
+
+
+class DataTail:
+    def __init__(self, source: str,
+                 num_features: Optional[int] = None,
+                 quarantine_path: Optional[str] = None,
+                 registry=None,
+                 label_kind: str = "binary",
+                 allow_nan_features: bool = False,
+                 sep: str = ","):
+        self.source = source.rstrip("/")
+        self.num_features = num_features
+        self.quarantine_path = quarantine_path
+        self.label_kind = label_kind
+        self.allow_nan_features = bool(allow_nan_features)
+        self.sep = sep
+        self._seen: Set[str] = set()
+        self.m_segments = get_counter(
+            registry, "lgbm_continuous_segments_total",
+            "segments ingested by the data tail")
+        self.m_rows = get_counter(
+            registry, "lgbm_continuous_rows_total",
+            "validated rows ingested by the data tail")
+        self.m_quarantined = get_counter(
+            registry, "lgbm_continuous_quarantined_total",
+            "rows rejected by per-record validation and quarantined")
+        self.m_segment_errors = get_counter(
+            registry, "lgbm_continuous_segment_errors_total",
+            "segments that could not be read (left for the next poll)")
+
+    # ------------------------------------------------------------------
+    def mark_seen(self, names) -> None:
+        """Skip ``names`` on future polls (callers that persist their own
+        ingest position replay it here after a restart)."""
+        self._seen.update(names)
+
+    def _discover(self) -> List[str]:
+        try:
+            names = file_io.listdir(self.source)
+        except OSError as exc:
+            # a missing/flaky source directory is the producer's problem,
+            # not a trainer crash; the next poll retries
+            log_warning(f"continuous: cannot list {self.source}: {exc}")
+            return []
+        fresh = [n for n in sorted(names)
+                 if n not in self._seen
+                 and not n.startswith((".", "_"))
+                 and not n.endswith(".tmp")]
+        return fresh
+
+    # ------------------------------------------------------------------
+    def _validate_line(self, fields: List[str]):
+        """(features, label) for a clean row, or (None, reason)."""
+        width = self.num_features
+        if width is not None and len(fields) != width + 1:
+            return None, (f"width: expected {width + 1} fields "
+                          f"(label + {width} features), got {len(fields)}")
+        try:
+            vals = [float(f) for f in fields]
+        except ValueError as exc:
+            return None, f"parse: {exc}"
+        label, feats = vals[0], vals[1:]
+        if not math.isfinite(label):
+            return None, f"label: non-finite ({label!r})"
+        if self.label_kind == "binary" and label not in (0.0, 1.0):
+            return None, f"label: {label!r} not in {{0, 1}}"
+        for j, v in enumerate(feats):
+            if math.isinf(v):
+                return None, f"feature {j}: Inf"
+            if math.isnan(v) and not self.allow_nan_features:
+                return None, f"feature {j}: NaN"
+        return (feats, label), ""
+
+    def _read_segment(self, name: str) -> Optional[SegmentBatch]:
+        path = f"{self.source}/{name}"
+        try:
+            text = file_io.read_text(path)
+        except OSError as exc:
+            self.m_segment_errors.inc()
+            log_warning(f"continuous: cannot read segment {path}: {exc} — "
+                        "will retry next poll")
+            return None
+        rows, labels, quarantined = [], [], []
+        for i, line in enumerate(text.splitlines()):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parsed, reason = self._validate_line(line.split(self.sep))
+            if parsed is None:
+                quarantined.append({"segment": name, "row": i,
+                                    "reason": reason, "raw": line[:500]})
+                continue
+            feats, label = parsed
+            if self.num_features is None:
+                # first clean row pins the expected width for every
+                # subsequent row and segment
+                self.num_features = len(feats)
+            rows.append(feats)
+            labels.append(label)
+        if quarantined:
+            self._quarantine(quarantined)
+        X = (np.asarray(rows, np.float64) if rows
+             else np.empty((0, self.num_features or 0), np.float64))
+        return SegmentBatch(name, X, np.asarray(labels, np.float64),
+                            len(quarantined))
+
+    def _quarantine(self, records: List[dict]) -> None:
+        self.m_quarantined.inc(len(records))
+        if not self.quarantine_path:
+            return
+        try:
+            with file_io.open_writable(self.quarantine_path,
+                                       append=True) as fh:
+                for rec in records:
+                    fh.write(json.dumps(rec) + "\n")
+        except OSError as exc:
+            # the quarantine file is evidence, not a dependency
+            log_warning(f"continuous: could not write quarantine file "
+                        f"{self.quarantine_path}: {exc}")
+
+    # ------------------------------------------------------------------
+    def poll(self) -> List[SegmentBatch]:
+        """Validated batches for every NEW segment (name order); a
+        segment is consumed exactly once per tail instance."""
+        out: List[SegmentBatch] = []
+        for name in self._discover():
+            batch = self._read_segment(name)
+            if batch is None:
+                continue                    # unreadable: retry next poll
+            self._seen.add(name)
+            self.m_segments.inc()
+            self.m_rows.inc(len(batch.y))
+            log_info(f"continuous: ingested segment {name}: "
+                     f"{len(batch.y)} rows ({batch.quarantined} "
+                     "quarantined)")
+            out.append(batch)
+        return out

@@ -1,0 +1,243 @@
+"""ContinuousTrainer: continued boosting over an accumulating dataset.
+
+Each **cycle** continues the last accepted model with ``continuous_rounds``
+fresh boosting rounds over everything ingested so far, using BOTH
+continuation paths the engine offers:
+
+- **across cycles** — ``init_model``: the previous accepted model's raw
+  predictions become the new run's init score (the reference's continued
+  -training semantics, engine.py), so the new rounds boost the residual.
+  The accepted serving artifact is the STITCHED model — previous trees +
+  the cycle's delta trees in one model string (``combine_model_strings``)
+  — because an init-score-trained booster holds only its own trees and
+  raw totals are ``init raw + delta raw``.
+- **within a cycle** — checkpoint resume: every cycle trains under its
+  own ``checkpoint_dir`` with ``resume=auto``, so a trainer death
+  mid-cycle restarts from the newest VERIFIABLE checkpoint (corrupt ones
+  are skipped by the manager) and finishes the cycle BIT-IDENTICAL to an
+  uninterrupted run — the engine's existing resume guarantee, inherited
+  wholesale.
+
+Rows are split train/holdout deterministically by global ingest index
+(hash-free modulo walk), so a replayed ingest after a service restart
+reproduces the same split and the gate's AUC series stays comparable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..log import LightGBMError, log_info
+from ..metrics import AUCMetric
+
+__all__ = ["ContinuousTrainer", "combine_model_strings", "holdout_auc",
+           "checkpoint_prefix_matches"]
+
+_TREE_HEAD = re.compile(r"(?m)^Tree=\d+$")
+
+
+def combine_model_strings(base: str, delta: str) -> str:
+    """Stitch a continued-training delta onto its base model: one model
+    string whose raw prediction equals ``base raw + delta raw``.
+
+    Pure text surgery on the reference model format (header, ``Tree=i``
+    blocks, ``end of trees``): the delta's tree blocks are renumbered and
+    spliced before the base's ``end of trees`` marker, so the base's tree
+    bytes are preserved EXACTLY — no parse/re-render float drift on trees
+    that already served traffic."""
+    marker = "end of trees"
+    cut = base.find(marker)
+    if cut < 0:
+        raise LightGBMError("combine_model_strings: base model string has "
+                            "no 'end of trees' marker")
+    n_base = len(_TREE_HEAD.findall(base[:cut]))
+    d_start = delta.find("Tree=")
+    d_end = delta.find(marker)
+    if d_start < 0 or d_end < 0 or d_end < d_start:
+        raise LightGBMError("combine_model_strings: delta model string is "
+                            "not a valid model dump")
+    body = delta[d_start:d_end]
+    counter = [n_base - 1]
+
+    def _renumber(_m):
+        counter[0] += 1
+        return f"Tree={counter[0]}"
+    body = _TREE_HEAD.sub(_renumber, body)
+    return base[:cut] + body + base[cut:]
+
+
+def holdout_auc(model, X: np.ndarray, y: np.ndarray) -> float:
+    """Held-out AUC of ``model`` (Booster or model string): the gate's
+    single quality number.  Raw scores — AUC is rank-based, so skipping
+    the sigmoid changes nothing and works for any monotonic link."""
+    from ..basic import Booster
+    if isinstance(model, str):
+        model = Booster(model_str=model)
+    raw = np.asarray(model.predict(X, raw_score=True), np.float64).ravel()
+    return float(AUCMetric(None).eval(raw, y, None, None)[0][1])
+
+
+def checkpoint_prefix_matches(state, booster) -> bool:
+    """True when ``booster``'s first ``len(state.trees)`` trees are
+    BIT-IDENTICAL (model-text equality over exactly-pickled trees) to the
+    checkpoint's — the resumed-run-continues-the-checkpoint proof the
+    chaos soak asserts after a mid-cycle kill."""
+    live = booster._gbdt.models if booster._gbdt is not None \
+        else booster._loaded_trees
+    if len(live) < len(state.trees):
+        return False
+    return all(a.to_string(i) == b.to_string(i)
+               for i, (a, b) in enumerate(zip(state.trees, live)))
+
+
+class ContinuousTrainer:
+    """Accumulates validated rows and continues boosting cycle by cycle.
+
+    The trainer only ADVANCES its committed model when the caller says so
+    (``commit``): a candidate the publish gate rejects leaves the model
+    reference — and therefore the next cycle's init scores — at the last
+    ACCEPTED model, so one bad segment cannot become the permanent base
+    of everything trained after it."""
+
+    def __init__(self, params: Dict, workdir: str,
+                 rounds_per_cycle: int = 20,
+                 holdout_fraction: float = 0.2,
+                 checkpoint_freq: int = 1,
+                 keep_checkpoints: int = 3):
+        if not 0.0 < holdout_fraction < 1.0:
+            raise LightGBMError("holdout_fraction must be in (0, 1), got "
+                                f"{holdout_fraction}")
+        from ..config import resolve_aliases
+        self.params = resolve_aliases(dict(params))
+        # strip service-level and per-run knobs: rounds_per_cycle is the
+        # cycle length (a leaked num_iterations would override it inside
+        # engine.train) and each cycle owns its checkpoint namespace
+        for key in list(self.params):
+            if (key.startswith(("continuous_", "serving_", "fleet_"))
+                    or key in ("task", "num_iterations", "config", "data",
+                               "valid", "input_model", "output_model",
+                               "checkpoint_dir", "checkpoint_freq",
+                               "keep_checkpoints", "resume")):
+                self.params.pop(key)
+        self.params.setdefault("objective", "binary")
+        self.workdir = workdir.rstrip("/")
+        self.rounds = int(rounds_per_cycle)
+        self.holdout_every = max(int(round(1.0 / holdout_fraction)), 2)
+        self.checkpoint_freq = int(checkpoint_freq)
+        self.keep_checkpoints = int(keep_checkpoints)
+        self.cycle = 0
+        self.model_str: Optional[str] = None      # last ACCEPTED model
+        self._prev_model_str: Optional[str] = None
+        self._train_X: List[np.ndarray] = []
+        self._train_y: List[np.ndarray] = []
+        self._hold_X: List[np.ndarray] = []
+        self._hold_y: List[np.ndarray] = []
+        self._ingested = 0
+        self.resume_events: List[Dict] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def num_train_rows(self) -> int:
+        return sum(len(y) for y in self._train_y)
+
+    def ingest(self, X: np.ndarray, y: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Add validated rows to the cumulative pool; returns the rows'
+        HOLDOUT slice (the fresh window the gate's drift watch scores the
+        live model on)."""
+        idx = np.arange(self._ingested, self._ingested + len(y))
+        self._ingested += len(y)
+        hold = (idx % self.holdout_every) == 0
+        if (~hold).any():
+            self._train_X.append(np.asarray(X[~hold], np.float64))
+            self._train_y.append(np.asarray(y[~hold], np.float64))
+        if hold.any():
+            self._hold_X.append(np.asarray(X[hold], np.float64))
+            self._hold_y.append(np.asarray(y[hold], np.float64))
+        return X[hold], y[hold]
+
+    def holdout(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._hold_y:
+            return (np.empty((0, 0)), np.empty((0,)))
+        return (np.concatenate(self._hold_X), np.concatenate(self._hold_y))
+
+    # ------------------------------------------------------------------
+    def _cycle_dir(self, cycle: int) -> str:
+        return f"{self.workdir}/cycles/cycle_{cycle:05d}"
+
+    def train_cycle(self, callbacks: Optional[List] = None) -> Dict:
+        """Run one continuation cycle; returns a result dict with the
+        candidate (NOT yet committed):
+
+        ``delta_booster`` (this cycle's new trees), ``candidate_str``
+        (stitched serving artifact), ``auc`` (cumulative-holdout AUC of
+        the candidate), ``resumed_from`` (checkpoint iteration a restart
+        picked up at, 0 for a fresh cycle; mirrored into
+        ``resume_events`` as ``{"cycle", "iteration"}``), ``cycle_dir``.
+
+        Raises whatever training raises — supervision (restart budget,
+        backoff) is the service's job; re-entering with the same cycle
+        counter resumes from the cycle's checkpoints."""
+        import lightgbm_tpu as lgb
+        from ..checkpoint import CheckpointManager
+        if self.num_train_rows == 0:
+            raise LightGBMError("train_cycle with no ingested rows")
+        cycle_dir = self._cycle_dir(self.cycle)
+        # resume probe BEFORE training so the event is recorded even if
+        # the engine's own resume log is drowned out; load_latest walks
+        # past corrupt files in ONE verified read — exactly what the
+        # engine's restore will do
+        mgr = CheckpointManager(cycle_dir, keep=self.keep_checkpoints)
+        probe = mgr.load_latest()
+        resumed_from = 0
+        if probe is not None:
+            resumed_from = probe.iteration
+            self.resume_events.append({"cycle": self.cycle,
+                                       "iteration": resumed_from})
+            log_info(f"continuous: cycle {self.cycle} resuming from "
+                     f"iteration {resumed_from}")
+        X = np.concatenate(self._train_X)
+        y = np.concatenate(self._train_y)
+        init = None
+        if self.model_str is not None:
+            from ..basic import Booster
+            init = Booster(model_str=self.model_str)
+        ds = lgb.Dataset(X, y, free_raw_data=False)
+        booster = lgb.train(
+            self.params, ds, num_boost_round=self.rounds,
+            init_model=init, callbacks=list(callbacks or []),
+            checkpoint_dir=cycle_dir, checkpoint_freq=self.checkpoint_freq,
+            keep_checkpoints=self.keep_checkpoints, resume="auto")
+        delta_str = booster.model_to_string()
+        candidate = (delta_str if self.model_str is None
+                     else combine_model_strings(self.model_str, delta_str))
+        hx, hy = self.holdout()
+        auc = holdout_auc(candidate, hx, hy) if len(hy) else float("nan")
+        return {"cycle": self.cycle, "delta_booster": booster,
+                "candidate_str": candidate, "auc": auc,
+                "resumed_from": resumed_from, "cycle_dir": cycle_dir,
+                "train_rows": len(y)}
+
+    def commit(self, candidate_str: str) -> None:
+        """Advance the committed model (the gate accepted the candidate)
+        and move on to the next cycle's checkpoint namespace."""
+        self._prev_model_str = self.model_str
+        self.model_str = candidate_str
+        self.cycle += 1
+
+    def revert(self) -> None:
+        """Post-publish rollback: the gate withdrew the last committed
+        model, so future cycles must boost from the model that is
+        actually serving again — not the withdrawn one."""
+        self.model_str = self._prev_model_str
+
+    def discard(self) -> None:
+        """Gate rejected the candidate: keep the committed model, burn
+        the cycle number (its checkpoints describe the rejected run and
+        must not be resumed into the next attempt, which will see
+        different data)."""
+        self.cycle += 1

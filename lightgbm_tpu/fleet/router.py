@@ -245,6 +245,10 @@ class FleetRouter:
         self._m_errors = reg.counter(
             "lgbm_fleet_errors_total",
             "requests that failed on every routable replica")
+        self._m_publish_partial = reg.counter(
+            "lgbm_fleet_publish_partial_total",
+            "publish broadcasts that landed on only a subset of replicas "
+            "and were rolled back to keep the fleet single-version")
         self._m_latency = reg.histogram(
             "lgbm_fleet_request_latency_seconds",
             "router-side end-to-end predict latency")
@@ -519,7 +523,10 @@ class FleetRouter:
         IN PARALLEL — a publish pays model load + bundle deserialize +
         warmup per replica, and a fleet-wide hot-swap should cost one
         replica's worth of wall clock, not N.  Succeeds if every
-        REACHABLE replica succeeded."""
+        REACHABLE replica succeeded.  A PARTIAL publish (some 200s, some
+        refusals) rolls the successes back — the fleet must never
+        silently serve mixed versions — and bumps
+        ``lgbm_fleet_publish_partial_total``."""
         def _one(rep):
             try:
                 status, payload = rep.endpoint.request(
@@ -563,6 +570,57 @@ class FleetRouter:
         reachable = [r for r in results.values() if r["status"] != 0]
         all_ok = bool(reachable) and all(r["status"] == 200
                                          for r in reachable)
+        if verb == "publish" and not all_ok and ok > 0:
+            # PARTIAL publish: some replicas installed the new version,
+            # others refused (or their outcome is unknown).  Leaving it be
+            # would silently serve MIXED versions behind one front door —
+            # the worst failure mode, because every response looks
+            # healthy.  Roll the confirmed successes back so the fleet
+            # converges on the old version; replicas with UNKNOWN
+            # outcomes (status -1 timeouts) are deliberately NOT rolled
+            # back — a rollback on a replica whose publish never landed
+            # would withdraw its previous GOOD version instead.
+            self._m_publish_partial.inc()
+            base_path = path[:path.rfind(":")]
+            to_undo = [rep for rep in self._replicas
+                       if results[rep.endpoint.name]["status"] == 200]
+            log_warning(
+                f"fleet: partial publish of {name!r} ({ok}/"
+                f"{len(self._replicas)} replicas) — rolling back the "
+                f"{len(to_undo)} that succeeded")
+
+            def _undo(rep):
+                # a replica whose FIRST version of this model just
+                # landed (publish returned version 1) has no previous to
+                # roll back to — its undo is :unpublish, restoring the
+                # nothing-published state the refusing replicas are in
+                first = results[rep.endpoint.name].get("version") == 1
+                undo_path = base_path + (":unpublish" if first
+                                         else ":rollback")
+                try:
+                    status, _ = rep.endpoint.request(
+                        "POST", undo_path, None,
+                        timeout_s=self.request_timeout_s)
+                    return status
+                except ReplicaTransportError as exc:
+                    log_warning(f"fleet: rollback of partial publish on "
+                                f"{rep.endpoint.name} failed: {exc}")
+                    return 0
+            undo_futs = [self._bcast_pool.submit(_undo, rep)
+                         for rep in to_undo]
+            for rep, fut in zip(to_undo, undo_futs):
+                try:
+                    status = fut.result(self.request_timeout_s + 5.0)
+                except Exception:
+                    status = 0
+                results[rep.endpoint.name]["rolled_back"] = status == 200
+                if status != 200:
+                    # still mixed: say so loudly — the operator's signal
+                    # is the partial counter plus this per-replica flag
+                    log_warning(
+                        f"fleet: replica {rep.endpoint.name} may still "
+                        f"serve the withdrawn version of {name!r} "
+                        f"(rollback status {status})")
         if all_ok:
             # maintain the rejoin-replay cache: a fleet-wide publish is
             # remembered (replayed to replicas that restart with their

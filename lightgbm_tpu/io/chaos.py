@@ -1,0 +1,212 @@
+"""``chaosio://`` — a fault-injecting file_io scheme for robustness tests.
+
+The continuous-boosting service claims it survives torn writes, flaky
+backends, and silently corrupted bytes.  Claims about failure handling
+are only as good as the failures actually exercised, so this scheme wraps
+the local filesystem with deterministic, test-armable faults:
+
+- **transient errors** (``fail_reads``/``fail_writes``): the next N ops
+  on that side raise ``TransientIOError`` — the retryable class file_io
+  backs off on.  Proves retry-with-backoff end to end: an op that fails
+  twice and then succeeds must lose no data.
+- **torn writes** (``tear_next_write``): the next writable file accepts
+  only the first N bytes, then raises mid-write — the crash-mid-write
+  model.  Against the atomic tmp+rename writers this must leave no
+  ``.tmp`` file and no manifest entry.
+- **bit flips** (``flip_next_reads``): the next N file reads return the
+  real bytes with ONE bit inverted — silent media corruption.  Nothing
+  retries this (nothing fails); only checksums can catch it, which is
+  exactly what the checkpoint/bundle sha256 verification is for.
+- **latency** (``latency_s``): every op sleeps first; soak tests use it
+  to widen race windows.
+
+Usage::
+
+    chaos = register_chaos_scheme()          # registers "chaosio"
+    mgr = CheckpointManager("chaosio:///tmp/ckpts")
+    chaos.fail_writes(2)                     # next two write ops bounce
+    mgr.save(state)                          # succeeds via retry
+
+Paths map 1:1 onto the local filesystem: ``chaosio:///tmp/x`` is
+``/tmp/x`` with faults applied.  All state is per-``ChaosScheme``
+instance and thread-safe; counters record every injection so tests can
+assert the fault actually fired (a chaos test whose fault never fired
+passes vacuously).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from .file_io import TransientIOError, register_scheme
+
+__all__ = ["ChaosScheme", "register_chaos_scheme"]
+
+
+class _TornWriter:
+    """File wrapper that accepts ``limit`` bytes then dies mid-write,
+    leaving a genuinely partial file behind — what a crash or full disk
+    does to a non-atomic writer."""
+
+    def __init__(self, fh, limit: int, scheme: "ChaosScheme"):
+        self._fh = fh
+        self._limit = int(limit)
+        self._written = 0
+        self._scheme = scheme
+
+    def write(self, data):
+        n = len(data)
+        if self._written + n > self._limit:
+            keep = max(self._limit - self._written, 0)
+            if keep:
+                self._fh.write(data[:keep])
+            self._fh.flush()
+            self._written = self._limit
+            self._scheme.counters["torn_writes"] += 1
+            raise OSError(
+                f"chaosio: torn write (backend died after "
+                f"{self._limit} bytes)")
+        self._fh.write(data)
+        self._written += n
+        return n
+
+    def flush(self):
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ChaosScheme:
+    """Armable fault state + the file_io op table for one scheme name."""
+
+    def __init__(self, scheme: str = "chaosio"):
+        self.scheme = scheme
+        self._lock = threading.Lock()
+        self._fail_reads = 0
+        self._fail_writes = 0
+        self._flip_reads = 0
+        self._torn_after: Optional[int] = None
+        self.latency_s = 0.0
+        self.counters: Dict[str, int] = {
+            "ops": 0, "transient_errors": 0, "bit_flips": 0,
+            "torn_writes": 0,
+        }
+
+    # -- arming -----------------------------------------------------------
+    def fail_reads(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_reads = int(n)
+
+    def fail_writes(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_writes = int(n)
+
+    def flip_next_reads(self, n: int = 1) -> None:
+        with self._lock:
+            self._flip_reads = int(n)
+
+    def tear_next_write(self, after_bytes: int) -> None:
+        with self._lock:
+            self._torn_after = int(after_bytes)
+
+    def calm(self) -> None:
+        """Disarm everything (tests' teardown)."""
+        with self._lock:
+            self._fail_reads = self._fail_writes = self._flip_reads = 0
+            self._torn_after = None
+            self.latency_s = 0.0
+
+    # -- fault application ------------------------------------------------
+    def _strip(self, path: str) -> str:
+        return path.split("://", 1)[1] if "://" in path else path
+
+    def _enter(self, side: str) -> None:
+        """Latency + armed transient failure for one op on ``side``
+        ('read' or 'write')."""
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+        with self._lock:
+            self.counters["ops"] += 1
+            armed = "_fail_reads" if side == "read" else "_fail_writes"
+            left = getattr(self, armed)
+            if left > 0:
+                setattr(self, armed, left - 1)
+                self.counters["transient_errors"] += 1
+                raise TransientIOError(
+                    f"chaosio: injected transient {side} error "
+                    f"({left - 1} more armed)")
+
+    def _open(self, path: str, mode: str):
+        local = self._strip(path)
+        writing = any(c in mode for c in "wa+")
+        self._enter("write" if writing else "read")
+        if writing:
+            with self._lock:
+                torn, self._torn_after = self._torn_after, None
+            fh = open(local, mode)
+            if torn is not None:
+                return _TornWriter(fh, torn, self)
+            return fh
+        with self._lock:
+            flip = self._flip_reads > 0
+            if flip:
+                self._flip_reads -= 1
+        if not flip:
+            return open(local, mode)
+        data = open(local, "rb").read()
+        if data:
+            # deterministic single-bit flip in the middle byte: large
+            # enough files land it inside the payload, and one bit is the
+            # hardest corruption to notice without a checksum
+            mid = len(data) // 2
+            data = data[:mid] + bytes([data[mid] ^ 0x01]) + data[mid + 1:]
+        self.counters["bit_flips"] += 1
+        if "b" in mode:
+            return io.BytesIO(data)
+        return io.StringIO(data.decode(errors="replace"))
+
+    # -- op table ---------------------------------------------------------
+    def _rename(self, src: str, dst: str) -> None:
+        self._enter("write")
+        os.replace(self._strip(src), self._strip(dst))
+
+    def _remove(self, path: str) -> None:
+        self._enter("write")
+        os.remove(self._strip(path))
+
+    def _listdir(self, path: str):
+        self._enter("read")
+        return os.listdir(self._strip(path))
+
+    def _makedirs(self, path: str) -> None:
+        self._enter("write")
+        os.makedirs(self._strip(path), exist_ok=True)
+
+    def _exists(self, path: str) -> bool:
+        self._enter("read")
+        return os.path.exists(self._strip(path))
+
+    def register(self) -> "ChaosScheme":
+        register_scheme(self.scheme, self._open, rename=self._rename,
+                        remove=self._remove, listdir=self._listdir,
+                        makedirs=self._makedirs, exists=self._exists)
+        return self
+
+
+def register_chaos_scheme(scheme: str = "chaosio") -> ChaosScheme:
+    """Register a fresh (calm) chaos scheme and return its handle.
+    Re-registering the same name replaces the previous instance's faults
+    — each test starts from a clean slate."""
+    return ChaosScheme(scheme).register()

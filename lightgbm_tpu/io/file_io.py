@@ -12,6 +12,14 @@ Beyond open, the registry carries the directory-level operations the
 checkpoint subsystem needs for atomic tmp+rename writes and keep-last-N
 retention (``rename``/``remove``/``listdir``/``makedirs``): a registered
 scheme supplies whichever it supports and callers get a uniform surface.
+
+Transient-failure policy: a backend may raise ``TransientIOError`` (a
+remote store's 5xx/timeout, the ``chaosio://`` fault injector) to mean
+"retry me".  Every public op here retries those with exponential backoff
+(``configure_retries``); any other OSError propagates unchanged — a
+missing file or permission error is not transient and retrying it only
+hides bugs.  ``read_bytes``/``read_text`` retry the WHOLE open+read, so a
+connection dying mid-read is retried too, not just a failed open.
 """
 
 from __future__ import annotations
@@ -19,10 +27,52 @@ from __future__ import annotations
 import gzip
 import io
 import os
+import time
 from typing import Callable, Dict, Optional
 
 __all__ = ["open_readable", "open_writable", "register_scheme", "exists",
-           "rename", "remove", "listdir", "makedirs"]
+           "rename", "remove", "listdir", "makedirs",
+           "TransientIOError", "configure_retries", "with_retry",
+           "read_bytes", "read_text"]
+
+
+class TransientIOError(OSError):
+    """A retryable backend failure (remote-store timeout, injected chaos).
+
+    Schemes raise this — never a bare OSError — for errors where the same
+    call is expected to succeed shortly; file_io's public ops retry it
+    with backoff before letting it escape to callers."""
+
+
+_RETRY = {"attempts": 3, "backoff_s": 0.05}
+
+
+def configure_retries(attempts: int = 3, backoff_s: float = 0.05):
+    """Set the transient-IO retry policy; returns the previous
+    ``(attempts, backoff_s)`` so tests can restore it."""
+    prev = (_RETRY["attempts"], _RETRY["backoff_s"])
+    _RETRY["attempts"] = max(int(attempts), 1)
+    _RETRY["backoff_s"] = max(float(backoff_s), 0.0)
+    return prev
+
+
+def with_retry(fn: Callable, *args, **kwargs):
+    """Run ``fn`` retrying TransientIOError with exponential backoff.
+
+    Public so multi-step composites (an atomic tmp-write+rename, a whole
+    checkpoint read) can retry the COMPOSITE: re-running a half-done
+    atomic write is safe by construction, and that is the granularity a
+    transient backend error actually invalidates."""
+    delay = _RETRY["backoff_s"]
+    for attempt in range(_RETRY["attempts"]):
+        try:
+            return fn(*args, **kwargs)
+        except TransientIOError:
+            if attempt == _RETRY["attempts"] - 1:
+                raise
+            if delay > 0:
+                time.sleep(delay)
+            delay *= 2
 
 # scheme -> {"open": fn(path, mode), "rename": fn(src, dst), ...}
 _SCHEMES: Dict[str, Dict[str, Callable]] = {}
@@ -81,11 +131,32 @@ def _open(path: str, mode: str):
 
 
 def open_readable(path: str, binary: bool = False):
-    return _open(path, "rb" if binary else "r")
+    return with_retry(_open, path, "rb" if binary else "r")
 
 
-def open_writable(path: str, binary: bool = False):
-    return _open(path, "wb" if binary else "w")
+def open_writable(path: str, binary: bool = False,
+                  append: bool = False):
+    """Writable handle; ``append=True`` opens in append mode (the
+    quarantine log's contract — records survive across opens)."""
+    mode = ("a" if append else "w") + ("b" if binary else "")
+    return with_retry(_open, path, mode)
+
+
+def read_bytes(path: str) -> bytes:
+    """Whole-file binary read, retried as ONE unit on transient errors
+    (a connection dying mid-read re-reads from the start — callers get
+    complete bytes or an exception, never a silent prefix)."""
+    def _do():
+        with _open(path, "rb") as fh:
+            return fh.read()
+    return with_retry(_do)
+
+
+def read_text(path: str) -> str:
+    def _do():
+        with _open(path, "r") as fh:
+            return fh.read()
+    return with_retry(_do)
 
 
 def exists(path: str) -> bool:
@@ -94,7 +165,7 @@ def exists(path: str) -> bool:
         return os.path.exists(rest if scheme == "file" else path)
     entry = _SCHEMES.get(scheme)
     if entry is not None and entry.get("exists") is not None:
-        return bool(entry["exists"](path))
+        return bool(with_retry(entry["exists"], path))
     try:
         with _open(path, "r"):
             return True
@@ -102,9 +173,10 @@ def exists(path: str) -> bool:
         return False
 
 
-def rename(src: str, dst: str) -> None:
-    """Atomic replace where the backend supports it (os.replace for local
-    paths) — the commit step of every checkpoint write."""
+def _rename_once(src: str, dst: str) -> None:
+    """Single rename attempt, no retry — the primitive composites like
+    an atomic tmp-write+rename build on so THEY own the (one) retry
+    layer instead of compounding budgets with the public op's."""
     scheme, rest = _split_scheme(src)
     dscheme, drest = _split_scheme(dst)
     local_src = scheme in (None, "file")
@@ -118,12 +190,18 @@ def rename(src: str, dst: str) -> None:
     _scheme_op(scheme, "rename")(src, dst)
 
 
+def rename(src: str, dst: str) -> None:
+    """Atomic replace where the backend supports it (os.replace for local
+    paths) — the commit step of every checkpoint write."""
+    with_retry(_rename_once, src, dst)
+
+
 def remove(path: str) -> None:
     scheme, rest = _split_scheme(path)
     if scheme in (None, "file"):
         os.remove(rest if scheme == "file" else path)
         return
-    _scheme_op(scheme, "remove")(path)
+    with_retry(_scheme_op(scheme, "remove"), path)
 
 
 def listdir(path: str) -> list:
@@ -131,7 +209,7 @@ def listdir(path: str) -> list:
     scheme, rest = _split_scheme(path)
     if scheme in (None, "file"):
         return os.listdir(rest if scheme == "file" else path)
-    return list(_scheme_op(scheme, "listdir")(path))
+    return list(with_retry(_scheme_op(scheme, "listdir"), path))
 
 
 def makedirs(path: str) -> None:
@@ -140,4 +218,4 @@ def makedirs(path: str) -> None:
     if scheme in (None, "file"):
         os.makedirs(rest if scheme == "file" else path, exist_ok=True)
         return
-    _scheme_op(scheme, "makedirs")(path)
+    with_retry(_scheme_op(scheme, "makedirs"), path)

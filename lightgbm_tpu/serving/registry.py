@@ -22,6 +22,7 @@ until it finishes.  The registry provides exactly that contract:
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
@@ -44,13 +45,18 @@ class _Entry:
 
 
 class _Model:
-    __slots__ = ("versions", "current", "previous", "next_version")
+    __slots__ = ("versions", "current", "previous", "next_version",
+                 "history")
 
     def __init__(self):
         self.versions: Dict[int, _Entry] = {}
         self.current: Optional[int] = None
         self.previous: Optional[int] = None
         self.next_version = 1
+        # append-only audit log of publish/rollback events: the record a
+        # gate (or an operator) uses to prove which version served when,
+        # and that a bad push really was rolled back
+        self.history: List[Dict] = []
 
 
 class ModelRegistry:
@@ -107,6 +113,9 @@ class ModelRegistry:
                 self._retire_locked(model, model.previous)
             model.previous = model.current
             model.current = version
+            model.history.append({"action": "publish", "version": version,
+                                  "previous": model.previous,
+                                  "t": time.time()})
             return version
 
     def rollback(self, name: str) -> int:
@@ -118,6 +127,10 @@ class ModelRegistry:
                 raise LightGBMError(
                     f"model {name!r} has no previous version to roll back to")
             model.current, model.previous = model.previous, model.current
+            model.history.append({"action": "rollback",
+                                  "version": model.current,
+                                  "previous": model.previous,
+                                  "t": time.time()})
             return model.current
 
     def unpublish(self, name: str) -> None:
@@ -179,6 +192,12 @@ class ModelRegistry:
     def versions(self, name: str) -> List[int]:
         with self._lock:
             return sorted(self._must_get(name).versions)
+
+    def history(self, name: str) -> List[Dict]:
+        """Publish/rollback audit log, oldest first (each entry:
+        action/version/previous/t)."""
+        with self._lock:
+            return [dict(ev) for ev in self._must_get(name).history]
 
     def models(self) -> Dict[str, Dict]:
         with self._lock:

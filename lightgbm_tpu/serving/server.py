@@ -203,6 +203,12 @@ class ServingApp:
                 if verb == "rollback":
                     version = self.registry.rollback(name)
                     return 200, {"name": name, "version": version}
+                if verb == "unpublish":
+                    # the undo for a FIRST-version publish (no previous
+                    # to roll back to) — the fleet router's partial-
+                    # publish recovery needs it; later predicts 404
+                    self.registry.unpublish(name)
+                    return 200, {"name": name, "version": None}
         return 404, {"error": f"no route for {method} {path}"}
 
     # ------------------------------------------------------------------

@@ -32,13 +32,15 @@ registry, and export are light.
 """
 
 from . import spans
-from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY)
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                       get_counter)
 from .export import (JsonlEventLog, chrome_trace, prometheus_text,
                      rollup_telemetry_dir, write_chrome_trace)
 from .spans import span, set_enabled, set_recording, set_context
 
 __all__ = ["spans", "span", "set_enabled", "set_recording", "set_context",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "get_counter",
            "prometheus_text", "chrome_trace", "write_chrome_trace",
            "JsonlEventLog", "rollup_telemetry_dir"]
 

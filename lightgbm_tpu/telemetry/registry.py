@@ -20,7 +20,7 @@ import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "DEFAULT_LATENCY_BUCKETS", "get_counter"]
 
 # seconds; spans request latencies from sub-ms device calls to stragglers
 DEFAULT_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -220,3 +220,12 @@ class MetricsRegistry:
 
 
 REGISTRY = MetricsRegistry()
+
+
+def get_counter(registry: Optional[MetricsRegistry], name: str,
+                help_text: str = "") -> Counter:
+    """Counter on ``registry``, or on the process-global ``REGISTRY``
+    when None — the default-wiring convenience components with an
+    optional ``metrics_registry`` parameter share."""
+    return (registry if registry is not None else REGISTRY).counter(
+        name, help_text)

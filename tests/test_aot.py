@@ -189,6 +189,49 @@ def test_signature_covers_sampling_params(xy, tmp_path):
     assert again._gbdt.aot_stats.get("compiled", 0) == 0
 
 
+def test_bundle_bit_flip_caught_by_sha256_then_legacy_loads(xy, tmp_path):
+    """Corruption hardening: a flipped bit in a serialized executable is
+    caught by the manifest sha256 BEFORE unpickling (training falls back
+    to recompile, with the reason logged), and legacy manifest entries
+    WITHOUT a sha256 (previous release) still load unverified."""
+    import os
+    X, y = xy
+    bundle = str(tmp_path / "bundle")
+    precompile_training(dict(BASE, fused_rounds=4), lgb.Dataset(X, y),
+                        bundle)
+    man = ProgramBundle(bundle).manifest()
+    victim = sorted(man["programs"])[0]
+    path = os.path.join(bundle, man["programs"][victim]["file"])
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0x01              # silent single-bit rot
+    open(path, "wb").write(bytes(data))
+    lines = []
+    lgb.register_log_callback(lines.append)
+    try:
+        warm = lgb.train(dict(BASE, fused_rounds=4, verbosity=0,
+                              aot_bundle_dir=bundle),
+                         lgb.Dataset(X, y), num_boost_round=10)
+    finally:
+        lgb.register_log_callback(None)
+    # the corrupt program recompiled, the intact one loaded; the bytes
+    # that failed their hash were never unpickled (reason is logged)
+    assert warm._gbdt.aot_stats.get("loaded", 0) == 1
+    assert warm._gbdt.aot_stats.get("compiled", 0) == 1
+    assert "sha256" in "".join(lines)
+    # the recompile was saved back: the bundle is healthy again
+    man = ProgramBundle(bundle).manifest()
+    assert all("sha256" in e for e in man["programs"].values())
+    # legacy entries without checksums (pre-checksum release) load fine
+    for entry in man["programs"].values():
+        entry.pop("sha256", None)
+    with open(os.path.join(bundle, "MANIFEST.json"), "w") as fh:
+        json.dump(man, fh, default=str)
+    legacy = lgb.train(dict(BASE, fused_rounds=4, aot_bundle_dir=bundle),
+                       lgb.Dataset(X, y), num_boost_round=10)
+    assert legacy._gbdt.aot_stats.get("loaded", 0) == 2
+    assert legacy._gbdt.aot_stats.get("compiled", 0) == 0
+
+
 def test_bundle_version_gate(tmp_path):
     bundle = str(tmp_path / "bundle")
     import os

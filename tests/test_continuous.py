@@ -1,0 +1,582 @@
+"""Continuous boosting service (lightgbm_tpu/continuous/).
+
+Coverage, bottom up:
+
+- ``DataTail`` per-record validation: every quarantine reason fires
+  (width, parse, NaN, Inf, non-binary label), bad rows never crash the
+  tail, segments are consumed exactly once in name order, unreadable
+  segments are retried on the next poll.
+- ``combine_model_strings``: the stitched continuation model's raw
+  prediction is exactly base + delta, with the base's tree bytes
+  preserved verbatim.
+- ``PublishGate``: absolute floor, relative regression bound, NaN
+  refusal, post-publish drift watch with registry rollback + alarm
+  counter, small-window and one-class guards.
+- the end-to-end chaos soak (the acceptance bar): trainer kill + corrupt
+  checkpoint + poisoned segment + quality-regressing segment against a
+  live in-process serving app — zero failed predict requests, only
+  gate-accepted versions ever served, bit-identical resume, rollback in
+  the registry history.
+- CLI wiring: ``task=continuous`` drains a segment directory and exits.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster
+from lightgbm_tpu.continuous import (ContinuousService, ContinuousTrainer,
+                                     DataTail, PublishGate,
+                                     combine_model_strings, holdout_auc)
+from lightgbm_tpu.io import file_io
+from lightgbm_tpu.io.chaos import register_chaos_scheme
+from lightgbm_tpu.serving.registry import ModelRegistry
+from lightgbm_tpu.serving.server import ServingApp
+from lightgbm_tpu.telemetry import MetricsRegistry
+
+NF = 5
+
+
+def _xy(n, seed, invert=False):
+    """Learnable binary data: label depends on the first three features."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, NF)
+    logit = 2.0 * X[:, 0] + X[:, 1] - 0.5 * X[:, 2]
+    y = (rng.rand(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float64)
+    if invert:
+        y = 1.0 - y
+    return X, y
+
+
+def _write_segment(src, name, X, y, extra_lines=()):
+    """Producer contract: write under a temp name, rename in."""
+    lines = [",".join([f"{y[i]:.0f}"] + [f"{v:.6f}" for v in X[i]])
+             for i in range(len(y))]
+    lines.extend(extra_lines)
+    tmp = os.path.join(src, f"_{name}.part")
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _params(**over):
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5, "max_bin": 63, "seed": 7}
+    p.update(over)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# DataTail
+# ---------------------------------------------------------------------------
+def test_tail_quarantines_every_bad_row_kind(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    X, y = _xy(40, seed=0)
+    bad = [
+        "0.5," + ",".join(["1.0"] * NF),          # non-binary label
+        "nan," + ",".join(["1.0"] * NF),          # non-finite label
+        "1," + ",".join(["1.0"] * (NF - 1)),      # wrong width
+        "1,abc," + ",".join(["1.0"] * (NF - 1)),  # parse failure
+        "1,nan," + ",".join(["1.0"] * (NF - 1)),  # NaN feature
+        "0,inf," + ",".join(["1.0"] * (NF - 1)),  # Inf feature
+    ]
+    _write_segment(src, "seg000.csv", X, y, extra_lines=bad)
+    reg = MetricsRegistry()
+    qpath = str(tmp_path / "quarantine.jsonl")
+    tail = DataTail(src, num_features=NF, quarantine_path=qpath,
+                    registry=reg)
+    batches = tail.poll()
+    assert len(batches) == 1
+    assert len(batches[0].y) == 40
+    assert batches[0].quarantined == len(bad)
+    assert tail.m_quarantined.value == len(bad)
+    recs = [json.loads(l) for l in open(qpath)]
+    assert len(recs) == len(bad)
+    reasons = " | ".join(r["reason"] for r in recs)
+    for expected in ("label", "width", "parse", "NaN", "Inf"):
+        assert expected in reasons
+    assert all(r["segment"] == "seg000.csv" for r in recs)
+
+
+def test_tail_width_pinned_by_first_clean_segment(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    X, y = _xy(20, seed=1)
+    _write_segment(src, "a.csv", X, y)
+    tail = DataTail(src)       # no width given
+    assert len(tail.poll()[0].y) == 20
+    assert tail.num_features == NF
+    # a later segment with a different width quarantines wholesale
+    _write_segment(src, "b.csv", np.ones((5, NF + 2)), np.zeros(5))
+    b = tail.poll()[0]
+    assert len(b.y) == 0 and b.quarantined == 5
+
+
+def test_tail_consumes_once_in_order_and_skips_partials(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    for name in ("seg002.csv", "seg001.csv"):
+        X, y = _xy(10, seed=2)
+        _write_segment(src, name, X, y)
+    # producer artifacts the tail must never read
+    open(os.path.join(src, "seg003.csv.tmp"), "w").write("garbage")
+    open(os.path.join(src, "_inflight.part"), "w").write("garbage")
+    open(os.path.join(src, ".hidden"), "w").write("garbage")
+    tail = DataTail(src, num_features=NF)
+    assert [b.name for b in tail.poll()] == ["seg001.csv", "seg002.csv"]
+    assert tail.poll() == []
+
+
+def test_tail_unreadable_segment_left_for_next_poll(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    # a directory where a segment should be: open() raises OSError on
+    # every attempt (not transient, not retried by file_io)
+    os.makedirs(os.path.join(src, "seg000.csv"))
+    reg = MetricsRegistry()
+    tail = DataTail(src, num_features=NF, registry=reg)
+    assert tail.poll() == []
+    assert tail.m_segment_errors.value == 1
+    # producer fixes it: the same name is ingested on the next poll
+    os.rmdir(os.path.join(src, "seg000.csv"))
+    X, y = _xy(15, seed=3)
+    _write_segment(src, "seg000.csv", X, y)
+    assert len(tail.poll()[0].y) == 15
+
+
+def test_tail_allow_nan_features_admits_missing_values(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    row = "1,nan," + ",".join(["1.0"] * (NF - 1))
+    X, y = _xy(10, seed=4)
+    _write_segment(src, "a.csv", X, y, extra_lines=[row])
+    tail = DataTail(src, num_features=NF, allow_nan_features=True)
+    b = tail.poll()[0]
+    assert len(b.y) == 11 and b.quarantined == 0
+    assert np.isnan(b.X).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# model stitching
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def base_and_delta():
+    X, y = _xy(400, seed=10)
+    ds = lgb.Dataset(X, y, free_raw_data=False)
+    base = lgb.train(_params(), ds, num_boost_round=5)
+    delta = lgb.train(_params(), lgb.Dataset(X, y, free_raw_data=False),
+                      num_boost_round=4, init_model=base)
+    return X, base, delta
+
+
+def test_combine_model_strings_raw_additivity(base_and_delta):
+    X, base, delta = base_and_delta
+    stitched = combine_model_strings(base.model_to_string(),
+                                     delta.model_to_string())
+    got = Booster(model_str=stitched)
+    assert got.num_trees() == base.num_trees() + delta.num_trees()
+    want = (base.predict(X, raw_score=True)
+            + delta.predict(X, raw_score=True))
+    np.testing.assert_allclose(got.predict(X, raw_score=True), want,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_combine_preserves_base_tree_bytes(base_and_delta):
+    _, base, delta = base_and_delta
+    base_str = base.model_to_string()
+    stitched = combine_model_strings(base_str, delta.model_to_string())
+    cut = base_str.find("end of trees")
+    assert stitched.startswith(base_str[:cut])
+
+
+def test_combine_rejects_invalid_inputs(base_and_delta):
+    _, base, _ = base_and_delta
+    from lightgbm_tpu.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        combine_model_strings("not a model", base.model_to_string())
+    with pytest.raises(LightGBMError):
+        combine_model_strings(base.model_to_string(), "not a model")
+
+
+# ---------------------------------------------------------------------------
+# PublishGate (scripted AUCs: publish/rollback fns are fakes, no training)
+# ---------------------------------------------------------------------------
+class _FakeFleet:
+    def __init__(self):
+        self.published = []
+        self.rollbacks = 0
+
+    def publish(self, model_str, bundle_dir):
+        self.published.append((model_str, bundle_dir))
+        return len(self.published)
+
+    def rollback(self):
+        self.rollbacks += 1
+        return max(len(self.published) - 1, 0)
+
+
+def _gate(fleet, **over):
+    kw = dict(min_auc=0.6, max_regression=0.05, min_fresh_rows=10,
+              metrics_registry=MetricsRegistry(),
+              publish_fn=fleet.publish, rollback_fn=fleet.rollback)
+    kw.update(over)
+    return PublishGate(None, "m", **kw)
+
+
+def test_gate_floor_regression_and_nan_refusals():
+    fleet = _FakeFleet()
+    gate = _gate(fleet)
+    assert gate.consider("m0", float("nan"))["reason"] == "no-holdout"
+    assert gate.consider("m0", 0.55)["reason"] == "floor"
+    ev = gate.consider("m1", 0.80)
+    assert ev["action"] == "publish" and ev["version"] == 1
+    # above the floor but >max_regression below the best published
+    assert gate.consider("m2", 0.74)["reason"] == "regression"
+    # within the bound publishes; best_auc keeps the max
+    assert gate.consider("m3", 0.76)["action"] == "publish"
+    assert gate.best_auc == 0.80
+    assert len(fleet.published) == 2
+    assert gate.m_published.value == 2
+    assert gate.m_rejected.value == 3
+
+
+def test_gate_watch_rolls_back_on_fresh_regression(monkeypatch):
+    fleet = _FakeFleet()
+    gate = _gate(fleet)
+    gate.consider("good-model", 0.85)
+    scripted = {"auc": 0.2}
+    monkeypatch.setattr("lightgbm_tpu.continuous.trainer.holdout_auc",
+                        lambda m, X, y: scripted["auc"])
+    X = np.zeros((50, NF))
+    y = np.arange(50) % 2
+    # too-small window: weather, not regression
+    assert gate.watch(X[:5], y[:5]) is None
+    # one-class window: AUC undefined, no verdict
+    assert gate.watch(X, np.zeros(50)) is None
+    assert fleet.rollbacks == 0
+    ev = gate.watch(X, y)
+    assert ev["action"] == "rollback" and ev["auc"] == 0.2
+    assert fleet.rollbacks == 1
+    assert gate.m_rollbacks.value == 1
+    # live model is now unknown: the watch stands down until a publish
+    assert gate.watch(X, y) is None
+    # a healthy window after a re-publish does NOT roll back
+    gate.consider("better-model", 0.84)
+    scripted["auc"] = 0.83
+    assert gate.watch(X, y) is None
+    assert fleet.rollbacks == 1
+
+
+def test_gate_watch_against_real_registry(binary_model):
+    """Rollback goes through ModelRegistry.rollback: current flips to the
+    previous version and the audit history records both actions."""
+    registry = ModelRegistry()
+    model_str = binary_model.model_to_string()
+    gate = PublishGate(registry, "m", min_auc=0.0, max_regression=0.05,
+                       min_fresh_rows=4, metrics_registry=MetricsRegistry())
+    gate.consider(model_str, 0.9)
+    gate.consider(model_str, 0.9)
+    assert registry.current_version("m") == 2
+    # the real scorer runs against a window the model is wrong on:
+    # inverted labels make its AUC ~ (1 - true AUC), far below the bound
+    nf = binary_model.num_feature()
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, nf)
+    pred = np.asarray(binary_model.predict(X, raw_score=True)).ravel()
+    y = (pred < np.median(pred)).astype(np.float64)   # anti-labels
+    ev = gate.watch(X, y)
+    assert ev is not None and ev["restored_version"] == 1
+    assert registry.current_version("m") == 1
+    actions = [h["action"] for h in registry.history("m")]
+    assert actions == ["publish", "publish", "rollback"]
+
+
+def test_gate_watch_single_version_keeps_serving(binary_model):
+    """Regression: a confirmed drift on the FIRST (only) published
+    version has nothing to roll back to — the gate must keep it serving
+    (alarm + event, baseline reset), not crash the service loop."""
+    registry = ModelRegistry()
+    model_str = binary_model.model_to_string()
+    gate = PublishGate(registry, "m", min_auc=0.0, max_regression=0.05,
+                       min_fresh_rows=4, metrics_registry=MetricsRegistry())
+    gate.consider(model_str, 0.9)
+    nf = binary_model.num_feature()
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, nf)
+    pred = np.asarray(binary_model.predict(X, raw_score=True)).ravel()
+    y = (pred < np.median(pred)).astype(np.float64)   # anti-labels
+    ev = gate.watch(X, y)
+    assert ev is not None and ev["restored_version"] is None
+    assert registry.current_version("m") == 1         # still serving
+    assert gate.m_rollbacks.value == 1                # alarm still raised
+    assert gate.live_auc is None                      # baseline reset
+
+
+def test_serving_unpublish_route(binary_model):
+    """The fleet partial-publish undo for a first-version publish:
+    ``:unpublish`` restores the nothing-published state (later predicts
+    404)."""
+    app = ServingApp()
+    st, _ = app.handle("POST", "/v1/models/m:publish",
+                       {"model_str": binary_model.model_to_string()})
+    assert st == 200
+    st, body = app.handle("POST", "/v1/models/m:unpublish")
+    assert st == 200 and body["version"] is None
+    st, _ = app.handle("POST", "/v1/models/m:predict",
+                       {"rows": np.zeros((2,
+                                          binary_model.num_feature()
+                                          )).tolist()})
+    assert st == 404
+    app.close()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end chaos soak (acceptance bar)
+# ---------------------------------------------------------------------------
+class _KillOnceTrainer(ContinuousTrainer):
+    """Arms a one-shot bomb for a chosen cycle: at iteration ``at`` the
+    post-iteration callback corrupts the NEWEST checkpoint on disk (the
+    crash-plus-bad-media double fault) and raises.  The service's retry
+    must resume from the newest VERIFIABLE checkpoint — the one before
+    the corrupted one."""
+
+    def __init__(self, *a, kill_cycle=1, kill_at=3, **kw):
+        super().__init__(*a, **kw)
+        self.kill_cycle = kill_cycle
+        self.kill_at = kill_at
+        self.fired = False
+        self.corrupted_iteration = None
+
+    def _bomb(self, env):
+        if self.fired or env.iteration != self.kill_at:
+            return
+        self.fired = True
+        cdir = self._cycle_dir(self.cycle)
+        local = cdir.split("://", 1)[-1]
+        ckpts = sorted(f for f in os.listdir(local)
+                       if f.endswith(".lgbckpt"))
+        newest = ckpts[-1]
+        self.corrupted_iteration = int(newest.split("_")[1].split(".")[0])
+        path = os.path.join(local, newest)
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(data[:len(data) // 2])      # torn mid-file
+        raise RuntimeError("chaos: injected trainer death")
+
+    def train_cycle(self, callbacks=None):
+        cbs = list(callbacks or [])
+        if not self.fired and self.cycle == self.kill_cycle:
+            cbs.append(self._bomb)
+        return super().train_cycle(cbs)
+
+
+def test_end_to_end_chaos_soak(tmp_path):
+    """The issue's acceptance scenario in one run: trainer kill, corrupt
+    checkpoint, poisoned segment, and quality-regressing segment against
+    a live serving app.  Bars: zero failed predict requests, only
+    gate-accepted versions ever served, training resumes bit-identical
+    from the last verifiable checkpoint, and the regression is rolled
+    back (registry history + alarm counter)."""
+    chaos = register_chaos_scheme("chaosio")
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    workdir = f"chaosio://{tmp_path}/work"     # all persistence on chaos
+    file_io.makedirs(workdir)
+    prev_retries = file_io.configure_retries(attempts=3, backoff_s=0.0)
+    app = ServingApp()
+    mreg = MetricsRegistry()
+    trainer = _KillOnceTrainer(_params(), workdir, rounds_per_cycle=6,
+                               kill_cycle=1, kill_at=3)
+    gate = PublishGate(app.registry, "cont", min_auc=0.55,
+                       max_regression=0.2, min_fresh_rows=20,
+                       metrics_registry=mreg)
+    service = ContinuousService(
+        DataTail(src, num_features=NF,
+                 quarantine_path=f"{workdir}/quarantine.jsonl",
+                 registry=mreg),
+        trainer, gate, poll_s=0.0, retry_backoff_s=0.0,
+        metrics_registry=mreg)
+
+    # -- segment 0: clean → cycle 0 trains and publishes v1 -------------
+    X0, y0 = _xy(500, seed=20)
+    _write_segment(src, "seg000.csv", X0, y0)
+    s0 = service.step()
+    assert s0["decision"]["action"] == "publish"
+    accepted = {s0["decision"]["version"]}
+
+    # -- serving side: hammer predicts for the rest of the soak ---------
+    stop = threading.Event()
+    failures, served_versions = [], set()
+    Xq = _xy(8, seed=99)[0]
+
+    def _client():
+        while not stop.is_set():
+            status, resp = app.handle(
+                "POST", "/v1/models/cont:predict", {"rows": Xq.tolist()})
+            if status != 200:
+                failures.append((status, resp))
+            else:
+                served_versions.add(resp["version"])
+
+    clients = [threading.Thread(target=_client) for _ in range(3)]
+    for t in clients:
+        t.start()
+    try:
+        # -- segment 1: clean, but the trainer dies mid-cycle AND the
+        # newest checkpoint is corrupted; one transient IO fault is armed
+        # so the retry path also exercises file_io backoff --------------
+        X1, y1 = _xy(500, seed=21)
+        _write_segment(src, "seg001.csv", X1, y1)
+        chaos.fail_writes(1)
+        s1 = service.step()
+        assert trainer.fired
+        assert service.m_cycle_failures.value == 1
+        assert chaos.counters["transient_errors"] >= 1
+        # resumed below the corrupted iteration: the corrupt newest was
+        # skipped back to the previous verifiable checkpoint
+        assert trainer.resume_events, "retry did not resume"
+        resumed = trainer.resume_events[0]["iteration"]
+        assert resumed == trainer.corrupted_iteration - 1
+        assert s1["resumed_from"] == resumed
+        assert s1["decision"]["action"] == "publish"
+        accepted.add(s1["decision"]["version"])
+        chaos_model = trainer.model_str
+
+        # -- segment 2: poisoned (mostly garbage) — quarantined, then the
+        # cycle trains on and publishes or holds, never crashes ---------
+        poison = (["not,a,row,at,all"] * 30
+                  + ["1," + ",".join(["inf"] * NF)] * 30
+                  + ["2," + ",".join(["0.0"] * NF)] * 30)
+        Xp, yp = _xy(60, seed=22)
+        _write_segment(src, "seg002.csv", Xp, yp, extra_lines=poison)
+        q_before = service.tail.m_quarantined.value
+        s2 = service.step()
+        assert service.tail.m_quarantined.value - q_before == 90
+        assert s2["decision"] is not None
+        if s2["decision"]["action"] == "publish":
+            accepted.add(s2["decision"]["version"])
+
+        # -- segment 3: the world turns adversarial — inverted labels.
+        # The drift watch scores the LIVE model on the fresh window
+        # before training and rolls back ---------------------------------
+        Xi, yi = _xy(400, seed=23, invert=True)
+        _write_segment(src, "seg003.csv", Xi, yi)
+        rollbacks_before = gate.m_rollbacks.value
+        s3 = service.step()
+        assert s3["rollback"] is not None
+        assert gate.m_rollbacks.value == rollbacks_before + 1
+        if s3["decision"]["action"] == "publish":
+            accepted.add(s3["decision"]["version"])
+    finally:
+        stop.set()
+        for t in clients:
+            t.join(10)
+        file_io.configure_retries(*prev_retries)
+        chaos.calm()
+        app.close()
+
+    # -- bars -----------------------------------------------------------
+    assert not failures, f"failed predict requests: {failures[:3]}"
+    assert served_versions <= accepted, (
+        f"served a version the gate never accepted: "
+        f"{served_versions - accepted}")
+    history = app.registry.history("cont")
+    assert [h["action"] for h in history].count("rollback") == 1
+    # every publish in the history was gate-accepted
+    assert {h["version"] for h in history
+            if h["action"] == "publish"} <= accepted
+
+    # -- bit-identical resume: replay cycle 1 uninterrupted -------------
+    # The control must see byte-identical inputs, so it ingests through
+    # the same tail/CSV pipeline (values are 6-decimal rounded on disk),
+    # not the raw arrays the producer started from.
+    control = ContinuousTrainer(_params(), str(tmp_path / "control"),
+                                rounds_per_cycle=6)
+    ctail = DataTail(src, num_features=NF)
+    replay = {b.name: b for b in ctail.poll()}
+    control.ingest(replay["seg000.csv"].X, replay["seg000.csv"].y)
+    c0 = control.train_cycle()
+    control.commit(c0["candidate_str"])
+    control.ingest(replay["seg001.csv"].X, replay["seg001.csv"].y)
+    c1 = control.train_cycle()
+    assert c1["candidate_str"] == chaos_model, (
+        "killed+corrupted run's cycle-1 model differs from an "
+        "uninterrupted control — resume was not bit-identical")
+
+
+# ---------------------------------------------------------------------------
+# service unit behavior (one tiny training cycle)
+# ---------------------------------------------------------------------------
+def test_service_rejected_candidate_keeps_base_and_registry(tmp_path):
+    """A cycle whose candidate the gate refuses leaves the registry AND
+    the trainer's continuation base untouched."""
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    X, y = _xy(300, seed=30)
+    _write_segment(src, "seg000.csv", X, y)
+    app = ServingApp()
+    trainer = ContinuousTrainer(_params(), str(tmp_path / "work"),
+                                rounds_per_cycle=4)
+    # impossible floor: everything is rejected
+    gate = PublishGate(app.registry, "m", min_auc=2.0,
+                       metrics_registry=MetricsRegistry())
+    service = ContinuousService(
+        DataTail(src, num_features=NF), trainer, gate, poll_s=0.0,
+        metrics_registry=MetricsRegistry())
+    s = service.step()
+    app.close()
+    assert s["decision"]["reason"] == "floor"
+    assert trainer.model_str is None          # base not advanced
+    assert trainer.cycle == 1                 # cycle number burned
+    with pytest.raises(Exception):
+        app.registry.current_version("m")     # nothing ever published
+
+
+def test_service_gives_up_after_retry_budget(tmp_path):
+    from lightgbm_tpu.log import LightGBMError
+
+    class _AlwaysDies(ContinuousTrainer):
+        def train_cycle(self, callbacks=None):
+            raise RuntimeError("boom")
+
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    X, y = _xy(50, seed=31)
+    _write_segment(src, "a.csv", X, y)
+    trainer = _AlwaysDies(_params(), str(tmp_path / "work"))
+    gate = _gate(_FakeFleet())
+    service = ContinuousService(
+        DataTail(src, num_features=NF), trainer, gate, poll_s=0.0,
+        max_cycle_retries=2, retry_backoff_s=0.0,
+        metrics_registry=MetricsRegistry())
+    with pytest.raises(LightGBMError, match="giving up"):
+        service.step()
+    assert service.m_cycle_failures.value == 3   # initial + 2 retries
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+def test_cli_task_continuous_drains_and_exits(tmp_path):
+    from lightgbm_tpu.application import Application
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    X, y = _xy(300, seed=40)
+    _write_segment(src, "seg000.csv", X, y)
+    workdir = str(tmp_path / "work")
+    Application([
+        "task=continuous", f"continuous_source={src}",
+        f"continuous_dir={workdir}", "continuous_rounds=4",
+        "continuous_max_cycles=1", "continuous_max_idle_polls=2",
+        "continuous_poll_s=0", "continuous_min_auc=0.5",
+        "serving_port=0", "objective=binary", "num_leaves=7",
+        "min_data_in_leaf=5", "max_bin=63", "verbosity=-1", "seed=7",
+    ]).run()
+    # the cycle ran under the service workdir and checkpointed
+    cdir = os.path.join(workdir, "cycles", "cycle_00000")
+    assert any(f.endswith(".lgbckpt") for f in os.listdir(cdir))

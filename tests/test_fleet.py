@@ -351,6 +351,91 @@ def test_router_broadcast_timeout_fails_not_excluded():
     assert "m" not in r._published
 
 
+def test_router_partial_publish_rolls_back_successes():
+    """Satellite regression: one replica 503s the publish broadcast →
+    the replicas that already installed the new version are rolled back
+    (the fleet must never silently serve mixed versions) and
+    ``lgbm_fleet_publish_partial_total`` records the incident."""
+    class Refusing(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":publish") and not self.dead:
+                return 503, {"error": "model load failed"}
+            return super().request(method, path, body, timeout_s)
+
+    class RollbackAware(FakeReplica):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.rollbacks = 0
+
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":rollback"):
+                self.rollbacks += 1
+                self.version -= 1
+                return 200, {"name": "m", "version": self.version}
+            return super().request(method, path, body, timeout_s)
+
+    a, b, bad = RollbackAware("a"), RollbackAware("b"), Refusing("bad")
+    r = _router([a, b, bad])
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 502 and body["succeeded"] == 2
+    # both successes were withdrawn — every replica is back on v1
+    assert a.rollbacks == b.rollbacks == 1
+    assert a.version == b.version == 1
+    assert body["replicas"]["a"]["rolled_back"] is True
+    assert body["replicas"]["b"]["rolled_back"] is True
+    assert bad.published == []
+    status, js = r.handle("GET", "/v1/metrics")
+    assert js["router"]["lgbm_fleet_publish_partial_total"]["_"] == 1
+    # never remembered as fleet-wide success for the rejoin replay
+    assert "m" not in r._published
+    # a fully-successful publish does NOT touch the partial counter
+    bad.dead = True            # unreachable (status 0) is not "partial"
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 200
+    assert a.version == b.version == 2 and a.rollbacks == 1
+    status, js = r.handle("GET", "/v1/metrics")
+    assert js["router"]["lgbm_fleet_publish_partial_total"]["_"] == 1
+
+
+def test_router_first_version_partial_publish_unpublishes():
+    """A partial FIRST publish cannot be undone with :rollback (the
+    successes have no previous version) — the router must send
+    :unpublish so those replicas return to the nothing-published state
+    the refusing replica is in."""
+    class Fresh(FakeReplica):
+        def __init__(self, name):
+            super().__init__(name, version=0)   # publish will mint v1
+            self.unpublishes = 0
+
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":unpublish"):
+                self.unpublishes += 1
+                self.version = 0
+                return 200, {"name": "m", "version": None}
+            if path.endswith(":rollback"):      # what a real replica says
+                return 400, {"error": "no previous version to roll "
+                                      "back to"}
+            return super().request(method, path, body, timeout_s)
+
+    class Refusing(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":publish"):
+                return 503, {"error": "model load failed"}
+            return super().request(method, path, body, timeout_s)
+
+    a, b, bad = Fresh("a"), Fresh("b"), Refusing("bad")
+    r = _router([a, b, bad])
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 502 and body["succeeded"] == 2
+    assert a.unpublishes == b.unpublishes == 1
+    assert a.version == b.version == 0          # nothing-published again
+    assert body["replicas"]["a"]["rolled_back"] is True
+    assert body["replicas"]["b"]["rolled_back"] is True
+
+
 def test_router_replays_publishes_to_rejoined_replica():
     """Regression: a supervised restart respawns a replica from its
     ORIGINAL argv, so a hot-swap it missed while dead must be replayed
