@@ -311,6 +311,59 @@ def run_training():
     finally:
         shutil.rmtree(aot_dir, ignore_errors=True)
 
+    # quantized-engine probe (ISSUE 9): pair-train the SAME rounds with
+    # quantized_histograms on and off and report timing + held-out AUC
+    # delta.  The paired f32 run (instead of reusing the headline model)
+    # keeps round counts identical, so auc_delta_vs_f32 is the engine's
+    # parity number — the accepted deviation class is an AUC bound, not
+    # bit-identity.
+    quantized = {}
+    try:
+        from lightgbm_tpu.telemetry.registry import get_counter
+        rem = (deadline - time.time()) - 20.0
+        if rem < 4.0 * per_iter:
+            # earlier probes ate the budget: bail out like run_hist's
+            # deadline guard rather than blowing BENCH_CHILD_DEADLINE and
+            # losing the whole train-stage JSON
+            raise RuntimeError(f"budget exhausted ({rem:.0f}s left)")
+        qiters = int(min(iters, max(3, rem / (2.5 * per_iter))))
+        clip_c = get_counter(None, "lgbm_hist_grad_clip_total")
+        qp = dict(params)
+        qp["quantized_histograms"] = True
+        # warm-up round OUTSIDE the clock: the quantized config compiles
+        # NEW grower programs while f32 reuses the headline run's warm jit
+        # cache — timing the compiles would bias speedup_vs_f32 against
+        # the engine (run_hist's timeit compiles outside the clock too)
+        lgb.train(qp, train_set, num_boost_round=1)
+        clips0 = clip_c.value
+        t0 = time.time()
+        bst_q = lgb.train(qp, train_set, num_boost_round=qiters)
+        bst_q.num_trees()              # forces the lazy flush -> full sync
+        q_s = time.time() - t0
+        learner = bst_q._gbdt.tree_learner
+        packed = learner.pack_map is not None
+        qbins = learner.train_bins
+        t0 = time.time()
+        bst_f = lgb.train(dict(params), train_set, num_boost_round=qiters)
+        bst_f.num_trees()
+        f_s = time.time() - t0
+        auc_q = float(roc_auc_score(yt, bst_q.predict(Xt)))
+        auc_f = float(roc_auc_score(yt, bst_f.predict(Xt)))
+        quantized = {
+            "iters": qiters,
+            "per_iter_s": round(q_s / qiters, 4),
+            "f32_per_iter_s": round(f_s / qiters, 4),
+            "speedup_vs_f32": round(f_s / q_s, 4),
+            "held_out_auc": round(auc_q, 6),
+            "auc_delta_vs_f32": round(auc_q - auc_f, 6),
+            "packed": packed,
+            "bin_matrix_bytes": (int(np.prod(qbins.shape))
+                                 if qbins is not None else None),
+            "grad_clip_rows": int(clip_c.value - clips0),
+        }
+    except Exception as exc:
+        quantized = {"error": repr(exc)[-200:]}   # honest failure marker
+
     ref_work = REFERENCE_HIGGS_ROWS * REFERENCE_ITERS
     our_work = rows * iters
     ref_time_scaled = REFERENCE_TIME_S * (our_work / ref_work)
@@ -328,6 +381,7 @@ def run_training():
         "checkpoint_frac": round(checkpoint_frac, 4),
         "telemetry": telemetry,
         "aot": aot,
+        "quantized": quantized,
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,
@@ -1088,7 +1142,10 @@ def run_hist():
     jnp.zeros((8, 8)).block_until_ready()
     print(f"BENCH_READY {backend}", flush=True)
 
-    from lightgbm_tpu.ops.histogram import build_histogram, plan_width_classes
+    from lightgbm_tpu.ops.histogram import (build_histogram, pack_bins,
+                                            plan_packed_classes,
+                                            plan_width_classes,
+                                            quantize_grad_hess)
 
     rows = int(os.environ.get("BENCH_HIST_ROWS", 100_000))
     feats = int(os.environ.get("BENCH_HIST_FEATURES", 32))
@@ -1150,6 +1207,54 @@ def run_hist():
                     "speedup_vs_256": round(t_full / t_cls, 4),
                     "width_class_s": round(t_cls, 5),
                     "global_256_s": round(t_full, 5),
+                    "rows": rows,
+                    "features": feats,
+                    "backend": backend,
+                }), flush=True)
+
+                if dtype != "float32":
+                    continue
+                # quantized engine row (ISSUE 9): int16 fixed-point weights
+                # + the sub-byte packed matrix where the width packs one
+                # (16-bin class: 4-bit nibbles, half the bin-matrix bytes).
+                # speedup_vs_f32 races the f32 width-class contraction just
+                # timed on identical data; bin_matrix_bytes_ratio is the
+                # HBM-footprint win and holds regardless of CPU emulation.
+                g = jnp.asarray(w[:, 0])
+                h = jnp.abs(jnp.asarray(w[:, 1]))
+                ones = jnp.ones((rows,), jnp.float32)
+                gq, hq, cq, scale3, _ = jax.jit(quantize_grad_hess)(
+                    g, h, ones, jnp.float32(rows))
+                wq = jnp.stack([gq, hq, cq], axis=1)
+                qplan = plan_packed_classes(np.full(feats, width), global_b)
+                if qplan is not None:
+                    qbins = jnp.asarray(pack_bins(bins_np, qplan))
+                    qlayout, qwidths = qplan.layout, qplan.widths
+                    qspec = qplan.pack_spec
+                    packed_bytes = int(qbins.shape[0] * qbins.shape[1])
+                else:        # width class too wide to pack: quantized-only
+                    qbins, qlayout, qwidths, qspec = bins, layout, widths, ()
+                    packed_bytes = rows * feats
+
+                def quantized():
+                    return build_histogram(qbins, wq, global_b, impl=impl,
+                                           layout=qlayout, widths=qwidths,
+                                           pack_spec=qspec)
+
+                t_q = timeit(quantized)
+                print("BENCH_RESULT " + json.dumps({
+                    "metric": f"hist_quant_{impl}_{width}bin",
+                    "value": round(rows * feats / t_q, 1),
+                    "unit": "rows*features/s",
+                    "vs_baseline": round(t_cls / t_q, 4),
+                    "speedup_vs_f32": round(t_cls / t_q, 4),
+                    "quantized_s": round(t_q, 5),
+                    "f32_width_class_s": round(t_cls, 5),
+                    "packed": qplan is not None,
+                    "bin_matrix_bytes": packed_bytes,
+                    "unpacked_bytes": rows * feats,
+                    "bin_matrix_bytes_ratio": round(
+                        packed_bytes / (rows * feats), 4),
                     "rows": rows,
                     "features": feats,
                     "backend": backend,

@@ -61,6 +61,9 @@ class GBDT:
 
         objective.init(train_data.metadata, train_data.num_data)
         self.tree_learner = self._create_tree_learner(config, train_data)
+        if self.telemetry is not None:
+            from ..telemetry.training import hist_path_of
+            self.telemetry.hist_path = hist_path_of(self.tree_learner)
 
         n = train_data.num_data
         k = self.num_class
@@ -106,9 +109,14 @@ class GBDT:
         self.config = config
         self.shrinkage_rate = config.learning_rate
         self.tree_learner = self._create_tree_learner(config, self.train_data)
+        if self.telemetry is not None:
+            from ..telemetry.training import hist_path_of
+            self.telemetry.hist_path = hist_path_of(self.tree_learner)
         self.train_metrics = create_metrics(config, self.objective)
         self._fused_step = None        # recompile against the new config
         self._fused_const = None
+        if hasattr(self, "_quant_bounds_cache"):
+            del self._quant_bounds_cache   # GOSS rates feed the bound
         self._L = self.tree_learner.grower_cfg.num_leaves
 
     @property
@@ -278,11 +286,11 @@ class GBDT:
             forced = (learner.forced
                       if self.config.grow_strategy == "compact" else None)
             self._fused_const = (
-                ds.device_bins, ds.label, ds.weight,
+                learner.train_bins, ds.label, ds.weight,
                 ds.num_bins_per_feature, ds.has_missing_per_feature,
                 learner.monotone, learner.is_cat_f, learner.bmap,
                 learner.igroups, learner.gain_scale, learner.hist_layout,
-                forced)
+                forced, learner.pack_map, self._quant_bounds_arr())
         return self._fused_const
 
     def _build_fused_block(self, variant: int, k: int):
@@ -297,7 +305,7 @@ class GBDT:
         booster = self
 
         def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
-                  igroups, gscale, hlayout, forced,
+                  igroups, gscale, hlayout, forced, pack_map, qbounds,
                   score_row, lr, masks, fmasks, keys, adjust_keys):
             grow = grow_tree_compact if compact else grow_tree
 
@@ -309,7 +317,8 @@ class GBDT:
                 kw = {"forced": forced} if compact else {}
                 state = grow(cfg, bins, g2[0], h2[0], mask2, nbf, hmf,
                              fmask, monotone, key, is_cat, bmap, igroups,
-                             gscale, None, hist_layout=hlayout, **kw)
+                             gscale, None, hist_layout=hlayout,
+                             pack_map=pack_map, quant_bounds=qbounds, **kw)
                 delta = jnp.where(state.n_leaves > 1,
                                   (state.leaf_value * lr)[state.row_leaf],
                                   jnp.zeros_like(score))
@@ -500,6 +509,10 @@ class GBDT:
         self._stall_checked = 0
         with timed("flush_states_to_host"):
             states = jax.device_get([p[0] for p in pending])
+        if (self.tree_learner is not None
+                and getattr(self.tree_learner.grower_cfg, "quantized",
+                            False)):
+            self._drain_quant_clips(sum(int(s.quant_clips) for s in states))
         for state, (_, init, lr) in zip(states, pending):
             tree = state_to_tree(state, self.train_data.feature_mappers,
                                  self.train_data.real_feature_index)
@@ -550,6 +563,42 @@ class GBDT:
         """Hook for sampling strategies that rescale gradients (GOSS
         overrides this; reference GOSS::BaggingHelper)."""
         return grad, hess, self._bagging_mask(self.iter_)
+
+    # -- quantized histogram engine (config quantized_histograms) --------
+    def _grad_amplification(self) -> float:
+        """Largest factor a sampling strategy multiplies gradients by
+        (GOSS overrides with its (n - top_k)/other_k rescale); scales the
+        objective's gradient bound for the fixed-point quantizer."""
+        return 1.0
+
+    def _quant_bounds_arr(self):
+        """[2] device (grad, hess) bound for the grower's quantizer, or
+        None for the runtime-max fallback.  Objective bound x max sample
+        weight x sampling amplification — anything past it clips (counted
+        in lgbm_hist_grad_clip_total)."""
+        if not getattr(self.tree_learner.grower_cfg, "quantized", False):
+            return None
+        if not hasattr(self, "_quant_bounds_cache"):
+            bounds = self.objective.gradient_bounds()
+            if bounds is None:
+                self._quant_bounds_cache = None
+            else:
+                w = self.train_data.metadata.weight
+                wmax = float(np.max(w)) if w is not None and len(w) else 1.0
+                amp = max(float(self._grad_amplification()), 1.0)
+                self._quant_bounds_cache = jnp.asarray(
+                    [bounds[0] * wmax * amp, bounds[1] * wmax * amp],
+                    jnp.float32)
+        return self._quant_bounds_cache
+
+    def _drain_quant_clips(self, clips) -> None:
+        """Fold a tree's quantization clip count into the process counter."""
+        v = int(clips)
+        if v > 0:
+            from ..telemetry.registry import get_counter
+            get_counter(None, "lgbm_hist_grad_clip_total",
+                        "rows whose quantized (grad, hess) hit the "
+                        "fixed-point clip bound").inc(v)
 
     bias_before_score_update = False
 
@@ -602,12 +651,15 @@ class GBDT:
             cegb_pen = self._cegb_penalty()
             with timed("tree_learner_train"):
                 t0 = time.perf_counter() if tele else 0.0
-                state = self.tree_learner.train(grad[cls], hess[cls], mask,
-                                                self.iter_,
-                                                gain_penalty=cegb_pen)
+                state = self.tree_learner.train(
+                    grad[cls], hess[cls], mask, self.iter_,
+                    gain_penalty=cegb_pen,
+                    quant_bounds=self._quant_bounds_arr())
                 if tele:
                     jax.block_until_ready(state.n_leaves)
                     tele.add("grow_s", time.perf_counter() - t0)
+            if getattr(self.tree_learner.grower_cfg, "quantized", False):
+                self._drain_quant_clips(state.quant_clips)
             if tele:
                 # staged re-grow of the same inputs for the per-phase
                 # hist/split/partition decomposition (tree discarded)

@@ -105,3 +105,11 @@ class GOSS(GBDT):
     def _fused_adjust_key_at(self, iteration: int):
         return jax.random.PRNGKey(self.config.bagging_seed * 65537 +
                                   iteration)
+
+    def _grad_amplification(self) -> float:
+        # sampled small-gradient rows are rescaled by (n - top_k)/other_k
+        # (goss_adjust `multiply`); the quantizer's gradient bound must
+        # cover the amplified values or every sampled row would clip
+        top_k, other_k = self._goss_ks()
+        n = self.train_data.num_data
+        return max((n - top_k) / max(other_k, 1), 1.0)

@@ -343,6 +343,19 @@ _PARAMS: List[ParamSpec] = [
             "one width-matched histogram contraction per class (reference "
             "histogram_16_64_256 kernel specialization); disable to force "
             "the single global-max_bin contraction"),
+    _p("quantized_histograms", bool, False, ("quantized_histogram",),
+       desc="quantized histogram engine: per-row (grad, hess) quantized to "
+            "int16 with a per-iteration scale derived from the objective's "
+            "gradient bound (runtime max when the objective is unbounded; "
+            "clipped rows count into lgbm_hist_grad_clip_total), histograms "
+            "accumulated in int32 fixed point and dequantized only at "
+            "split-scan time (arxiv 2011.02022), plus <=16-bin device "
+            "columns packed four-or-two-to-a-byte for the contraction "
+            "input (arxiv 1706.08359; non-segment impls, byte-backed "
+            "matrices).  Models match the f32 path within quantization "
+            "precision — AUC-bounded parity, NOT bit-identical (the "
+            "documented deviation class for this knob).  Cleared by the "
+            "feature-parallel learner like the width-class plan"),
     _p("compilation_cache_dir", str, "", ("jax_compilation_cache_dir",),
        desc="enable the JAX persistent compilation cache at this directory; "
             "repeat runs with identical shapes/configs skip XLA recompiles "

@@ -617,6 +617,31 @@ class TrainDataset:
         self.setup_timings["construct_s"] = time.perf_counter() - t_construct
 
     # ------------------------------------------------------------------
+    def packed_device_bins(self, plan) -> np.ndarray:
+        """Sub-byte-packed device bin matrix for the quantized histogram
+        engine (config ``quantized_histograms``; arxiv 1706.08359 bin
+        packing).
+
+        ``plan`` is a ``PackPlan`` from ``ops.histogram.plan_packed_classes``
+        over this dataset's ``device_col_num_bins``: <=16-bin device columns
+        (post-EFB bundle widths) share bytes — four 2-bit columns or two
+        4-bit nibbles per byte — and the planes are laid out in width-class
+        order, so the histogram contraction streams the packed bytes
+        directly with the unpack fused into its input.  Returns the host
+        [N, P] uint8 matrix; the learner places/shards it (the unpacked
+        ``device_bins`` stays authoritative for traversal-based score
+        updates and rollback).
+        """
+        from .ops.histogram import pack_bins
+        if self.device_bins is None:
+            # self.bins is the pre-bundling storage matrix: packing it
+            # under a plan built over device_col_num_bins would produce a
+            # plausibly-shaped but WRONG matrix — refuse instead
+            raise ValueError(
+                "packed_device_bins needs the device-space matrix; this "
+                "dataset has no device_bins (rank-local shard?)")
+        return pack_bins(np.asarray(self.device_bins), plan)
+
     def bin_external(self, data: np.ndarray) -> np.ndarray:
         """Bin new rows with this dataset's mappers (reference
         LoadFromFileAlignWithOtherDataset / _init_from_ref_dataset)."""

@@ -80,6 +80,18 @@ class ObjectiveFunction:
         """Host-side leaf refit; default no-op."""
         return tree
 
+    def gradient_bounds(self):
+        """Static per-row (max |grad|, max hess) for an UNWEIGHTED row, or
+        None when unbounded.  The quantized histogram engine (config
+        quantized_histograms) derives its per-iteration fixed-point scale
+        from this bound — rows beyond it clip and count into
+        ``lgbm_hist_grad_clip_total``; None falls back to the runtime max
+        (never clips).  The booster folds sample-weight and GOSS
+        amplification factors in on top (gbdt.py), so bounds here describe
+        only the raw objective math.  Call after ``init()`` — data-derived
+        factors (e.g. is_unbalance label weights) are resolved there."""
+        return None
+
     def to_string(self) -> str:
         return self.name
 
@@ -386,6 +398,12 @@ class BinaryLogloss(ObjectiveFunction):
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
 
+    def gradient_bounds(self):
+        # |response| <= sigmoid and h = |r|(sigmoid - |r|) peaks at
+        # sigmoid^2/4, both scaled by the larger unbalance/pos label weight
+        lw = max(self.label_weights)
+        return (self.sigmoid * lw, 0.25 * self.sigmoid * self.sigmoid * lw)
+
     def to_string(self):
         return f"binary sigmoid:{self.sigmoid:g}"
 
@@ -424,6 +442,10 @@ class MulticlassSoftmax(ObjectiveFunction):
 
     def convert_output(self, score):
         return jax.nn.softmax(score, axis=0)
+
+    def gradient_bounds(self):
+        # g = p - onehot in [-1, 1]; h = 2 p (1 - p) <= 0.5
+        return (1.0, 0.5)
 
     def to_string(self):
         return f"multiclass num_class:{self.num_class}"
@@ -467,6 +489,10 @@ class MulticlassOVA(ObjectiveFunction):
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * score))
 
+    def gradient_bounds(self):
+        # per-class binary logloss without unbalance weights
+        return (self.sigmoid, 0.25 * self.sigmoid * self.sigmoid)
+
     def to_string(self):
         return f"multiclassova num_class:{self.num_class} sigmoid:{self.sigmoid:g}"
 
@@ -499,6 +525,10 @@ class CrossEntropy(ObjectiveFunction):
 
     def convert_output(self, score):
         return 1.0 / (1.0 + jnp.exp(-score))
+
+    def gradient_bounds(self):
+        # g = p - y with p in (0,1), y in [0,1]; h = p(1-p) <= 1/4
+        return (1.0, 0.25)
 
     def to_string(self):
         return "cross_entropy"

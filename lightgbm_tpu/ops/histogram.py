@@ -29,6 +29,27 @@ The multi-channel weight design subsumes the reference's separate
 replaces the histogram-subtraction cache: callers pass
 ``w = [g*left, h*left, left, g*right, h*right, right]`` and a single pass
 yields both children's histograms (see tree_learner.py).
+
+Quantized engine (config ``quantized_histograms``): the remaining factor
+after width-matching is operand size, the core trick of the GPU paper
+(arxiv 1706.08359: bin packing + low-precision workgroup accumulation) and
+Booster (arxiv 2011.02022: fixed-point gradient arithmetic).  Two layers:
+
+- **Packed bins**: ``plan_packed_classes`` assigns every <=16-bin device
+  column a sub-byte width (2 bits for <=4 bins — four columns to a byte —
+  else 4 bits, two to a byte) and lays the packed planes out in width-class
+  order; ``build_histogram`` consumes the packed matrix directly
+  (``pack_spec``), fusing the shift/mask unpack into the contraction input
+  so the unpacked columns never materialize in HBM at full N.
+- **Fixed-point accumulation**: ``quantize_grad_hess`` maps per-row
+  (grad, hess) to int16 with a per-iteration scale (hess is nonnegative, so
+  its quantized range is one-sided and needs no sign handling); integer
+  weights make every impl accumulate in int32 and ``ops/split.py``
+  dequantizes only at split-scan time.  The int32 histograms make the
+  compact grower's parent-minus-child subtraction EXACT (no f32 cancellation
+  drift), while split decisions differ from the f32 path within quantization
+  precision — model parity is AUC-bounded, not bit-identical (the documented
+  deviation class for this path).
 """
 
 from __future__ import annotations
@@ -42,7 +63,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["build_histogram", "HistLayout", "plan_width_classes",
-           "resolve_impl", "WIDTH_CLASS_LADDER"]
+           "resolve_impl", "WIDTH_CLASS_LADDER",
+           "PackMap", "PackPlan", "plan_packed_classes", "pack_bins",
+           "quantize_grad_hess", "take_device_column", "QUANT_ACC_LIMIT"]
 
 # Specialized contraction widths, mirroring the reference's 16/64/256 GPU
 # kernel variants (histogram_16_64_256.cu).
@@ -91,6 +114,218 @@ def plan_width_classes(col_num_bins, num_bins: int,
     return layout, widths
 
 
+# ---------------------------------------------------------------------------
+# Packed sub-byte bin storage (arxiv 1706.08359 bin packing)
+# ---------------------------------------------------------------------------
+
+class PackMap(NamedTuple):
+    """Per-STORAGE-column decode map into the packed byte matrix.
+
+    Device arrays only (rides through jit/shard_map as a pytree; replicated
+    under the parallel learners like HistLayout).  Column ``j`` of the
+    logical device matrix lives in packed byte column ``byte_col[j]`` at
+    ``(value >> shift[j]) & mask[j]``.
+    """
+    byte_col: jnp.ndarray   # [F] int32
+    shift: jnp.ndarray      # [F] int32 (0/2/4/6)
+    mask: jnp.ndarray       # [F] int32 (3, 15 or 255)
+
+
+class PackPlan(NamedTuple):
+    """Host-side packing plan (``plan_packed_classes``).
+
+    ``layout.inv_perm`` scatters per-class histograms back to storage-column
+    order exactly like the width-class plan; ``layout.perm`` is kept for
+    introspection but the packed matrix is ALREADY in permuted order, so
+    ``build_histogram`` never gathers columns on this path.  ``pack_spec``
+    is the STATIC run list ``(class_width, bits, n_cols, n_planes)`` in
+    packed-column order (rides GrowerConfig so per-run shapes stay
+    compile-time constants); ``byte_col``/``shift``/``mask`` are numpy in
+    storage order — callers lift them into a device ``PackMap``.
+    """
+    layout: HistLayout
+    widths: Tuple[Tuple[int, int], ...]
+    pack_spec: Tuple[Tuple[int, int, int, int], ...]
+    byte_col: np.ndarray
+    shift: np.ndarray
+    mask: np.ndarray
+    perm: np.ndarray        # [F] int32 storage column of packed slot i
+
+
+def plan_packed_classes(col_num_bins, num_bins: int,
+                        ladder: Tuple[int, ...] = WIDTH_CLASS_LADDER
+                        ) -> Optional[PackPlan]:
+    """Host-side planning for the packed device matrix.
+
+    Columns are grouped into the same {16, 64, 256} contraction classes as
+    ``plan_width_classes``; within the narrow class each column additionally
+    gets a sub-byte storage width — 2 bits (four columns per byte) when its
+    own bin count fits in 4 bins, else 4 bits (two per byte) — and wider
+    classes keep one byte per column.  Returns None when no column packs
+    sub-byte (the plain width plan is then strictly better: same classes,
+    no repack).  Unlike ``plan_width_classes`` a single-class plan is NOT
+    degenerate here: an all-16-bin dataset still halves its bin matrix.
+    """
+    col_num_bins = np.asarray(col_num_bins, np.int64)
+    if len(col_num_bins) == 0 or col_num_bins.max() > 256:
+        return None              # int32 storage matrix: nothing sub-byte
+    classes = [w for w in ladder if w < num_bins] + [num_bins]
+    bounds = np.asarray(classes, np.int64)
+    cls_idx = np.searchsorted(bounds, col_num_bins, side="left")
+    bits = np.where(col_num_bins <= 4, 2,
+                    np.where(col_num_bins <= 16, 4, 8)).astype(np.int64)
+    if not (bits < 8).any():
+        return None
+    # stable order: class, then storage bits, then original column
+    perm = np.lexsort((np.arange(len(cls_idx)), bits, cls_idx)).astype(
+        np.int32)
+    inv_perm = np.argsort(perm, kind="stable").astype(np.int32)
+    widths = tuple((int(classes[c]), int((cls_idx == c).sum()))
+                   for c in np.unique(cls_idx))
+    byte_col = np.zeros(len(perm), np.int32)
+    shift = np.zeros(len(perm), np.int32)
+    mask = np.zeros(len(perm), np.int32)
+    pack_spec = []
+    p_off = 0
+    i = 0
+    while i < len(perm):
+        c0, b0 = int(cls_idx[perm[i]]), int(bits[perm[i]])
+        j = i
+        while (j < len(perm) and cls_idx[perm[j]] == c0
+               and bits[perm[j]] == b0):
+            j += 1
+        ncols = j - i
+        per = 8 // b0
+        nplanes = -(-ncols // per)
+        for t in range(ncols):
+            col = int(perm[i + t])
+            byte_col[col] = p_off + t // per
+            shift[col] = b0 * (t % per)
+            mask[col] = (1 << b0) - 1
+        pack_spec.append((int(classes[c0]), b0, ncols, nplanes))
+        p_off += nplanes
+        i = j
+    layout = HistLayout(perm=jnp.asarray(perm), inv_perm=jnp.asarray(inv_perm))
+    return PackPlan(layout, widths, tuple(pack_spec), byte_col, shift, mask,
+                    perm)
+
+
+def pack_bins(bins_np: np.ndarray, plan: PackPlan) -> np.ndarray:
+    """Host-side packing: [N, F] uint8 storage-order bins -> [N, P] uint8
+    packed planes in the plan's packed-column order."""
+    bins_np = np.asarray(bins_np)
+    n = bins_np.shape[0]
+    total_planes = sum(s[3] for s in plan.pack_spec)
+    out = np.zeros((n, total_planes), np.uint8)
+    p_off = 0
+    c_off = 0
+    for (_w, b0, ncols, nplanes) in plan.pack_spec:
+        cols = plan.perm[c_off:c_off + ncols]
+        vals = bins_np[:, cols].astype(np.uint8)
+        per = 8 // b0
+        if per == 1:
+            out[:, p_off:p_off + nplanes] = vals
+        else:
+            padded = np.zeros((n, nplanes * per), np.uint8)
+            padded[:, :ncols] = vals
+            padded = padded.reshape(n, nplanes, per)
+            acc = np.zeros((n, nplanes), np.uint8)
+            for j in range(per):
+                acc |= padded[:, :, j] << np.uint8(b0 * j)
+            out[:, p_off:p_off + nplanes] = acc
+        p_off += nplanes
+        c_off += ncols
+    return out
+
+
+def take_device_column(bins: jnp.ndarray, col, pack_map=None) -> jnp.ndarray:
+    """[N] int32 decoded logical device column ``col`` (packed-aware).
+
+    ``col`` may be a traced scalar; the decode is uniform shift/mask
+    arithmetic over the gathered byte column, so no branching per width."""
+    if pack_map is None:
+        return jnp.take(bins, col, axis=1).astype(jnp.int32)
+    v = jnp.take(bins, pack_map.byte_col[col], axis=1).astype(jnp.int32)
+    return (v >> pack_map.shift[col]) & pack_map.mask[col]
+
+
+def _unpack_planes(planes: jnp.ndarray, bits: int, ncols: int) -> jnp.ndarray:
+    """[rows, n_planes] packed planes -> [rows, ncols] bin values.
+
+    Pure shift/mask arithmetic on the loaded bytes — XLA fuses it into the
+    consumer (one-hot compare / segment ids), so each packed byte is read
+    from HBM once and the unpacked columns never round-trip."""
+    per = 8 // bits
+    if per == 1:
+        return planes[:, :ncols]
+    m = (1 << bits) - 1
+    sub = jnp.stack([(planes >> (bits * j)) & m for j in range(per)], axis=2)
+    return sub.reshape(planes.shape[0], -1)[:, :ncols]
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point (grad, hess) quantization (arxiv 2011.02022)
+# ---------------------------------------------------------------------------
+
+# int32 accumulator headroom: per-row magnitudes are capped so a bin that
+# receives EVERY row (the root histogram's totals; hess never cancels) still
+# fits a signed 32-bit sum.  The int16 storage cap binds for < ~65k rows.
+QUANT_ACC_LIMIT = 2.0 ** 31 - 1.0
+
+
+def quantize_grad_hess(grad_m, hess_m, sample_mask, n_total, bounds=None,
+                       axis_name=None):
+    """Per-iteration int16 quantization of masked (grad, hess).
+
+    Scale derivation: ``limit = min(32767, (2^31-1)/N_total)`` rows of
+    headroom (see QUANT_ACC_LIMIT), ``scale = bound / limit`` with ``bound``
+    the objective's gradient/hessian bound when the caller supplies one
+    (rows beyond it CLIP and are counted — telemetry
+    ``lgbm_hist_grad_clip_total``) or the runtime max (never clips).  Hess
+    is nonnegative by construction, so its quantized range is the one-sided
+    [0, limit] and its bound is a plain max, not a max-abs.
+
+    ``axis_name``: under shard_map the runtime-max fallback is pmax'd over
+    the mesh so every shard derives the SAME scale — the data/voting
+    learners psum raw int32 histograms, which is only meaningful when the
+    fixed-point scale is shared (caller-supplied bounds are replicated and
+    need no sync; ``n_total`` must already be the GLOBAL row count).
+
+    Returns ``(g_q, h_q, count_q, scale3, clips)``: int16 per-row values, a
+    [3] f32 dequantization scale (count channel exactly 1.0 — bag counts
+    stay exact integers), and the int32 clipped-row count.
+    """
+    limit = jnp.floor(jnp.minimum(
+        32767.0, QUANT_ACC_LIMIT / jnp.maximum(
+            n_total.astype(jnp.float32), 1.0)))
+    if bounds is None:
+        g_bound = jnp.max(jnp.abs(grad_m))
+        h_bound = jnp.max(hess_m)
+        if axis_name is not None:
+            g_bound = jax.lax.pmax(g_bound, axis_name)
+            h_bound = jax.lax.pmax(h_bound, axis_name)
+    else:
+        g_bound, h_bound = bounds[0], bounds[1]
+    # all-zero gradients (converged class) still need a finite scale
+    g_bound = jnp.maximum(g_bound.astype(jnp.float32), 1e-30)
+    h_bound = jnp.maximum(h_bound.astype(jnp.float32), 1e-30)
+    s_g = g_bound / limit
+    s_h = h_bound / limit
+    g_q = jnp.round(grad_m / s_g)
+    h_q = jnp.round(hess_m / s_h)
+    # a NEGATIVE hessian (possible only for custom non-convex objectives;
+    # built-ins are nonnegative by construction) is clamped to the one-sided
+    # range below — count it as a clip so the altered-curvature rows are
+    # visible in lgbm_hist_grad_clip_total rather than silent
+    clips = ((jnp.abs(g_q) > limit) | (h_q > limit)
+             | (h_q < 0)).sum().astype(jnp.int32)
+    g_q = jnp.clip(g_q, -limit, limit).astype(jnp.int16)
+    h_q = jnp.clip(h_q, 0.0, limit).astype(jnp.int16)
+    count_q = sample_mask.astype(jnp.int16)      # 0/1 bag membership, exact
+    scale3 = jnp.stack([s_g, s_h, jnp.float32(1.0)])
+    return g_q, h_q, count_q, scale3, clips
+
+
 def _segment_impl(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int) -> jnp.ndarray:
     """[N, F] uint bins x [N, C] weights -> [F, B, C] via scatter-add.
 
@@ -103,6 +338,9 @@ def _segment_impl(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int) -> jnp
     # [N*F] segment ids, weights repeated per feature: [N*F, C]
     seg = flat_ids.reshape(-1)
     vals = jnp.broadcast_to(weights[:, None, :], (n, f, c)).reshape(-1, c)
+    if jnp.issubdtype(weights.dtype, jnp.integer):
+        # quantized path: widen int16 -> int32 at the adder, not in HBM
+        vals = vals.astype(jnp.int32)
     hist = jax.ops.segment_sum(vals, seg, num_segments=f * num_bins)
     return hist.reshape(f, num_bins, c)
 
@@ -113,6 +351,10 @@ def _onehot_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray, num_bins: int,
     # onehot: [chunk, F, B] — XLA fuses the iota-compare into the dot operand
     onehot = (bins_chunk[:, :, None] ==
               jnp.arange(num_bins, dtype=bins_chunk.dtype)[None, None, :])
+    if jnp.issubdtype(w_chunk.dtype, jnp.integer):
+        # fixed-point path: int16 x {0,1} contraction accumulated in int32
+        return jnp.einsum("rfb,rc->fbc", onehot.astype(jnp.int16), w_chunk,
+                          preferred_element_type=jnp.int32)
     onehot = onehot.astype(acc_dtype)
     # contraction over rows: f,b,c — a batched matmul over F on the MXU
     return jnp.einsum("rfb,rc->fbc", onehot, w_chunk.astype(acc_dtype),
@@ -120,23 +362,33 @@ def _onehot_chunk(bins_chunk: jnp.ndarray, w_chunk: jnp.ndarray, num_bins: int,
 
 
 def _onehot_impl(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
-                 chunk: int = 4096, acc_dtype=jnp.float32) -> jnp.ndarray:
-    """Chunked scan so the one-hot operand never materializes in HBM at full N."""
-    n, f = bins.shape
+                 chunk: int = 4096, acc_dtype=jnp.float32,
+                 prep=None, ncols: Optional[int] = None) -> jnp.ndarray:
+    """Chunked scan so the one-hot operand never materializes in HBM at full N.
+
+    ``prep`` (packed path): maps a [chunk, n_planes] packed-byte chunk to its
+    [chunk, ncols] unpacked bins INSIDE the scan body, so the array streamed
+    from HBM per chunk is the packed planes, not the unpacked columns."""
+    n, f_in = bins.shape
+    f = f_in if ncols is None else ncols
     c = weights.shape[1]
     pad = (-n) % chunk
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
         weights = jnp.pad(weights, ((0, pad), (0, 0)))
     nchunks = (n + pad) // chunk
-    bins_r = bins.reshape(nchunks, chunk, f)
+    bins_r = bins.reshape(nchunks, chunk, f_in)
     w_r = weights.reshape(nchunks, chunk, c)
+    quant = jnp.issubdtype(weights.dtype, jnp.integer)
 
     def body(acc, xs):
         b_c, w_c = xs
+        if prep is not None:
+            b_c = prep(b_c)
         return acc + _onehot_chunk(b_c, w_c, num_bins, acc_dtype), None
 
-    init = jnp.zeros((f, num_bins, c), dtype=jnp.float32)
+    init = jnp.zeros((f, num_bins, c),
+                     dtype=jnp.int32 if quant else jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_r, w_r))
     return hist
 
@@ -167,8 +419,15 @@ def resolve_impl(impl: str) -> str:
 
 
 def _build_one_class(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
-                     impl: str, chunk: int, hist_dtype: str) -> jnp.ndarray:
+                     impl: str, chunk: int, hist_dtype: str,
+                     prep=None, ncols: Optional[int] = None) -> jnp.ndarray:
     """One width-matched contraction: [N, F] x [N, C] -> [F, num_bins, C]."""
+    quant = jnp.issubdtype(weights.dtype, jnp.integer)
+    if impl == "pallas" and (quant or prep is not None):
+        # the pallas kernel is an f32/bf16 MXU kernel; the quantized/packed
+        # path rides the onehot formulation instead (real-chip int8 MXU
+        # variants stay a ROADMAP item)
+        impl = "onehot"
     if impl == "pallas":
         from . import pallas_histogram
         return pallas_histogram.build_histogram_pallas(
@@ -176,39 +435,86 @@ def _build_one_class(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
     if impl == "onehot":
         acc = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
         return _onehot_impl(bins, weights, num_bins, chunk=chunk,
-                            acc_dtype=acc)
+                            acc_dtype=acc, prep=prep, ncols=ncols)
+    if prep is not None:
+        bins = prep(bins)   # segment: one full-N unpack feeding scatter-add
     return _segment_impl(bins, weights, num_bins)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "impl", "chunk", "hist_dtype",
-                                    "widths"))
+                                    "widths", "pack_spec"))
 def build_histogram(bins: jnp.ndarray, weights: jnp.ndarray, num_bins: int,
                     impl: str = "auto", chunk: int = 4096,
                     hist_dtype: str = "float32",
                     layout: Optional[HistLayout] = None,
-                    widths: Tuple[Tuple[int, int], ...] = ()) -> jnp.ndarray:
+                    widths: Tuple[Tuple[int, int], ...] = (),
+                    pack_spec: Tuple[Tuple[int, int, int, int], ...] = ()
+                    ) -> jnp.ndarray:
     """Accumulate per-feature histograms.
 
     Args:
-      bins: [N, F] integer bin ids (uint8/int32).
+      bins: [N, F] integer bin ids (uint8/int32) — or, when ``pack_spec`` is
+        set, the [N, P] packed byte-plane matrix from ``pack_bins``.
       weights: [N, C] per-row channel values (already masked/zeroed for rows
-        outside the target leaf / bag).
+        outside the target leaf / bag).  f32 for the standard path; int16
+        (``quantize_grad_hess``) switches every impl to int32 fixed-point
+        accumulation and the result dtype to int32.
       num_bins: static B.
       impl: "segment" | "onehot" | "pallas" | "auto".
       hist_dtype: MXU contraction input dtype ("float32" | "bfloat16");
         accumulation is always f32 (reference GPU single-precision trade-off,
-        docs/GPU-Performance.rst:88; bf16 doubles the MXU rate).
+        docs/GPU-Performance.rst:88; bf16 doubles the MXU rate).  Ignored on
+        the fixed-point path.
       layout / widths: bin-width-class plan from ``plan_width_classes``.
         ``widths`` is a STATIC tuple of (class_width, column_count) pairs in
         permuted-column order; each class runs its own width-matched
         contraction and the results scatter back into the [F, B, C] pool
         layout, zero-padded above the class width.  Omit both (or pass the
         plan's None/()) for the single global-B contraction.
+      pack_spec: STATIC ``plan_packed_classes`` run list — ``bins`` is then
+        the packed matrix IN PACKED-COLUMN ORDER (no per-class gather; the
+        shift/mask unpack fuses into each contraction's input) and
+        ``layout.inv_perm`` scatters results back to storage order.
     Returns:
-      [F, B, C] float32 histogram.
+      [F, B, C] float32 histogram (int32 on the fixed-point path).
     """
     impl = _pick_impl(impl)
+    if pack_spec:
+        if layout is None:
+            raise ValueError("pack_spec requires the PackPlan's layout")
+        parts = []
+        p_off = 0
+        i = 0
+        while i < len(pack_spec):
+            w = pack_spec[i][0]
+            runs = []
+            while i < len(pack_spec) and pack_spec[i][0] == w:
+                _w, bits, ncols, nplanes = pack_spec[i]
+                runs.append((p_off, bits, ncols, nplanes))
+                p_off += nplanes
+                i += 1
+            base = runs[0][0]
+            total_planes = sum(r[3] for r in runs)
+            total_cols = sum(r[2] for r in runs)
+            planes = jax.lax.slice_in_dim(bins, base, base + total_planes,
+                                          axis=1)
+
+            def prep(pchunk, runs=runs, base=base):
+                outs = []
+                for (off, bits, ncols, nplanes) in runs:
+                    pl = pchunk[:, off - base:off - base + nplanes]
+                    outs.append(_unpack_planes(pl, bits, ncols))
+                return outs[0] if len(outs) == 1 else jnp.concatenate(
+                    outs, axis=1)
+
+            h = _build_one_class(planes, weights, w, impl, chunk, hist_dtype,
+                                 prep=prep, ncols=total_cols)
+            if w < num_bins:
+                h = jnp.pad(h, ((0, 0), (0, num_bins - w), (0, 0)))
+            parts.append(h)
+        hist = jnp.concatenate(parts, axis=0)        # packed-column order
+        return jnp.take(hist, layout.inv_perm, axis=0)
     if layout is None or not widths:
         return _build_one_class(bins, weights, num_bins, impl, chunk,
                                 hist_dtype)

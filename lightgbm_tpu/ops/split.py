@@ -22,10 +22,29 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["find_best_split", "leaf_output", "SplitResult", "K_EPSILON",
-           "leaf_gain"]
+           "leaf_gain", "dequantize_hist"]
 
 K_EPSILON = 1e-15  # reference kEpsilon in feature_histogram.hpp
 _NEG_INF = -jnp.inf
+
+
+def dequantize_hist(hist: jnp.ndarray, scale3) -> jnp.ndarray:
+    """Fixed-point int32 histogram -> f32, applied ONLY at split-scan time.
+
+    The quantized engine (ops/histogram.py quantize_grad_hess) accumulates
+    (grad, hess, count) in int32; everything upstream of the scan — the
+    compact grower's histogram pool, the parent-minus-child subtraction,
+    cross-shard psums — stays in exact integer arithmetic, and this is the
+    single seam back to the f32 gain math.  ``scale3`` is the [3] per-
+    iteration scale (count channel 1.0); 6-channel both-children layouts
+    tile it.  No-op for f32 inputs or a None scale, so every call site can
+    pass through unconditionally.
+    """
+    if scale3 is None or not jnp.issubdtype(hist.dtype, jnp.integer):
+        return hist
+    c = hist.shape[-1]
+    s = scale3 if c == 3 else jnp.concatenate([scale3, scale3])
+    return hist.astype(jnp.float32) * s
 
 
 class SplitResult(NamedTuple):

@@ -29,6 +29,9 @@ __all__ = ["DataParallelTreeLearner"]
 
 class DataParallelTreeLearner(SerialTreeLearner):
     AXIS = "data"
+    # pack once, straight into the row-sharded placement below — never the
+    # serial init's full-matrix default-device copy
+    PACK_DEVICE_BINS = False
 
     def _mode(self) -> str:
         return "data"
@@ -100,7 +103,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
             self._n_padded = nproc * n_per
         else:
             self.pad = (-n) % self.n_dev
-            bins = np.asarray(dataset.to_device_space(dataset.bins))
+            if self.pack_plan is not None:
+                # quantized engine: shard the sub-byte-packed plane matrix
+                # (rows shard cleanly — packing is columnwise); pad rows
+                # decode to bin 0 and carry zero weights, contributing
+                # nothing.  This is the ONLY pack of this dataset —
+                # PACK_DEVICE_BINS=False skipped the serial init's
+                # full-matrix default-device copy.
+                bins = dataset.packed_device_bins(self.pack_plan)
+            else:
+                bins = np.asarray(dataset.to_device_space(dataset.bins))
             if self.pad:
                 bins = np.pad(bins, ((0, self.pad), (0, 0)))
             self.sharded_bins = self._put(jnp.asarray(bins), row_sharding)
@@ -145,18 +157,19 @@ class DataParallelTreeLearner(SerialTreeLearner):
             mesh=self.mesh,
             in_specs=(P(ax, None), P(ax), P(ax), P(ax),  # bins, g, h, mask
                       P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                      P()),                              # hist_layout
+                      P(), P(), P()),        # hist_layout, pack_map, qbounds
             out_specs=jax.tree_util.tree_map(
                 lambda _: P(), _state_structure(cfg)
             )._replace(row_leaf=P() if mp else P(ax)))
         def sharded(bins, grad, hess, mask, nbf, hmf, fmask, mono, key, icf,
-                    bmap, igroups, gscale, gpen, hlayout):
+                    bmap, igroups, gscale, gpen, hlayout, pack_map, qbounds):
             from ..tree_learner import grow_tree_compact
             grow = (grow_tree_compact
                     if self.config.grow_strategy == "compact" else grow_tree)
             state = grow(cfg, bins, grad, hess, mask, nbf, hmf, fmask,
                          mono, key, icf, bmap, igroups, gscale, gpen,
-                         hist_layout=hlayout)
+                         hist_layout=hlayout, pack_map=pack_map,
+                         quant_bounds=qbounds)
             if mp:
                 # multi-host: replicate row_leaf so every process can read
                 # its full copy for the score update (one [N] allgather per
@@ -169,7 +182,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return sharded
 
     def train(self, grad, hess, sample_mask, iteration: int,
-              gain_penalty=None):
+              gain_penalty=None, quant_bounds=None):
         if self.rank_local:
             # scatter the [N] global vectors into the rank-block padded
             # layout (every process holds identical global score/grad
@@ -208,7 +221,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
             (None if gain_penalty is None
              else jax.device_put(gain_penalty, self._rep_sharding)),
             (None if self.hist_layout is None
-             else jax.device_put(self.hist_layout, self._rep_sharding)))
+             else jax.device_put(self.hist_layout, self._rep_sharding)),
+            (None if self.pack_map is None
+             else jax.device_put(self.pack_map, self._rep_sharding)),
+            (None if quant_bounds is None
+             else jax.device_put(quant_bounds, self._rep_sharding)))
         if self.multiprocess:
             # pull everything process-local so the booster can mix state
             # with its (non-mesh) score arrays

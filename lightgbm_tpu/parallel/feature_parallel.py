@@ -31,6 +31,7 @@ __all__ = ["FeatureParallelTreeLearner"]
 
 class FeatureParallelTreeLearner(SerialTreeLearner):
     AXIS = "feat"
+    PACK_BINS = False   # pack plan permutes GLOBAL columns; shards are slices
 
     def __init__(self, config, dataset):
         super().__init__(config, dataset)
@@ -47,11 +48,13 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         # bundle decode would couple shards, so run unbundled here.  The
         # histogram width-class plan is also cleared: it permutes GLOBAL
         # storage columns, but each shard's bins matrix is a local slice.
+        # The quantized engine is cleared the same way (its pack plan rides
+        # the width-class machinery); this learner trains plain f32.
         self.bmap = None
         self.hist_layout = None
         self.grower_cfg = self.grower_cfg._replace(
             axis_name=self.AXIS, parallel_mode="feature", use_efb=False,
-            hist_widths=())
+            hist_widths=(), quantized=False, pack_spec=())
 
         f = dataset.num_features
         self.fpad = (-f) % self.n_dev
@@ -132,7 +135,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         return sharded
 
     def train(self, grad, hess, sample_mask, iteration: int,
-              gain_penalty=None):
+              gain_penalty=None, quant_bounds=None):
+        # quant_bounds is accepted for booster-interface parity but unused:
+        # this learner cleared GrowerConfig.quantized, so the booster always
+        # passes None here
         key = self.iter_key(iteration)
         gpen_sh = None
         if gain_penalty is not None:

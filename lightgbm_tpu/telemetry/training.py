@@ -43,7 +43,7 @@ from . import spans
 from .registry import REGISTRY
 
 __all__ = ["TrainingTelemetry", "maybe_training_telemetry",
-           "compile_tracker", "PHASE_KEYS"]
+           "compile_tracker", "PHASE_KEYS", "hist_path_of"]
 
 PHASE_KEYS = ("grad_s", "grow_s", "hist_s", "split_s", "partition_s",
               "comm_s", "apply_s", "checkpoint_s")
@@ -108,6 +108,22 @@ def maybe_training_telemetry(config) -> Optional["TrainingTelemetry"]:
     return TrainingTelemetry()
 
 
+def hist_path_of(learner) -> str:
+    """Label of the ACTIVE histogram path, attached to every per-iteration
+    record and the staged probe so ``hist_s`` comparisons across configs
+    are never apples-to-oranges: ``f32``/``bf16`` (contraction input dtype)
+    for the standard engine, ``int16x32`` for fixed-point accumulation
+    (config ``quantized_histograms``), ``+packed`` appended when the device
+    bin matrix is sub-byte packed."""
+    cfg = learner.grower_cfg
+    if getattr(cfg, "quantized", False):
+        label = "int16x32"
+        if getattr(cfg, "pack_spec", ()):
+            label += "+packed"
+        return label
+    return "bf16" if cfg.hist_dtype == "bfloat16" else "f32"
+
+
 # ---------------------------------------------------------------------------
 # Staged probe: the dense-grower decomposition as separate jitted programs
 # ---------------------------------------------------------------------------
@@ -136,17 +152,30 @@ def _jits():
         return _STAGE
     import jax
     import jax.numpy as jnp
-    from ..ops.histogram import build_histogram
+    from ..ops.histogram import build_histogram, quantize_grad_hess
     from ..tree_learner import (_apply_split_bookkeeping, _child_weights,
                                 _init_tree_state, _scan_leaf, _store_best)
-    from ..ops.split import leaf_output
+    from ..ops.split import dequantize_hist, leaf_output
+
+    # quantized configs (hist_path int16x32[+packed]): the probe's weights
+    # are pre-quantized int16 and ``bins`` is the learner's ACTIVE matrix
+    # (the packed planes when packing is on), so hist_s times the real
+    # fixed-point contraction; histograms are dequantized on the way out so
+    # the split/partition stages run the shared f32 program.
+    @jax.jit
+    def quantize(grad_m, hess_m, mask, quant_bounds):
+        n_total = jnp.asarray(grad_m.shape[0], jnp.float32)
+        return quantize_grad_hess(grad_m, hess_m, mask, n_total,
+                                  quant_bounds)
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
-    def root_hist(cfg, bins, grad_m, hess_m, mask, hist_layout):
-        return build_histogram(
+    def root_hist(cfg, bins, grad_m, hess_m, mask, hist_layout, scale3):
+        h = build_histogram(
             bins, jnp.stack([grad_m, hess_m, mask], axis=1), cfg.num_bins,
             impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
-            layout=hist_layout, widths=cfg.hist_widths)
+            layout=hist_layout, widths=cfg.hist_widths,
+            pack_spec=cfg.pack_spec)
+        return dequantize_hist(h, scale3)
 
     @functools.partial(jax.jit, static_argnames=("cfg", "n", "f"))
     def root_scan(cfg, rhist, num_bins_f, has_missing_f, fmask, monotone,
@@ -163,7 +192,7 @@ def _jits():
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
     def partition(cfg, state, bins, num_bins_f, has_missing_f, monotone,
-                  bmap):
+                  bmap, pack_map):
         best_leaf = jnp.argmax(state.best_gain).astype(jnp.int32)
         gain = state.best_gain[best_leaf]
         new_leaf = state.n_leaves
@@ -173,14 +202,14 @@ def _jits():
         split_cat = (state.best_is_cat[best_leaf]
                      if cfg.use_categorical else jnp.asarray(False))
         cat_mask = state.best_cat_mask[best_leaf]
+        from ..ops.histogram import take_device_column
         if cfg.use_efb:
             from ..efb import decode_member_bin
-            col = jnp.take(bins, bmap.bundle_of_f[feat],
-                           axis=1).astype(jnp.int32)
+            col = take_device_column(bins, bmap.bundle_of_f[feat], pack_map)
             fcol = decode_member_bin(col, bmap.offset_of_f[feat],
                                      num_bins_f[feat])
         else:
-            fcol = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            fcol = take_device_column(bins, feat, pack_map)
         missing_bin = num_bins_f[feat] - 1
         is_missing = has_missing_f[feat] & (fcol == missing_bin)
         go_left = jnp.where(is_missing, dleft, fcol <= thr)
@@ -195,13 +224,15 @@ def _jits():
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
     def child_hists(cfg, bins, row_leaf, best_leaf, new_leaf, grad_m,
-                    hess_m, mask, hist_layout):
+                    hess_m, mask, hist_layout, scale3):
         left_m = (row_leaf == best_leaf).astype(grad_m.dtype)
         right_m = (row_leaf == new_leaf).astype(grad_m.dtype)
         h6 = build_histogram(
             bins, _child_weights(grad_m, hess_m, mask, left_m, right_m),
             cfg.num_bins, impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
-            layout=hist_layout, widths=cfg.hist_widths)
+            layout=hist_layout, widths=cfg.hist_widths,
+            pack_spec=cfg.pack_spec)
+        h6 = dequantize_hist(h6, scale3)
         return h6[..., 0:3], h6[..., 3:6]
 
     @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -225,7 +256,7 @@ def _jits():
 
     _STAGE = {"root_hist": root_hist, "root_scan": root_scan,
               "partition": partition, "child_hists": child_hists,
-              "scan": scan}
+              "scan": scan, "quantize": quantize}
     return _STAGE
 
 
@@ -247,7 +278,13 @@ def run_staged_probe(learner, grad, hess, mask,
     stage = _jits()
     ds = learner.dataset
     cfg = learner.grower_cfg._replace(parallel_mode="none", axis_name=None)
-    bins = ds.device_bins
+    # the learner's ACTIVE bin matrix: the packed byte planes when the
+    # quantized engine packed them, else the plain device matrix — hist_s
+    # must time the path production actually runs (hist_path_of labels it)
+    bins = getattr(learner, "train_bins", None)
+    if bins is None:
+        bins = ds.device_bins
+    pack_map = getattr(learner, "pack_map", None)
     n = int(bins.shape[0])
     f = int(np.asarray(ds.num_bins_per_feature).shape[0])
     # all-ones feature mask on purpose: calling learner.feature_mask()
@@ -256,6 +293,8 @@ def run_staged_probe(learner, grad, hess, mask,
     fmask = jnp.ones((f,), bool)
     grad_m = grad * mask
     hess_m = hess * mask
+    count_m = mask
+    scale3 = None
     layout = learner.hist_layout
     out = timings if timings is not None else {}
     for k in ("hist_s", "split_s", "partition_s"):
@@ -269,8 +308,13 @@ def run_staged_probe(learner, grad, hess, mask,
         out[key] += time.perf_counter() - t0
         return res
 
+    if cfg.quantized:
+        # the runtime-max bounds fallback keeps the probe self-contained
+        # (the booster's objective-derived bounds only tighten the scale)
+        grad_m, hess_m, count_m, scale3, _clips = timed_call(
+            "hist_s", stage["quantize"], grad_m, hess_m, mask, None)
     rhist = timed_call("hist_s", stage["root_hist"], cfg, bins, grad_m,
-                       hess_m, mask, layout)
+                       hess_m, count_m, layout, scale3)
     state = timed_call("split_s", stage["root_scan"], cfg, rhist,
                        ds.num_bins_per_feature, ds.has_missing_per_feature,
                        fmask, learner.monotone, learner.is_cat_f,
@@ -281,10 +325,10 @@ def run_staged_probe(learner, grad, hess, mask,
         state, bl, nl = timed_call(
             "partition_s", stage["partition"], cfg, state, bins,
             ds.num_bins_per_feature, ds.has_missing_per_feature,
-            learner.monotone, learner.bmap)
+            learner.monotone, learner.bmap, pack_map)
         hist_l, hist_r = timed_call(
             "hist_s", stage["child_hists"], cfg, bins, state.row_leaf, bl,
-            nl, grad_m, hess_m, mask, layout)
+            nl, grad_m, hess_m, count_m, layout, scale3)
         state = timed_call(
             "split_s", stage["scan"], cfg, state, hist_l, hist_r, bl, nl,
             ds.num_bins_per_feature, ds.has_missing_per_feature, fmask,
@@ -336,6 +380,9 @@ class TrainingTelemetry:
         self.records: List[Dict] = []
         self.probe_enabled = probe
         self.probe_every = max(int(probe_every), 1)
+        # ACTIVE histogram-path label (hist_path_of): set by the booster
+        # once the learner exists; stamped on every record + the summary
+        self.hist_path: Optional[str] = None
         self._cur: Optional[Dict] = None
         self._t0 = 0.0
         self._span_cm = None
@@ -357,6 +404,7 @@ class TrainingTelemetry:
                      "grad_s": 0.0, "grow_s": 0.0, "apply_s": 0.0,
                      "comm_s": 0.0, "checkpoint_s": 0.0,
                      "hist_s": None, "split_s": None, "partition_s": None,
+                     "hist_path": self.hist_path,
                      "_cc": cc, "_cs": cs}
         self._t0 = time.perf_counter()
         self._span_cm = spans.span("train::iteration", iteration=iteration)
@@ -460,6 +508,7 @@ class TrainingTelemetry:
 
         for key in ("iter_s",) + PHASE_KEYS:
             out[key] = mean(key)
+        out["hist_path"] = self.hist_path
         out["compile_count"] = sum(int(r.get("compile_count") or 0)
                                    for r in recs)
         out["compile_s"] = round(sum(float(r.get("compile_s") or 0.0)

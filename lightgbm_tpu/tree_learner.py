@@ -29,10 +29,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from .efb import BundleMap, expand_bundle_hist
-from .ops.histogram import (HistLayout, build_histogram, plan_width_classes,
-                            resolve_impl)
-from .ops.split import (SplitResult, find_best_split, leaf_output, leaf_gain,
-                        K_EPSILON)
+from .ops.histogram import (HistLayout, PackMap, build_histogram,
+                            plan_packed_classes, plan_width_classes,
+                            quantize_grad_hess, resolve_impl,
+                            take_device_column)
+from .ops.split import (SplitResult, dequantize_hist, find_best_split,
+                        leaf_output, leaf_gain, K_EPSILON)
 from .tree import Tree
 
 __all__ = ["GrowerConfig", "TreeState", "grow_tree", "SerialTreeLearner",
@@ -59,6 +61,17 @@ class GrowerConfig(NamedTuple):
     # single global-num_bins contraction.  The matching HistLayout rides as a
     # traced grower argument (device arrays can't live in the static config).
     hist_widths: tuple = ()
+    # quantized histogram engine (config quantized_histograms): int16
+    # per-row (grad, hess) with int32 accumulation, dequantized only at
+    # split-scan time (ops/histogram.quantize_grad_hess / ops/split.
+    # dequantize_hist).  The per-iteration scale and clip count are TRACED
+    # values; only the on/off switch is static.
+    quantized: bool = False
+    # packed sub-byte bin storage (ops/histogram.plan_packed_classes):
+    # static (class_width, bits, n_cols, n_planes) runs — the grower's bins
+    # argument is then the packed byte-plane matrix and the matching
+    # PackMap rides as a traced argument next to hist_layout.
+    pack_spec: tuple = ()
     # distributed mode under shard_map (reference 4-mode learner factory,
     # src/treelearner/tree_learner.cpp):
     #   "none"    serial single-device
@@ -146,6 +159,10 @@ class TreeState(NamedTuple):
     # trees by the booster (reference feature_used_in_data_ bitset,
     # cost_effective_gradient_boosting.hpp:60); [0, 0] when lazy is off
     cegb_used: jnp.ndarray       # [N, F] bool (or [0, 0] placeholder)
+    # quantized engine: rows whose (grad, hess) hit the quantization clip
+    # range this tree (0 off the quantized path / with runtime-max scales);
+    # the booster drains it into lgbm_hist_grad_clip_total
+    quant_clips: jnp.ndarray     # scalar int32
 
 
 class ForcedSplits(NamedTuple):
@@ -231,7 +248,7 @@ def parse_forced_splits(spec, dataset, max_splits: int):
 def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
                          num_bins_f, has_missing_f,
                          bmap: Optional[BundleMap],
-                         f_is_cat=None) -> SplitResult:
+                         f_is_cat=None, hist_scale=None) -> SplitResult:
     """Gather split sums at a forced (feature, threshold-bin) from the leaf's
     pooled histogram — reference GatherInfoForThresholdNumerical
     (feature_histogram.hpp:546-632): the right side accumulates bins above
@@ -239,6 +256,7 @@ def _forced_split_result(cfg: GrowerConfig, pool_hist, sums, f_feat, f_thr,
     lands left; ``output->default_left = true`` unconditionally).
     Categorical entries are one-hot splits: the single category bin
     ``f_thr`` goes left (GatherInfoForThresholdCategorical, :648-710)."""
+    pool_hist = dequantize_hist(pool_hist, hist_scale)
     if cfg.use_efb:
         hist = expand_bundle_hist(pool_hist, sums, bmap, num_bins_f,
                                   cfg.num_bins)
@@ -307,7 +325,11 @@ def _scan_leaf(hist, sums, depth, cfg: GrowerConfig, num_bins_f, has_missing_f,
                feature_mask, monotone, is_cat_f=None,
                bmap: Optional[BundleMap] = None,
                bounds=None, gain_scale_f=None, gain_penalty_f=None,
-               rand_bin_f=None) -> SplitResult:
+               rand_bin_f=None, hist_scale=None) -> SplitResult:
+    # quantized engine: the int32 fixed-point histogram meets the f32 gain
+    # math exactly here (ops/split.dequantize_hist) — EFB expansion and the
+    # scan below run unchanged on the dequantized values
+    hist = dequantize_hist(hist, hist_scale)
     if cfg.use_efb:
         # bundle-space histogram -> per-member-feature histograms; the
         # leaf's own (g,h,c) totals reconstruct each member's zero bin
@@ -392,6 +414,7 @@ def _init_tree_state(cfg: GrowerConfig, n: int, fdt, root_out,
         node_is_cat=jnp.zeros((L - 1,), bool),
         node_cat_mask=jnp.zeros((L - 1, B), bool),
         cegb_used=jnp.zeros((0, 0), bool),
+        quant_clips=jnp.zeros((), jnp.int32),
     )
 
 
@@ -549,6 +572,8 @@ def grow_tree(cfg: GrowerConfig,
               gain_scale_f: Optional[jnp.ndarray] = None,   # feature_contri
               gain_penalty_f: Optional[jnp.ndarray] = None,  # CEGB
               hist_layout: Optional[HistLayout] = None,  # width-class perm
+              pack_map: Optional[PackMap] = None,   # packed-bin decode map
+              quant_bounds: Optional[jnp.ndarray] = None,  # [2] (g, h) bound
               ) -> TreeState:
     """Grow one tree; returns the final TreeState (all device arrays)."""
     n = bins.shape[0]
@@ -559,11 +584,26 @@ def grow_tree(cfg: GrowerConfig,
 
     grad_m = grad * sample_mask
     hess_m = hess * sample_mask
+    count_m = sample_mask
+    hist_scale = None
+    clips = jnp.zeros((), jnp.int32)
+    if cfg.quantized:
+        # per-iteration int16 quantization; the accumulator headroom limit
+        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap
+        n_total = jnp.asarray(n, jnp.float32)
+        if ax is not None:
+            n_total = jax.lax.psum(n_total, ax)
+        grad_m, hess_m, count_m, hist_scale, clips = quantize_grad_hess(
+            grad_m, hess_m, sample_mask, n_total, quant_bounds,
+            axis_name=ax)
+        if ax is not None:
+            clips = jax.lax.psum(clips, ax)
 
     def hist_of(weights):
         h = build_histogram(bins, weights, B, impl=cfg.hist_impl,
                             hist_dtype=cfg.hist_dtype,
-                            layout=hist_layout, widths=cfg.hist_widths)
+                            layout=hist_layout, widths=cfg.hist_widths,
+                            pack_spec=cfg.pack_spec)
         if ax is not None:
             h = jax.lax.psum(h, ax)  # reference: Network::ReduceScatter of
             # histograms (data_parallel_tree_learner.cpp:184); psum over ICI
@@ -597,14 +637,16 @@ def grow_tree(cfg: GrowerConfig,
         return (u * (num_bins_f - 1).astype(u.dtype)).astype(jnp.int32)
 
     # ---- root ----------------------------------------------------------
-    root_hist = hist_of(jnp.stack([grad_m, hess_m, sample_mask], axis=1))
-    root_sums = root_hist[0].sum(axis=0)  # feature 0's bins cover every row once
+    root_hist = hist_of(jnp.stack([grad_m, hess_m, count_m], axis=1))
+    # feature 0's bins cover every row once
+    root_sums = dequantize_hist(root_hist[0].sum(axis=0), hist_scale)
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
     if is_cat_f is None:
         is_cat_f = jnp.zeros((f,), bool)
     fdt = grad.dtype
     state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f)
+    state = state._replace(quant_clips=clips)
     root_res = _scan_leaf(root_hist, root_sums, jnp.int32(0), cfg, num_bins_f,
                           has_missing_f,
                           interaction_mask(state.leaf_used[0],
@@ -612,7 +654,7 @@ def grow_tree(cfg: GrowerConfig,
                           monotone, is_cat_f, bmap,
                           gain_scale_f=gain_scale_f,
                           gain_penalty_f=gain_penalty_f,
-                          rand_bin_f=extra_bins(0))
+                          rand_bin_f=extra_bins(0), hist_scale=hist_scale)
     state = _store_best(state, 0, root_res)
 
     def body(step, state: TreeState) -> TreeState:
@@ -632,12 +674,12 @@ def grow_tree(cfg: GrowerConfig,
             # -- partition (reference DataPartition::Split; here O(N) where)
             if cfg.use_efb:
                 from .efb import decode_member_bin
-                col = jnp.take(bins, bmap.bundle_of_f[feat],
-                               axis=1).astype(jnp.int32)
+                col = take_device_column(bins, bmap.bundle_of_f[feat],
+                                         pack_map)
                 fcol = decode_member_bin(col, bmap.offset_of_f[feat],
                                          num_bins_f[feat])
             else:
-                fcol = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+                fcol = take_device_column(bins, feat, pack_map)
             missing_bin = num_bins_f[feat] - 1
             is_missing = has_missing_f[feat] & (fcol == missing_bin)
             go_left = jnp.where(is_missing, dleft, fcol <= thr)
@@ -655,7 +697,7 @@ def grow_tree(cfg: GrowerConfig,
             #    subtraction trick, see module docstring)
             left_m = (row_leaf == best_leaf).astype(grad_m.dtype)
             right_m = (row_leaf == new_leaf).astype(grad_m.dtype)
-            w6 = _child_weights(grad_m, hess_m, sample_mask, left_m, right_m)
+            w6 = _child_weights(grad_m, hess_m, count_m, left_m, right_m)
             h6 = hist_of(w6)                       # [F, B, 6]
             hist_l = h6[..., 0:3]
             hist_r = h6[..., 3:6]
@@ -669,14 +711,16 @@ def grow_tree(cfg: GrowerConfig,
                                bounds=(new_state.leaf_lo[best_leaf],
                                        new_state.leaf_hi[best_leaf]),
                                gain_scale_f=gain_scale_f,
-                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb)
+                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb,
+                               hist_scale=hist_scale)
             res_r = _scan_leaf(hist_r, new_state.leaf_sum[new_leaf], depth,
                                cfg, num_bins_f, has_missing_f, fmask, monotone,
                                is_cat_f, bmap,
                                bounds=(new_state.leaf_lo[new_leaf],
                                        new_state.leaf_hi[new_leaf]),
                                gain_scale_f=gain_scale_f,
-                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb)
+                               gain_penalty_f=gain_penalty_f, rand_bin_f=rb,
+                               hist_scale=hist_scale)
             new_state = _store_best(new_state, best_leaf, res_l)
             new_state = _store_best(new_state, new_leaf, res_r)
             return new_state
@@ -775,6 +819,8 @@ def grow_tree_compact(cfg: GrowerConfig,
                       lazy_pen_f: Optional[jnp.ndarray] = None,
                       used_init: Optional[jnp.ndarray] = None,
                       hist_layout: Optional[HistLayout] = None,
+                      pack_map: Optional[PackMap] = None,
+                      quant_bounds: Optional[jnp.ndarray] = None,
                       ) -> TreeState:
     """Grow one tree with the partition-order strategy; same TreeState out.
 
@@ -784,7 +830,8 @@ def grow_tree_compact(cfg: GrowerConfig,
     replicated — split bookkeeping indexes them with the globally-agreed
     winning feature id (the reference shares the serial learner's
     constraint state across all parallel learners the same way)."""
-    n, g = bins.shape            # g = storage columns (bundles under EFB)
+    n, g = bins.shape            # g = PHYSICAL storage columns: bundles
+    #                              under EFB, packed byte planes when packed
     f = num_bins_f.shape[0]      # original feature count
     L = cfg.num_leaves
     B = cfg.num_bins
@@ -793,6 +840,21 @@ def grow_tree_compact(cfg: GrowerConfig,
 
     grad_m = grad * sample_mask
     hess_m = hess * sample_mask
+    count_m = sample_mask
+    hist_scale = None
+    clips = jnp.zeros((), jnp.int32)
+    if cfg.quantized:
+        # per-iteration int16 quantization; the accumulator headroom limit
+        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap
+        n_total = jnp.asarray(n, jnp.float32)
+        if ax is not None:
+            n_total = jax.lax.psum(n_total, ax)
+        grad_m, hess_m, count_m, hist_scale, clips = quantize_grad_hess(
+            grad_m, hess_m, sample_mask, n_total, quant_bounds,
+            axis_name=ax)
+        if ax is not None:
+            clips = jax.lax.psum(clips, ax)
+    wdt = grad_m.dtype           # weight dtype: f32, or int16 when quantized
     if is_cat_f is None:
         is_cat_f = jnp.zeros((f,), bool)
 
@@ -800,6 +862,14 @@ def grow_tree_compact(cfg: GrowerConfig,
     bucket_arr = jnp.asarray(buckets, jnp.int32)
     max_bucket = buckets[-1]
     bins_flat = bins.reshape(-1)  # keep uint8: gather then widen (4x less HBM)
+
+    def col_bin_at(rows, col):
+        """[rows] int32 bin of logical device column ``col`` — flat-gather
+        counterpart of ops/histogram.take_device_column (packed-aware)."""
+        if pack_map is None:
+            return bins_flat[rows * g + col].astype(jnp.int32)
+        v = bins_flat[rows * g + pack_map.byte_col[col]].astype(jnp.int32)
+        return (v >> pack_map.shift[col]) & pack_map.mask[col]
 
     mode = cfg.parallel_mode if ax is not None else "none"
 
@@ -850,7 +920,7 @@ def grow_tree_compact(cfg: GrowerConfig,
                           fmask, monotone, is_cat_f, bmap, bounds,
                           gain_scale_f,
                           gain_penalty_f if pen_f is None else pen_f,
-                          rand_bin)
+                          rand_bin, hist_scale=hist_scale)
 
     def scan_feature_parallel(hist_local, sums, depth, fmask, bounds=None,
                               rand_bin=None):
@@ -869,6 +939,10 @@ def grow_tree_compact(cfg: GrowerConfig,
         # PV-Tree (reference VotingParallelTreeLearner): local proposals ->
         # allgather -> global vote -> reduce ONLY the elected features'
         # histograms -> global scan (voting_parallel_tree_learner.cpp:151-344)
+        # quantized: the local pool slice is int32 fixed point; dequantize
+        # here so the proposal gains and the elected-feature psum run in the
+        # f32 scan space (the pool/subtraction stay exact ints)
+        hist_local = dequantize_hist(hist_local, hist_scale)
         inner_cfg = cfg
         if cfg.use_efb:
             local_sums = hist_local[0].sum(axis=0)
@@ -936,15 +1010,20 @@ def grow_tree_compact(cfg: GrowerConfig,
     # ---- root ----------------------------------------------------------
     with jax.named_scope("grow::hist"):
         root_hist = psum_(build_histogram(
-            bins, jnp.stack([grad_m, hess_m, sample_mask], axis=1), B,
+            bins, jnp.stack([grad_m, hess_m, count_m], axis=1), B,
             impl=cfg.hist_impl, hist_dtype=cfg.hist_dtype,
-            layout=hist_layout, widths=cfg.hist_widths))
-    root_sums = root_hist[0].sum(axis=0)
+            layout=hist_layout, widths=cfg.hist_widths,
+            pack_spec=cfg.pack_spec))
+    g_hist = root_hist.shape[0]  # LOGICAL device columns (g counts packed
+    #                              byte planes when the matrix is packed)
+    root_tot = root_hist[0].sum(axis=0)
     if mode == "voting":
-        root_sums = jax.lax.psum(root_sums, ax)
+        root_tot = jax.lax.psum(root_tot, ax)
+    root_sums = dequantize_hist(root_tot, hist_scale)
     root_out = leaf_output(root_sums[0], root_sums[1], cfg.lambda_l1,
                            cfg.lambda_l2, cfg.max_delta_step)
     state = _init_tree_state(cfg, n, fdt, root_out, root_sums, f_used)
+    state = state._replace(quant_clips=clips)
     root_kw = {}
     if use_lazy:
         nu_root = ((~used0) & bagged[:, None]).sum(0).astype(jnp.float32)
@@ -958,8 +1037,12 @@ def grow_tree_compact(cfg: GrowerConfig,
     # histogram pool (reference HistogramPool, feature_histogram.hpp:1095;
     # here a dense [L, G, B, 3] HBM array — no LRU needed, HBM is the pool;
     # under EFB the pool and the subtraction trick stay in (narrower)
-    # bundle space, expansion happens per scan)
-    pool = jnp.zeros((L, g, B, 3), jnp.float32).at[0].set(root_hist)
+    # bundle space, expansion happens per scan).  Quantized: the pool holds
+    # int32 fixed point, so parent - child subtraction is EXACT — no f32
+    # cancellation drift — and dequantization waits for the scan.
+    pool = jnp.zeros((L, g_hist, B, 3),
+                     jnp.int32 if cfg.quantized else jnp.float32
+                     ).at[0].set(root_hist)
     order = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                              jnp.zeros((max_bucket,), jnp.int32)])
     leaf_start = jnp.zeros((L,), jnp.int32)
@@ -995,7 +1078,7 @@ def grow_tree_compact(cfg: GrowerConfig,
                 res_local = _forced_split_result(
                     cfg, pool[f_leaf], state.leaf_sum[f_leaf], lf,
                     forced.thr[si], num_bins_f, has_missing_f, bmap,
-                    f_is_cat=forced.is_cat[si])
+                    f_is_cat=forced.is_cat[si], hist_scale=hist_scale)
                 is_owner = me == owner
 
                 def _bcast(x):
@@ -1013,7 +1096,8 @@ def grow_tree_compact(cfg: GrowerConfig,
                                              state.leaf_sum[f_leaf],
                                              forced.feat[si], forced.thr[si],
                                              num_bins_f, has_missing_f, bmap,
-                                             f_is_cat=forced.is_cat[si])
+                                             f_is_cat=forced.is_cat[si],
+                                             hist_scale=hist_scale)
             # reference gate (feature_histogram.hpp:606): a forced split
             # whose gain is not positive is "ignored since the gain getting
             # worse", which then aborts the remaining schedule
@@ -1061,7 +1145,7 @@ def grow_tree_compact(cfg: GrowerConfig,
                     lf = jnp.clip(feat - owner * jnp.int32(f), 0, f - 1)
                     mb = num_bins_f[lf] - 1
                     fmiss = has_missing_f[lf]
-                    fbin = bins_flat[rows * g + lf].astype(jnp.int32)
+                    fbin = col_bin_at(rows, lf)
                     gl = jnp.where(fmiss & (fbin == mb), dleft, fbin <= thr)
                     if cfg.use_categorical:
                         gl = jnp.where(split_cat, cat_mask[fbin], gl)
@@ -1071,12 +1155,11 @@ def grow_tree_compact(cfg: GrowerConfig,
                 fm = has_missing_f[feat]
                 if cfg.use_efb:
                     from .efb import decode_member_bin
-                    bb = bins_flat[rows * g +
-                                   bmap.bundle_of_f[feat]].astype(jnp.int32)
+                    bb = col_bin_at(rows, bmap.bundle_of_f[feat])
                     fbin = decode_member_bin(bb, bmap.offset_of_f[feat],
                                              num_bins_f[feat])
                 else:
-                    fbin = bins_flat[rows * g + feat].astype(jnp.int32)
+                    fbin = col_bin_at(rows, feat)
                 gl = jnp.where(fm & (fbin == missing_bin), dleft, fbin <= thr)
                 if cfg.use_categorical:
                     gl = jnp.where(split_cat, cat_mask[fbin], gl)
@@ -1142,16 +1225,17 @@ def grow_tree_compact(cfg: GrowerConfig,
             def hist_child(kp: int):
                 with jax.named_scope("grow::gather"):
                     rows = jax.lax.dynamic_slice(order, (s_h,), (kp,))
-                    validh = (jnp.arange(kp, dtype=jnp.int32) < k_h).astype(fdt)
+                    validh = (jnp.arange(kp, dtype=jnp.int32) < k_h).astype(wdt)
                     w = jnp.stack([grad_m[rows], hess_m[rows],
-                                   sample_mask[rows]], axis=1) * validh[:, None]
+                                   count_m[rows]], axis=1) * validh[:, None]
                     child_bins = bins[rows]
                 with jax.named_scope("grow::hist"):
                     return build_histogram(child_bins, w, B,
                                            impl=cfg.hist_impl,
                                            hist_dtype=cfg.hist_dtype,
                                            layout=hist_layout,
-                                           widths=cfg.hist_widths)
+                                           widths=cfg.hist_widths,
+                                           pack_spec=cfg.pack_spec)
 
             hidx = jnp.searchsorted(bucket_arr, k_h, side="left")
             hist_small = psum_(jax.lax.switch(
@@ -1353,6 +1437,17 @@ class SerialTreeLearner:
     warm after the first tree.
     """
 
+    # sub-byte bin packing opt-in (quantized engine): feature-parallel
+    # clears it — the pack plan permutes GLOBAL storage columns, which a
+    # column-sharded bins matrix doesn't match (same reason it clears
+    # hist_widths)
+    PACK_BINS = True
+    # whether packing also materializes the full packed matrix on the
+    # default device as train_bins; the data/voting learners clear it and
+    # build their own ROW-SHARDED placement from pack_plan instead (one
+    # pack, no discarded full-matrix HBM copy)
+    PACK_DEVICE_BINS = True
+
     def __init__(self, config, dataset):
         from .dataset import TrainDataset
         self.config = config
@@ -1394,6 +1489,41 @@ class SerialTreeLearner:
             self.hist_layout, widths = plan_width_classes(
                 dataset.device_col_num_bins, dataset.max_num_bins)
             self.grower_cfg = self.grower_cfg._replace(hist_widths=widths)
+        # quantized histogram engine (config quantized_histograms): int16
+        # (grad, hess) with int32 accumulation for every impl, plus sub-byte
+        # bin packing when the impl's FLOPs scale with operand size (same
+        # segment-impl gate as the width plan: scatter-add gains nothing
+        # from narrower inputs) and the matrix is byte-backed.  The packed
+        # plan REPLACES the width plan's layout — same contraction classes,
+        # its own column order (sub-byte runs grouped) — and the matrix +
+        # decode map ride as jit ARGUMENTS, never closure constants (the
+        # PR 6 HLO-constant-inlining bug class).
+        self.pack_map = None
+        self.pack_plan = None                   # host PackPlan (subclasses
+        #                                         repack their own placement)
+        self.train_bins = dataset.device_bins   # None for rank-local shards
+        if getattr(config, "quantized_histograms", False):
+            self.grower_cfg = self.grower_cfg._replace(quantized=True)
+            if (self.PACK_BINS
+                    and resolve_impl(config.histogram_impl) != "segment"
+                    and getattr(config, "histogram_width_classes", True)
+                    and dataset.device_bins is not None
+                    and dataset.device_bins.dtype == jnp.uint8
+                    and getattr(dataset, "device_col_num_bins", None)
+                    is not None):
+                plan = plan_packed_classes(dataset.device_col_num_bins,
+                                           dataset.max_num_bins)
+                if plan is not None:
+                    self.pack_plan = plan
+                    self.hist_layout = plan.layout
+                    self.grower_cfg = self.grower_cfg._replace(
+                        hist_widths=plan.widths, pack_spec=plan.pack_spec)
+                    self.train_bins = (
+                        jnp.asarray(dataset.packed_device_bins(plan))
+                        if self.PACK_DEVICE_BINS else None)
+                    self.pack_map = PackMap(jnp.asarray(plan.byte_col),
+                                            jnp.asarray(plan.shift),
+                                            jnp.asarray(plan.mask))
         self._rng = np.random.RandomState(config.feature_fraction_seed)
         mono = np.zeros(dataset.num_features, np.int8)
         if config.monotone_constraints:
@@ -1530,7 +1660,8 @@ class SerialTreeLearner:
         return jax.random.PRNGKey(self.config.feature_fraction_seed * 7919 +
                                   iteration)
 
-    def grow_traced(self, grad, hess, sample_mask, feature_mask, key):
+    def grow_traced(self, grad, hess, sample_mask, feature_mask, key,
+                    quant_bounds=None):
         """Traceable grower call — usable inside an outer jit (the fused
         boosting step, gbdt.py) as well as standalone."""
         ds = self.dataset
@@ -1539,15 +1670,16 @@ class SerialTreeLearner:
         kw = {}
         if self.config.grow_strategy == "compact":
             kw["forced"] = self.forced
-        return grow(self.grower_cfg, ds.device_bins, grad, hess,
+        return grow(self.grower_cfg, self.train_bins, grad, hess,
                     sample_mask, ds.num_bins_per_feature,
                     ds.has_missing_per_feature, feature_mask,
                     self.monotone, key, self.is_cat_f, self.bmap,
                     self.igroups, self.gain_scale, None,
-                    hist_layout=self.hist_layout, **kw)
+                    hist_layout=self.hist_layout, pack_map=self.pack_map,
+                    quant_bounds=quant_bounds, **kw)
 
     def train(self, grad, hess, sample_mask, iteration: int,
-              gain_penalty=None):
+              gain_penalty=None, quant_bounds=None):
         ds = self.dataset
         key = self.iter_key(iteration)
         grow = (grow_tree_compact_jit
@@ -1558,12 +1690,13 @@ class SerialTreeLearner:
             if self.cegb_lazy_pen is not None:
                 kw["lazy_pen_f"] = self.cegb_lazy_pen
                 kw["used_init"] = self._cegb_used
-        state = grow(self.grower_cfg, ds.device_bins, grad, hess,
+        state = grow(self.grower_cfg, self.train_bins, grad, hess,
                      sample_mask, ds.num_bins_per_feature,
                      ds.has_missing_per_feature, self.feature_mask(),
                      self.monotone, key, self.is_cat_f, self.bmap,
                      self.igroups, self.gain_scale, gain_penalty,
-                     hist_layout=self.hist_layout, **kw)
+                     hist_layout=self.hist_layout, pack_map=self.pack_map,
+                     quant_bounds=quant_bounds, **kw)
         if self.cegb_lazy_pen is not None:
             # carry the used-rows matrix to the next tree (reference
             # feature_used_in_data_ persists across iterations)

@@ -1,0 +1,311 @@
+"""Quantized histogram engine: packed bins + fixed-point accumulation.
+
+ISSUE 9: ``quantized_histograms`` quantizes per-row (grad, hess) to int16
+with a per-iteration scale, accumulates histograms in int32, packs <=16-bin
+device columns sub-byte, and dequantizes only at split-scan time.  Split
+decisions on this path match the f32 engine only within quantization
+precision, so model parity is asserted as HELD-OUT AUC DELTA BOUNDS and a
+split-decision agreement rate — never bit-identity (the documented
+deviation class for this knob; contrast test_hist_width.py, where f32
+impls ARE bit-identical).
+
+Tier-1 budget note: the fast set covers every layer with unit-sized
+inputs — pack/unpack round trip, packed-vs-unpacked histogram equality
+(exact: both paths accumulate the same int32 values), quantizer scale/clip
+math, one small end-to-end parity train, and the closure-constant guard.
+The plain/bagging/GOSS x AUC/agreement parity matrix on the standard
+fixture is `slow`-demoted: it re-trains six boosters, and its failure
+modes (scale derivation, dequantize seam, sampling interplay) are already
+pinned by the fast end-to-end test on the same code path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.histogram import (build_histogram, pack_bins,
+                                        plan_packed_classes,
+                                        quantize_grad_hess,
+                                        take_device_column)
+
+RNG = np.random.RandomState(7)
+
+
+def _mixed_bins(rng, n, col_nb):
+    return np.stack([rng.randint(0, nb, size=n) for nb in col_nb],
+                    axis=1).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Packed sub-byte storage
+# ---------------------------------------------------------------------------
+def test_pack_roundtrip_mixed_widths():
+    """2-bit, 4-bit and full-byte columns interleaved: every logical device
+    column decodes from the packed planes to its original bins."""
+    col_nb = [3, 16, 4, 64, 9, 2, 256, 13, 4, 100]
+    bins = _mixed_bins(RNG, 257, col_nb)
+    plan = plan_packed_classes(np.asarray(col_nb), 256)
+    assert plan is not None
+    packed = pack_bins(bins, plan)
+    assert packed.dtype == np.uint8
+    # sub-byte packing must shrink the matrix (4x 2-bit + 3x 4-bit columns)
+    assert packed.shape[1] < bins.shape[1]
+    pm = jax.tree_util.tree_map(jnp.asarray, _pack_map_of(plan))
+    for col in range(bins.shape[1]):
+        got = np.asarray(take_device_column(jnp.asarray(packed), col, pm))
+        np.testing.assert_array_equal(got, bins[:, col].astype(np.int32))
+    # unpacked matrices pass through take_device_column untouched
+    got = np.asarray(take_device_column(jnp.asarray(bins), 3, None))
+    np.testing.assert_array_equal(got, bins[:, 3].astype(np.int32))
+
+
+def _pack_map_of(plan):
+    from lightgbm_tpu.ops.histogram import PackMap
+    return PackMap(jnp.asarray(plan.byte_col), jnp.asarray(plan.shift),
+                   jnp.asarray(plan.mask))
+
+
+def test_all_wide_columns_returns_none():
+    # nothing sub-byte to pack: the plain width plan is strictly better
+    assert plan_packed_classes(np.asarray([64, 256, 100]), 256) is None
+
+
+@pytest.mark.parametrize("impl", ["segment", "onehot"])
+def test_packed_histogram_matches_unpacked_exactly(impl):
+    """Same int16 weights through the packed and unpacked matrices: the
+    int32 histograms must agree BITWISE (packing changes storage, not
+    arithmetic), scattered back to storage-column order."""
+    col_nb = [4, 16, 3, 40, 16, 2, 200]
+    n = 503
+    bins = _mixed_bins(RNG, n, col_nb)
+    plan = plan_packed_classes(np.asarray(col_nb), 256)
+    packed = pack_bins(bins, plan)
+    w = RNG.randint(-300, 300, size=(n, 3)).astype(np.int16)
+    href = build_histogram(jnp.asarray(bins), jnp.asarray(w), 256, impl=impl)
+    hq = build_histogram(jnp.asarray(packed), jnp.asarray(w), 256, impl=impl,
+                         layout=plan.layout, widths=plan.widths,
+                         pack_spec=plan.pack_spec)
+    assert hq.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(hq), np.asarray(href))
+
+
+# ---------------------------------------------------------------------------
+# Fixed-point quantizer
+# ---------------------------------------------------------------------------
+def test_quantizer_scale_and_exact_counts():
+    n = 1000
+    g = RNG.randn(n).astype(np.float32)
+    h = np.abs(RNG.randn(n)).astype(np.float32)
+    mask = (RNG.rand(n) < 0.7).astype(np.float32)
+    gq, hq, cq, scale3, clips = quantize_grad_hess(
+        jnp.asarray(g * mask), jnp.asarray(h * mask), jnp.asarray(mask),
+        jnp.float32(n))
+    assert gq.dtype == jnp.int16 and hq.dtype == jnp.int16
+    # runtime-max bounds never clip
+    assert int(clips) == 0
+    # count channel is the exact 0/1 bag membership (scale 1.0)
+    np.testing.assert_array_equal(np.asarray(cq), mask.astype(np.int16))
+    assert float(scale3[2]) == 1.0
+    # dequantized rows within half a quantization step of the truth
+    s = np.asarray(scale3)
+    np.testing.assert_allclose(np.asarray(gq) * s[0], g * mask,
+                               atol=float(s[0]) * 0.5 + 1e-12)
+    np.testing.assert_allclose(np.asarray(hq) * s[1], h * mask,
+                               atol=float(s[1]) * 0.5 + 1e-12)
+    # hess is one-sided: no negative quantized values
+    assert int(jnp.min(hq)) >= 0
+
+
+def test_quantizer_clips_beyond_supplied_bounds():
+    g = jnp.asarray([0.5, -3.0, 0.1, 2.5], jnp.float32)
+    h = jnp.asarray([0.2, 0.1, 5.0, 0.0], jnp.float32)
+    ones = jnp.ones((4,), jnp.float32)
+    gq, hq, _cq, scale3, clips = quantize_grad_hess(
+        g, h, ones, jnp.float32(4), bounds=jnp.asarray([1.0, 1.0]))
+    assert int(clips) == 3          # rows 1, 2 and 3's |g|>1 / h>1
+    # clipped rows saturate at the bound, not wrap
+    s = np.asarray(scale3)
+    assert np.isclose(float(gq[1]) * s[0], -1.0, rtol=1e-3)
+    assert np.isclose(float(hq[2]) * s[1], 1.0, rtol=1e-3)
+
+
+def test_negative_hessian_counts_as_clip():
+    """A custom objective's locally-negative hessian is clamped to the
+    one-sided range — the clamp must be VISIBLE in the clip count, not a
+    silent curvature change."""
+    g = jnp.zeros((4,), jnp.float32)
+    h = jnp.asarray([0.5, -0.3, 0.2, -0.9], jnp.float32)
+    ones = jnp.ones((4,), jnp.float32)
+    _gq, hq, _cq, _s, clips = quantize_grad_hess(g, h, ones, jnp.float32(4))
+    assert int(clips) == 2          # the two negative-hess rows
+    assert int(jnp.min(hq)) >= 0    # clamped, never wrapped into int16
+
+
+def test_headroom_limit_shrinks_with_row_count():
+    """A bin receiving every row must fit int32: at huge N the per-row
+    limit drops below int16's range."""
+    n = 2_000_000
+    g = jnp.ones((8,), jnp.float32)
+    gq, hq, _c, scale3, _ = quantize_grad_hess(
+        g, g, jnp.ones((8,), jnp.float32), jnp.float32(n))
+    limit = float(jnp.max(jnp.abs(gq)))
+    assert limit <= (2.0 ** 31 - 1) / n + 1
+    assert limit * n < 2.0 ** 31
+
+
+# ---------------------------------------------------------------------------
+# End-to-end parity (AUC-bounded, the documented deviation class)
+# ---------------------------------------------------------------------------
+def _split_agreement(models_a, models_b):
+    """Fraction of internal nodes (paired by tree + creation order) where
+    both models chose the same (feature, threshold)."""
+    same = total = 0
+    for ta, tb in zip(models_a, models_b):
+        k = min(ta.num_leaves, tb.num_leaves) - 1
+        for i in range(k):
+            total += 1
+            if (ta.split_feature[i] == tb.split_feature[i]
+                    and ta.threshold_in_bin[i] == tb.threshold_in_bin[i]):
+                same += 1
+    return same / max(total, 1)
+
+
+def _pair_train(X, y, Xt, yt, extra, rounds=8):
+    from sklearn.metrics import roc_auc_score
+    aucs, models = [], []
+    for q in (False, True):
+        params = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                      min_data_in_leaf=5, verbose=-1, max_bin=15,
+                      deterministic=True, quantized_histograms=q)
+        params.update(extra)
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds)
+        aucs.append(roc_auc_score(yt, bst.predict(Xt)))
+        models.append(list(bst._gbdt.models))
+    return aucs[0], aucs[1], _split_agreement(models[0], models[1])
+
+
+def _small_binary(n=1200, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 10)
+    X[:, :5] = rng.randint(0, 12, size=(n, 5))   # sub-byte-packable columns
+    y = (X[:, 0] + 3 * X[:, 7] + rng.randn(n) * 0.5 > 6).astype(np.float64)
+    cut = n - n // 4
+    return X[:cut], y[:cut], X[cut:], y[cut:]
+
+
+def test_quantized_parity_small_end_to_end():
+    """Fast pin of the whole path: packed serial training within an AUC
+    bound of f32 and mostly-agreeing split decisions."""
+    X, y, Xt, yt = _small_binary()
+    auc_f, auc_q, agree = _pair_train(X, y, Xt, yt, {})
+    assert abs(auc_q - auc_f) <= 0.005, (auc_f, auc_q)
+    assert agree >= 0.6, agree
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["plain", "bagging", "goss"])
+def test_quantized_parity_standard_fixture(binary_data, mode):
+    """Held-out AUC delta + split agreement across sampling modes on the
+    standard fixture (coverage note: the fast test above exercises the
+    identical quantize/accumulate/dequantize path; this matrix adds the
+    bagging/GOSS gradient-rescale interplay at fixture scale)."""
+    X, y, Xt, yt = binary_data
+    X, y = np.asarray(X)[:4000], np.asarray(y)[:4000]
+    extra = {
+        "plain": {},
+        "bagging": {"bagging_fraction": 0.7, "bagging_freq": 1,
+                    "bagging_seed": 11},
+        # other_rate high enough that warmup ends within the run
+        "goss": {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.3,
+                 "learning_rate": 0.5},
+    }[mode]
+    auc_f, auc_q, agree = _pair_train(X, y, np.asarray(Xt), np.asarray(yt),
+                                      extra, rounds=10)
+    assert abs(auc_q - auc_f) <= 0.01, (mode, auc_f, auc_q)
+    assert agree >= 0.5, (mode, agree)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: clip counter + hist-path labels
+# ---------------------------------------------------------------------------
+def test_clip_counter_and_hist_path_label():
+    from lightgbm_tpu.telemetry.registry import get_counter
+    X, y, _, _ = _small_binary(600)
+    c = get_counter(None, "lgbm_hist_grad_clip_total")
+    base = c.value
+    params = dict(objective="binary", num_leaves=7, verbose=-1, max_bin=15,
+                  quantized_histograms=True, telemetry=True,
+                  deterministic=True)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    # binary logloss bounds cover every unweighted row: nothing clips
+    assert c.value == base
+    summ = bst.telemetry_summary()
+    assert summ["hist_path"].startswith("int16x32")
+    recs = bst._gbdt.telemetry.records
+    assert all(r["hist_path"] == summ["hist_path"] for r in recs)
+    # the booster-side drain feeds the counter
+    bst._gbdt._drain_quant_clips(3)
+    assert c.value == base + 3
+
+
+# ---------------------------------------------------------------------------
+# Closure-constant guard (the PR 6 HLO-constant-inlining bug class)
+# ---------------------------------------------------------------------------
+def test_no_closure_array_constants_in_quantized_programs():
+    """The packed matrix, PackMap and quantization bounds must ride jitted
+    programs as ARGUMENTS — a closure-captured device array is inlined into
+    the traced program as an HLO constant, bloating it and baking one run's
+    data into AOT bundles (the PR 6 bug class).  Guard: trace the quantized
+    grower and the fused block exactly as production jits them and assert
+    the closed jaxpr carries no data-sized constants.  (Stricter than a
+    source grep for the test_no_pinned_check_vma_outside_mesh pattern: the
+    jaxpr sees every capture, however it was spelled.)"""
+    X, y, _, _ = _small_binary(400)
+    params = dict(objective="binary", num_leaves=7, verbose=-1, max_bin=15,
+                  quantized_histograms=True, deterministic=True,
+                  histogram_impl="onehot")     # force the packed plan on CPU
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=1)
+    gbdt = bst._gbdt
+    learner = gbdt.tree_learner
+    assert learner.pack_map is not None, "packed plan did not engage"
+
+    def max_const_elems(closed_jaxpr):
+        sizes = [int(np.asarray(c).size) for c in closed_jaxpr.consts
+                 if hasattr(c, "shape")]
+        return max(sizes, default=0)
+
+    # trace the grower exactly as learner.train jits it: config static,
+    # every array — packed matrix, PackMap, layout, bounds — an ARGUMENT
+    from lightgbm_tpu.tree_learner import grow_tree
+    ds_h = learner.dataset
+    n = learner.train_bins.shape[0]
+    grad = jnp.zeros((n,), jnp.float32)
+    mask = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((X.shape[1],), bool)
+    key = learner.iter_key(0)
+    qb = gbdt._quant_bounds_arr()
+    closed = jax.make_jaxpr(
+        lambda *a, **kw: grow_tree(learner.grower_cfg, *a, **kw))(
+            learner.train_bins, grad, grad, mask,
+            ds_h.num_bins_per_feature, ds_h.has_missing_per_feature, fmask,
+            learner.monotone, key, learner.is_cat_f, learner.bmap,
+            learner.igroups, learner.gain_scale, None,
+            hist_layout=learner.hist_layout, pack_map=learner.pack_map,
+            quant_bounds=qb)
+    assert max_const_elems(closed) <= 64, (
+        "the quantized grower trace captured an array constant instead of "
+        "taking it as an argument")
+
+    k = 2
+    block = gbdt._build_fused_block(0, k)
+    args = gbdt._fused_example_args(k)
+    closed = jax.make_jaxpr(block)(*args)
+    assert max_const_elems(closed) <= 64, (
+        "the fused block (the AOT-serialized program) captured an array "
+        "constant instead of taking it as an argument")
