@@ -142,6 +142,21 @@ def synth_binary(n, seed):
     return X, y
 
 
+def _row_bucket_info(params, rows):
+    """Bucket-ladder padding accounting for the train-stage JSON: what the
+    row-bucket ladder (config train_row_buckets, dataset.py) pads this
+    run's row count to, and the fraction of device rows that padding
+    would be.  ``enabled`` reflects the actual run config (the headline
+    stays unbucketed unless BENCH_TRAIN_ROW_BUCKETS opts in)."""
+    from lightgbm_tpu.dataset import _train_row_bucket
+    bucket = _train_row_bucket(rows)
+    return {
+        "enabled": bool(params.get("train_row_buckets", False)),
+        "bucket": int(bucket),
+        "pad_fraction": round((bucket - rows) / max(bucket, 1), 4),
+    }
+
+
 def run_training():
     """Child-process body: bin + train + eval, prints the result JSON.
 
@@ -179,6 +194,11 @@ def run_training():
         # opt-in persistent compilation cache: warm-cache runs skip the XLA
         # compiles entirely (cold runs still pay them — the honest default)
         params["compilation_cache_dir"] = os.environ["BENCH_COMPILE_CACHE"]
+    if os.environ.get("BENCH_TRAIN_ROW_BUCKETS"):
+        # opt-in bucketed training (bit-identical; pays pad-fraction extra
+        # histogram compute to keep shapes — and compiled programs —
+        # stable as row counts vary)
+        params["train_row_buckets"] = True
     train_set = lgb.Dataset(X, y)
     t_construct = time.time()
     train_set.construct()
@@ -377,6 +397,7 @@ def run_training():
         "held_out_auc": round(test_auc, 6),
         "setup_s": round(setup_s, 3),
         "setup_breakdown": setup_breakdown,
+        "row_bucket": _row_bucket_info(params, rows),
         "checkpoint_s": round(checkpoint_s, 4),
         "checkpoint_frac": round(checkpoint_frac, 4),
         "telemetry": telemetry,
@@ -867,6 +888,68 @@ def run_fleet():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def _continuous_incremental_phase(params, tmp):
+    """Growing-pool probe for the incremental dataset pipeline (ISSUE 10):
+    N stationary cycles, each ingesting one fresh segment into the
+    trainer's persistent binned store.  Reports per-cycle dataset
+    ``setup_s`` and backend-compile deltas (the trainer brackets each
+    cycle with telemetry.compile_snapshot), and the final-cycle
+    incremental-vs-scratch bar: the same pool built from scratch
+    (GreedyFindBin + EFB + device placement over all history) timed
+    against the last cycle's extend.  Bars: setup_speedup >= 5x and
+    steady-state (stable row bucket) cycles report 0 compiles."""
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.continuous import ContinuousTrainer
+    from lightgbm_tpu.dataset import Metadata, TrainDataset
+
+    n_cycles = int(os.environ.get("BENCH_CONT_INC_CYCLES", 5))
+    seg_rows = int(os.environ.get("BENCH_CONT_INC_SEG_ROWS", 8000))
+    rounds = int(os.environ.get("BENCH_CONT_INC_ROUNDS", 5))
+    trainer = ContinuousTrainer(params, os.path.join(tmp, "inc_work"),
+                                rounds_per_cycle=rounds)
+    per_cycle = []
+    res = None
+    for c in range(n_cycles):
+        X, y = synth_binary(seg_rows, seed=400 + c)
+        trainer.ingest(X, y)
+        res = trainer.train_cycle()
+        trainer.commit(res["candidate_str"])
+        per_cycle.append({
+            "cycle": c,
+            "train_rows": res["train_rows"],
+            "fresh_rows": res["fresh_rows"],
+            "setup_s": res["setup_s"],
+            "init_score_s": res["init_score_s"],
+            "compiles": res["compiles"],
+            "row_bucket": res["row_bucket"],
+            "pad_fraction": res["pad_fraction"],
+            "drift_max_psi": res["drift_max_psi"],
+            "rebin": res["rebin"] is not None,
+        })
+    # final-cycle bar: the O(total) from-scratch build the incremental
+    # path replaced, on the exact same pool and config
+    Xall = np.concatenate(trainer._train_X)
+    yall = np.concatenate(trainer._train_y)
+    t0 = time.time()
+    TrainDataset(Xall, Metadata(yall), Config(trainer.params))
+    scratch_s = time.time() - t0
+    incr_s = max(res["setup_s"], 1e-9)
+    # steady state = trailing cycles whose row bucket matches the final
+    # one (the set the "0 new compiles" claim is scoped to)
+    tail = [c for c in per_cycle if c["row_bucket"] == res["row_bucket"]]
+    steady = tail[1:] if len(tail) > 1 else []
+    return {
+        "cycles": per_cycle,
+        "incremental_setup_s": round(incr_s, 4),
+        "scratch_setup_s": round(scratch_s, 4),
+        "setup_speedup": round(scratch_s / incr_s, 1),
+        "steady_state_cycles": len(steady),
+        "steady_state_compiles": int(sum(c["compiles"] for c in steady)),
+        "final_pool_rows": int(res["train_rows"]),
+    }
+
+
 def run_continuous():
     """Child body for BENCH_STAGE=continuous: the closed train→serve loop
     under chaos (lightgbm_tpu/continuous/).
@@ -925,6 +1008,15 @@ def run_continuous():
     params = {"objective": "binary", "num_leaves": 15,
               "learning_rate": 0.2, "verbosity": -1, "max_bin": MAX_BIN,
               "min_data_in_leaf": 20, "seed": 7}
+
+    # growing-pool incremental-pipeline probe FIRST (no serving traffic,
+    # so the per-cycle compile deltas are attributable to training alone)
+    incremental = None
+    if os.environ.get("BENCH_CONT_INCREMENTAL", "1") != "0":
+        try:
+            incremental = _continuous_incremental_phase(params, tmp)
+        except Exception as exc:       # keep the chaos soak alive
+            incremental = {"error": repr(exc)[-300:]}
 
     def write_segment(name, X, y, extra=()):
         lines = [",".join([f"{y[i]:.0f}"]
@@ -1107,6 +1199,11 @@ def run_continuous():
                                if e["action"] == "publish"],
             "soak_s": round(elapsed, 1),
             "setup_s": round(setup_s, 1),
+            # per-cycle incremental-dataset accounting from the soak's
+            # own service steps (trainer.train_cycle exports them)
+            "cycle_setup_s": [e.get("setup_s") for e in service.events],
+            "cycle_compiles": [e.get("compiles") for e in service.events],
+            "incremental": incremental,
             "backend": backend,
         }
         if failures:

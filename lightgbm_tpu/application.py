@@ -333,7 +333,11 @@ class Application:
             rounds_per_cycle=cfg.continuous_rounds,
             holdout_fraction=cfg.continuous_holdout_fraction,
             checkpoint_freq=max(cfg.checkpoint_freq, 1),
-            keep_checkpoints=cfg.keep_checkpoints)
+            keep_checkpoints=cfg.keep_checkpoints,
+            incremental=bool(cfg.continuous_incremental),
+            rebin_policy=cfg.continuous_rebin_policy,
+            rebin_threshold=cfg.continuous_rebin_threshold,
+            rebin_every_k=cfg.continuous_rebin_every_k)
         gate = PublishGate(app.registry, name,
                            min_auc=cfg.continuous_min_auc,
                            max_regression=cfg.continuous_max_regression,

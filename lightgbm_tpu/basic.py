@@ -254,7 +254,15 @@ class Dataset:
         cfg = Config(self.params)
         cats = self._resolve_categoricals(arr.shape[1])
         if self.reference is not None:
-            self._handle = self.reference._handle.create_valid(arr, meta)
+            if self.params.get("reference_as_train"):
+                # continued-training alignment (ISSUE 10): a TRAIN dataset
+                # binned with the reference's frozen mappers AND frozen EFB
+                # bundles — O(rows) setup, bit-identical to extending the
+                # reference with the same rows (dataset.from_reference)
+                self._handle = TrainDataset.from_reference(
+                    self.reference._handle, arr, meta)
+            else:
+                self._handle = self.reference._handle.create_valid(arr, meta)
         else:
             self._handle = TrainDataset(arr, meta, cfg,
                                         categorical_features=cats)
@@ -321,6 +329,29 @@ class Dataset:
             else:
                 out.append(int(c))
         return sorted(set(out) | set(self._pandas_cats))
+
+    @classmethod
+    def _from_handle(cls, handle, params=None) -> "Dataset":
+        """Wrap an already-constructed TrainDataset handle (the continuous
+        trainer's persistent incremental store) so ``engine.train`` can
+        consume it without re-binning or re-concatenating raw data.
+        ``construct()`` is a no-op on the wrapper."""
+        ds = cls.__new__(cls)
+        ds.data = None
+        ds.label = None
+        ds.reference = None
+        ds.weight = None
+        ds.group = None
+        ds.init_score = None
+        ds.feature_name = "auto"
+        ds.categorical_feature = "auto"
+        ds.params = dict(params or {})
+        ds.free_raw_data = False
+        ds._handle = handle
+        ds._used_indices = None
+        ds._feature_names = None
+        ds._pandas_cats = []
+        return ds
 
     # ------------------------------------------------------------------
     def create_valid(self, data, label=None, weight=None, group=None,
@@ -740,6 +771,9 @@ class Booster:
         if name == "training":
             data_meta = g.train_data.metadata
             score = g.train_score
+            if score.shape[-1] != g.train_data.num_data:
+                # row-bucket padding: metrics see the real rows only
+                score = score[:, :g.train_data.num_data]
         else:
             i = self._valid_names.index(name)
             data_meta = g.valid_sets[i].metadata

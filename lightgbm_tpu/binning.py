@@ -17,7 +17,26 @@ from __future__ import annotations
 import numpy as np
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["BinMapper", "BinType", "MissingType", "find_bin_mappers"]
+__all__ = ["BinMapper", "BinType", "MissingType", "find_bin_mappers",
+           "bin_occupancy"]
+
+
+def bin_occupancy(bins: np.ndarray, num_bins_per_feature) -> np.ndarray:
+    """[F, B] per-feature bin occupancy counts of a binned row matrix.
+
+    The sufficient statistic behind the continuous service's
+    drift-triggered re-binning policy (continuous/drift.py): cheap to
+    accumulate at ingest (the rows are binned anyway), and distribution
+    drift against frozen mappers shows up directly as occupancy shift —
+    including out-of-range mass piling into the edge bins."""
+    bins = np.asarray(bins)
+    nb = np.asarray(num_bins_per_feature, np.int64)
+    B = int(nb.max()) if len(nb) else 1
+    out = np.zeros((bins.shape[1], B), np.int64)
+    for f in range(bins.shape[1]):
+        c = np.bincount(bins[:, f].astype(np.int64), minlength=B)
+        out[f] = c[:B]
+    return out
 
 
 class BinType:

@@ -23,10 +23,30 @@ from ..tree_learner import (SerialTreeLearner, grow_tree, grow_tree_compact,
                             state_to_tree)
 from ..ops.predict import traverse_binned
 from ..metrics import create_metrics
-from ..log import log_info, log_warning
+from ..log import LightGBMError, log_info, log_warning
 from ..timer import timed
 
 __all__ = ["GBDT"]
+
+# Process-wide fused-block executable cache.  Continuation cycles
+# (continuous/trainer.py) rebuild the Booster — and with it the fused
+# block closure — every cycle; a fresh jax.jit wrapper retraces and
+# recompiles an IDENTICAL program even though nothing changed.  Entries
+# are AOT-compiled executables (lower().compile(): no python closure, so
+# no stale dataset/device-array pinning) keyed by the same signature that
+# gates AOT bundle loads — every fact the program is specialized on,
+# argument avals included.  With row-bucket padding the avals are stable
+# while the pool grows inside its bucket, so steady-state cycles compile
+# nothing.
+_FUSED_EXEC_CACHE: Dict[str, object] = {}
+_FUSED_EXEC_CACHE_CAP = 8
+
+
+def _fused_exec_cache_key(signature: Dict) -> str:
+    import hashlib
+    import json
+    payload = json.dumps(signature, sort_keys=True, default=repr)
+    return hashlib.sha256(payload.encode()).hexdigest()
 
 
 class GBDT:
@@ -67,11 +87,25 @@ class GBDT:
 
         n = train_data.num_data
         k = self.num_class
-        init = jnp.zeros((k, n), jnp.float32)
+        # row-bucket padding (config train_row_buckets, dataset.py): the
+        # device row axis may exceed the real row count; every padded row
+        # is masked out of gradients/histograms/bagging below, so results
+        # are bit-identical to the unpadded shape
+        nd = int(getattr(train_data, "num_rows_device", n))
+        self._n_rows_device = nd
+        if nd != n and objective.need_renew_tree_output:
+            raise LightGBMError(
+                f"objective {objective.to_string()!r} refits leaf outputs "
+                "host-side over the real rows and cannot run on a row-"
+                "bucket-padded dataset; set train_row_buckets=false")
+        init = jnp.zeros((k, nd), jnp.float32)
         if train_data.metadata.init_score is not None:
             s = np.asarray(train_data.metadata.init_score, np.float32)
-            init = init + jnp.asarray(s.reshape(k, n) if s.size == k * n
-                                      else np.tile(s, (k, 1)))
+            s = s.reshape(k, n) if s.size == k * n else np.tile(s, (k, 1))
+            if nd != n:
+                s = np.concatenate(
+                    [s, np.zeros((k, nd - n), np.float32)], axis=1)
+            init = init + jnp.asarray(s)
             self._has_init_score = True
         else:
             self._has_init_score = False
@@ -172,6 +206,12 @@ class GBDT:
         self._boosted_from_average[cls] = True
         label = self.train_data.label
         weight = self.train_data.weight
+        if self._n_rows_device != self.train_data.num_data:
+            # padded label/weight rows are zeros and would shift the
+            # average — the init must come from the real rows only
+            nr = self.train_data.num_data
+            label = label[:nr]
+            weight = weight[:nr] if weight is not None else None
         init = obj.boost_from_score(label, weight, cls)
         if init != 0.0:
             self.train_score = self.train_score.at[cls].add(init)
@@ -184,13 +224,18 @@ class GBDT:
         row subset, incl. balanced pos/neg bagging."""
         cfg = self.config
         n = self.train_data.num_data
+        nd = self._n_rows_device
         use_pos_neg = (cfg.pos_bagging_fraction < 1.0
                        or cfg.neg_bagging_fraction < 1.0)
         need = (cfg.bagging_freq > 0 and
                 (cfg.bagging_fraction < 1.0 or use_pos_neg))
         if not need:
             if not hasattr(self, "_ones_mask"):
-                self._ones_mask = jnp.ones((n,), jnp.float32)
+                # under row-bucket padding the "no bagging" mask is the
+                # pad-validity mask: 1 for real rows, 0 for padded ones
+                ones = np.zeros(nd, np.float32)
+                ones[:n] = 1.0
+                self._ones_mask = jnp.asarray(ones)
             return self._ones_mask
         # the mask refreshes every bagging_freq iterations and is derived
         # from bagging_seed + the REFRESH iteration (not the current one):
@@ -211,6 +256,10 @@ class GBDT:
                           cfg.neg_bagging_fraction).astype(np.float32)
         else:
             mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
+        if nd != n:
+            # the rng draw stays over the REAL row count (bit-identical to
+            # the unpadded stream); padded rows are simply never in the bag
+            mask = np.concatenate([mask, np.zeros(nd - n, np.float32)])
         self._last_mask = jnp.asarray(mask)
         self._last_mask_iter = base_iter
         return self._last_mask
@@ -272,6 +321,15 @@ class GBDT:
         from bagging_seed so fused and unfused runs draw the SAME sample
         sequence."""
         return jax.random.PRNGKey(0)
+
+    def _fused_adjust_payload_at(self, iteration: int):
+        """Per-round pytree handed to _fused_gradient_adjust through the
+        fused block's scan.  Default: the adjust key.  GOSS on a row-
+        bucket-padded dataset overrides with (priorities, ks, multiply) so
+        its sample selection rides as ARGUMENTS with the row count traced
+        — the program stays stable while the pool grows inside its
+        bucket.  Must be side-effect free (precompile calls it)."""
+        return self._fused_adjust_key_at(iteration)
 
     def _fused_const_args(self) -> tuple:
         """The per-run-constant arrays of the fused block, as ARGUMENTS.
@@ -359,6 +417,12 @@ class GBDT:
             "boosting": self.config.boosting,
             "objective": self.objective.to_string(),
             "objective_params": semantics,
+            # DATA-derived trace constants: binary's is_unbalance /
+            # scale_pos_weight label weights come from the label counts,
+            # not the config — a continuation cycle over a grown pool must
+            # not signature-match a program that baked the old ratio
+            "objective_state": repr(getattr(self.objective,
+                                            "label_weights", None)),
             "grow_strategy": self.config.grow_strategy,
             "grower_cfg": repr(self.tree_learner.grower_cfg),
             "args_tree": hashlib.sha256(tree_str.encode()).hexdigest()[:12],
@@ -390,7 +454,17 @@ class GBDT:
                 save_on_miss=(comm_rank() == 0),
                 stats=self.aot_stats)
         else:
-            fn = jax.jit(builder)
+            ck = _fused_exec_cache_key(self._fused_signature(variant, k,
+                                                             args))
+            fn = _FUSED_EXEC_CACHE.get(ck)
+            if fn is None:
+                fn = jax.jit(builder).lower(*args).compile()
+                if len(_FUSED_EXEC_CACHE) >= _FUSED_EXEC_CACHE_CAP:
+                    # tiny FIFO bound: executables are small (the jaxpr
+                    # guard keeps data out of the program), but unbounded
+                    # growth across shape-churning test suites isn't free
+                    _FUSED_EXEC_CACHE.pop(next(iter(_FUSED_EXEC_CACHE)))
+                _FUSED_EXEC_CACHE[ck] = fn
         self._fused_step[key] = fn
         return fn
 
@@ -398,12 +472,13 @@ class GBDT:
         """Args with this run's exact shapes/dtypes for AOT lowering WITHOUT
         touching stateful sampling RNGs (precompile must be side-effect
         free; masks are data, not program, so all-ones stands in)."""
-        n = self.train_data.num_data
         f = self.train_data.num_features
-        masks = jnp.ones((k, n), jnp.float32)
+        masks = jnp.ones((k, self._n_rows_device), jnp.float32)
         fmasks = np.ones((k, f), bool)
         keys = jnp.stack([self.tree_learner.iter_key(i) for i in range(k)])
-        akeys = jnp.stack([self._fused_adjust_key_at(i) for i in range(k)])
+        akeys = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[self._fused_adjust_payload_at(i) for i in range(k)])
         return self._fused_const_args() + (
             self.train_score[0], jnp.float32(self.shrinkage_rate),
             masks, fmasks, keys, akeys)
@@ -467,8 +542,9 @@ class GBDT:
         masks = jnp.stack([self._bagging_mask(base + i) for i in range(k)])
         fmasks = np.stack([learner.feature_mask() for _ in range(k)])
         keys = jnp.stack([learner.iter_key(base + i) for i in range(k)])
-        akeys = jnp.stack([self._fused_adjust_key_at(base + i)
-                           for i in range(k)])
+        akeys = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[self._fused_adjust_payload_at(base + i) for i in range(k)])
         args = self._fused_const_args() + (
             self.train_score[0], jnp.float32(self.shrinkage_rate),
             masks, fmasks, keys, akeys)
@@ -547,6 +623,11 @@ class GBDT:
                 jax.block_until_ready((grad, hess))
                 tele.add("grad_s", time.perf_counter() - t0)
         else:
+            if self._n_rows_device != self.train_data.num_data:
+                raise LightGBMError(
+                    "custom objective gradients are sized to the real row "
+                    "count and cannot drive a row-bucket-padded dataset; "
+                    "set train_row_buckets=false")
             if tele:
                 tele.start_iteration(self.iter_)
             grad = jnp.asarray(np.asarray(grad, np.float32).reshape(k, -1))
@@ -572,10 +653,15 @@ class GBDT:
         return 1.0
 
     def _quant_bounds_arr(self):
-        """[2] device (grad, hess) bound for the grower's quantizer, or
-        None for the runtime-max fallback.  Objective bound x max sample
-        weight x sampling amplification — anything past it clips (counted
-        in lgbm_hist_grad_clip_total)."""
+        """[3] device (grad bound, hess bound, real row count) for the
+        grower's quantizer, or None for the runtime-max fallback.
+        Objective bound x max sample weight x sampling amplification —
+        anything past it clips (counted in lgbm_hist_grad_clip_total).
+        The REAL row count rides along so the int16 headroom limit under
+        row-bucket padding matches the unpadded run exactly (padded rows
+        are masked to zero and add nothing to the int32 accumulators);
+        as a traced argument it never bakes into the program, so the
+        bucketed shape stays stable while N grows."""
         if not getattr(self.tree_learner.grower_cfg, "quantized", False):
             return None
         if not hasattr(self, "_quant_bounds_cache"):
@@ -587,8 +673,8 @@ class GBDT:
                 wmax = float(np.max(w)) if w is not None and len(w) else 1.0
                 amp = max(float(self._grad_amplification()), 1.0)
                 self._quant_bounds_cache = jnp.asarray(
-                    [bounds[0] * wmax * amp, bounds[1] * wmax * amp],
-                    jnp.float32)
+                    [bounds[0] * wmax * amp, bounds[1] * wmax * amp,
+                     float(self.train_data.num_data)], jnp.float32)
         return self._quant_bounds_cache
 
     def _drain_quant_clips(self, clips) -> None:
@@ -830,8 +916,11 @@ class GBDT:
         cfg = self.config
         obj = self.objective
         if cfg.is_provide_training_metric and self.train_metrics:
+            score = self.train_score
+            if self._n_rows_device != self.train_data.num_data:
+                score = score[:, :self.train_data.num_data]
             out["training"] = self._eval_one(
-                self.train_score, self.train_data.metadata, self.train_metrics)
+                score, self.train_data.metadata, self.train_metrics)
         for i, (valid, name) in enumerate(zip(self.valid_sets, self.valid_names)):
             out[name] = self._eval_one(self.valid_scores[i], valid.metadata,
                                        self.train_metrics)

@@ -44,6 +44,34 @@ def goss_adjust(grad, hess, key, top_k: int, other_k: int):
     return grad * scale, hess * scale, mask
 
 
+def goss_adjust_masked(grad, hess, valid, pri, top_k, other_k, multiply):
+    """Row-bucket-padded GOSS adjustment (config ``train_row_buckets``).
+
+    Same selection as ``goss_adjust`` restricted to the ``valid`` rows,
+    reformulated so NOTHING about the real row count is baked into the
+    program: ``top_k``/``other_k``/``multiply`` ride as traced scalars
+    (the top-k thresholds become dynamic-rank gathers on a full sort) and
+    the rest-sample priorities arrive PRECOMPUTED over the real rows —
+    drawn from the same iteration key and shape as the unbucketed in-jit
+    draw, so the selection (and therefore the model) is bit-identical to
+    ``goss_adjust`` at the same rows.  A growing pool only recompiles
+    when it outgrows its row bucket."""
+    g_abs = jnp.sum(jnp.abs(grad * hess), axis=0)
+    ok = valid > 0
+    g_rank = jnp.where(ok, g_abs, -jnp.inf)
+    thr = -jnp.sort(-g_rank)[jnp.maximum(top_k - 1, 0)]
+    # padded rows rank -inf, below any real |g*h| >= 0, so the k-th
+    # largest is the same value lax.top_k finds on the unpadded shape;
+    # the explicit `ok` keeps zero-gradient real ties from admitting pads
+    is_top = ok & (g_rank >= thr)
+    pri = jnp.where(is_top | ~ok, -jnp.inf, pri)
+    kth = -jnp.sort(-pri)[jnp.maximum(other_k - 1, 0)]
+    sampled = (pri >= kth) & ~is_top & jnp.isfinite(pri)
+    scale = jnp.where(sampled, multiply, jnp.float32(1.0))[None, :]
+    mask = (is_top | sampled).astype(jnp.float32)
+    return grad * scale, hess * scale, mask
+
+
 class GOSS(GBDT):
     def __init__(self, config, train_data, objective):
         if config.bagging_freq > 0 and config.bagging_fraction != 1.0:
@@ -75,10 +103,44 @@ class GOSS(GBDT):
         # MUST stay identical or fused-vs-unfused bit-identity breaks
         return self._fused_adjust_key_at(self.iter_)
 
-    def _adjust_gradients(self, grad, hess):
+    def _padded(self) -> bool:
+        return self._n_rows_device != self.train_data.num_data
+
+    def _goss_payload_at(self, iteration: int):
+        """(priorities, [top_k, other_k], multiply) for the padded GOSS
+        variant: the uniform draw happens EAGERLY over the real row count
+        with the same key the in-jit unpadded draw would use — identical
+        values — and is padded to the device rows; the counts and rescale
+        factor ride as traced scalars so the compiled program never
+        depends on the real row count."""
         n = self.train_data.num_data
+        nd = self._n_rows_device
+        top_k, other_k = self._goss_ks()
+        pri = jax.random.uniform(self._fused_adjust_key_at(iteration), (n,))
+        if nd != n:
+            pri = jnp.concatenate([pri, jnp.full((nd - n,), -jnp.inf,
+                                                 pri.dtype)])
+        # host-computed exactly like goss_adjust's python-float `multiply`
+        # (f64 divide, then one f32 round) so padded == unpadded bitwise
+        multiply = np.float32((n - top_k) / max(other_k, 1))
+        return (pri, jnp.asarray([top_k, other_k], jnp.int32),
+                jnp.float32(multiply))
+
+    def _fused_adjust_payload_at(self, iteration: int):
+        if self._padded():
+            return self._goss_payload_at(iteration)
+        return self._fused_adjust_key_at(iteration)
+
+    def _adjust_gradients(self, grad, hess):
         if not self._goss_active():
-            return grad, hess, jnp.ones((n,), jnp.float32)
+            # pad-validity-aware ones mask (GOSS forbids bagging, so the
+            # booster's no-bagging mask is exactly that)
+            return grad, hess, self._bagging_mask(self.iter_)
+        if self._padded():
+            pri, ks, mult = self._goss_payload_at(self.iter_)
+            return goss_adjust_masked(grad, hess,
+                                      self._bagging_mask(self.iter_),
+                                      pri, ks[0], ks[1], mult)
         top_k, other_k = self._goss_ks()
         return goss_adjust(grad, hess, self._goss_key(), top_k, other_k)
 
@@ -96,11 +158,17 @@ class GOSS(GBDT):
             return min(k, boundary - self.iter_)
         return k
 
-    def _fused_gradient_adjust(self, grad, hess, mask, key, variant: int):
+    def _fused_gradient_adjust(self, grad, hess, mask, payload, variant: int):
         if variant == 0:
             return grad, hess, mask
+        if isinstance(payload, tuple):
+            # padded variant: payload = (priorities, ks, multiply) from
+            # _goss_payload_at, all arguments — never trace-time constants
+            pri, ks, mult = payload
+            return goss_adjust_masked(grad, hess, mask, pri, ks[0], ks[1],
+                                      mult)
         top_k, other_k = self._goss_ks()
-        return goss_adjust(grad, hess, key, top_k, other_k)
+        return goss_adjust(grad, hess, payload, top_k, other_k)
 
     def _fused_adjust_key_at(self, iteration: int):
         return jax.random.PRNGKey(self.config.bagging_seed * 65537 +

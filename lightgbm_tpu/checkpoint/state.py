@@ -325,10 +325,15 @@ def restore_train_state(booster, state: TrainState) -> None:
             f"corrupt checkpoint: {len(state.trees)} trees for "
             f"{state.iteration} iterations x {gbdt.num_class} classes")
     score = np.asarray(state.train_score, np.float32)
-    if score.shape != (gbdt.num_class, gbdt.train_data.num_data):
+    # the saved score spans the DEVICE rows: with train_row_buckets on
+    # that includes the bucket padding (same config ⇒ same bucket, the
+    # fingerprint already pinned the real row count)
+    n_dev = int(getattr(gbdt.train_data, "num_rows_device",
+                        gbdt.train_data.num_data))
+    if score.shape != (gbdt.num_class, n_dev):
         raise LightGBMError(
             f"corrupt checkpoint: train_score shape {score.shape} != "
-            f"{(gbdt.num_class, gbdt.train_data.num_data)}")
+            f"{(gbdt.num_class, n_dev)}")
 
     gbdt.models = list(state.trees)
     gbdt.iter_ = int(state.iteration)

@@ -297,6 +297,31 @@ _PARAMS: List[ParamSpec] = [
     _p("continuous_allow_nan_features", bool, False, (),
        desc="admit NaN feature values as LightGBM missing values "
             "instead of quarantining the row (Inf always quarantines)"),
+    _p("continuous_incremental", bool, True, (),
+       desc="keep a persistent frozen-mapper binned store across "
+            "continuation cycles: each cycle bins only the FRESH segment "
+            "(TrainDataset.extend) instead of rebuilding the dataset over "
+            "all history — per-cycle setup cost O(segment), not O(total "
+            "rows).  Implies train_row_buckets so training shapes (and "
+            "compiled programs / AOT bundle entries) stay stable while "
+            "the pool grows inside a bucket"),
+    _p("continuous_rebin_policy", str, "drift", (),
+       check="in:never|drift|every_k",
+       desc="when the incremental store pays a full re-bin (fresh "
+            "GreedyFindBin mappers + EFB over all history): 'never', "
+            "'drift' (per-feature PSI of recent bin occupancy vs the "
+            "mappers' construction distribution crosses "
+            "continuous_rebin_threshold), or 'every_k' cycles.  Decisions "
+            "+ paid cost land in lgbm_continuous_rebin_total and the "
+            "cycle events"),
+    _p("continuous_rebin_threshold", float, 0.2, (), ">0",
+       "drift policy trigger: max per-feature PSI (population stability "
+       "index) of ingested-since-last-rebin bin occupancy vs the "
+       "reference distribution; 0.2 is the conventional 'significant "
+       "shift' bar"),
+    _p("continuous_rebin_every_k", int, 10, (), ">0",
+       "every_k policy period: pay a full re-bin every k training "
+       "cycles"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),
@@ -356,6 +381,24 @@ _PARAMS: List[ParamSpec] = [
             "precision — AUC-bounded parity, NOT bit-identical (the "
             "documented deviation class for this knob).  Cleared by the "
             "feature-parallel learner like the width-class plan"),
+    _p("train_row_buckets", bool, False, ("row_bucket_training",),
+       desc="pad the training row axis up to a power-of-two bucket "
+            "(serving's ladder, ops/predict.py) with the padded rows "
+            "masked out of gradients/histograms/bagging/GOSS: training "
+            "is bit-identical to the unpadded shape (one carve-out: "
+            "quantized_histograms with an objective lacking closed-form "
+            "gradient bounds derives its runtime fixed-point scale from "
+            "the padded count above ~64k rows — safe headroom, coarser "
+            "scale, the quantized path's documented AUC-parity class), "
+            "and a dataset "
+            "growing across continuation cycles (TrainDataset.extend) "
+            "reuses the same compiled programs and AOT bundle entries "
+            "until it outgrows its bucket — steady-state cycles compile "
+            "nothing.  Serial learner only; ignored for query/group "
+            "data, linear_tree, and multi-process runs; custom fobj and "
+            "renew-output objectives (L1/huber/quantile/...) are "
+            "rejected.  Costs up to 2x histogram compute at worst-case "
+            "pad fraction — the tradeoff for zero recompiles"),
     _p("compilation_cache_dir", str, "", ("jax_compilation_cache_dir",),
        desc="enable the JAX persistent compilation cache at this directory; "
             "repeat runs with identical shapes/configs skip XLA recompiles "

@@ -8,12 +8,17 @@ post-publish regression and corruption-hardened persistence underneath
 coverage in tests).
 
 - :class:`DataTail` — validated ingest (quarantine, never crash)
-- :class:`ContinuousTrainer` — checkpointed continuation cycles
+- :class:`ContinuousTrainer` — checkpointed continuation cycles over a
+  persistent incremental binned store (O(segment) cycle setup,
+  drift-triggered re-binning)
+- :class:`DriftSketch` — per-feature PSI statistics behind the
+  ``continuous_rebin_policy`` decision
 - :class:`PublishGate` — AUC floor + regression bound + rollback alarm
 - :class:`ContinuousService` — the supervised composition (CLI
   ``task=continuous``)
 """
 
+from .drift import DriftSketch
 from .gate import PublishGate
 from .service import ContinuousService
 from .tail import DataTail, SegmentBatch
@@ -21,7 +26,7 @@ from .trainer import (ContinuousTrainer, checkpoint_prefix_matches,
                       combine_model_strings, holdout_auc)
 
 __all__ = [
-    "DataTail", "SegmentBatch",
+    "DataTail", "SegmentBatch", "DriftSketch",
     "ContinuousTrainer", "combine_model_strings", "holdout_auc",
     "checkpoint_prefix_matches",
     "PublishGate", "ContinuousService",

@@ -92,6 +92,13 @@ class ContinuousService:
         result = self._train_cycle_supervised()
         summary["trained"] = True
         summary["resumed_from"] = result["resumed_from"]
+        # incremental-pipeline accounting (trainer.train_cycle): per-cycle
+        # dataset setup wall, backend-compile delta, and the re-bin
+        # decision ride the step summary/events for telemetry + bench
+        for key in ("setup_s", "init_score_s", "compiles", "fresh_rows",
+                    "rebin", "row_bucket", "pad_fraction", "drift_max_psi"):
+            if key in result:
+                summary[key] = result[key]
         decision = self.gate.consider(result["candidate_str"],
                                       result["auc"], cycle=result["cycle"])
         if decision["action"] == "publish":

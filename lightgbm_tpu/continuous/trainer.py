@@ -4,10 +4,10 @@ Each **cycle** continues the last accepted model with ``continuous_rounds``
 fresh boosting rounds over everything ingested so far, using BOTH
 continuation paths the engine offers:
 
-- **across cycles** — ``init_model``: the previous accepted model's raw
-  predictions become the new run's init score (the reference's continued
-  -training semantics, engine.py), so the new rounds boost the residual.
-  The accepted serving artifact is the STITCHED model — previous trees +
+- **across cycles** — init scores: the previous accepted model's raw
+  scores become the new run's init score (the reference's continued
+  -training semantics), so the new rounds boost the residual.  The
+  accepted serving artifact is the STITCHED model — previous trees +
   the cycle's delta trees in one model string (``combine_model_strings``)
   — because an init-score-trained booster holds only its own trees and
   raw totals are ``init raw + delta raw``.
@@ -18,6 +18,26 @@ continuation paths the engine offers:
   uninterrupted run — the engine's existing resume guarantee, inherited
   wholesale.
 
+**Incremental cycle setup** (default, ``continuous_incremental``): the
+trainer keeps ONE persistent binned ``TrainDataset`` across cycles and
+``extend()``s it with each fresh segment — O(segment) per-cycle setup
+instead of re-concatenating the raw float64 pool and re-running
+GreedyFindBin + EFB + device placement over all history.  Training rows
+are row-bucket padded (``train_row_buckets``), so the compiled training
+programs (and AOT bundle entries, when ``aot_bundle_dir`` is set) stay
+stable while the pool grows inside a bucket: steady-state cycles compile
+nothing.  Init scores are maintained incrementally too: the committed
+model's raw score per train row is cached and advanced with each cycle's
+delta (the final train score IS init + delta raw), and fresh rows get the
+base model's host-side prediction — no O(total x trees) device predict
+per cycle.
+
+The frozen mappers drift with the data; ``continuous_rebin_policy``
+decides when to pay a full re-bin (continuous/drift.py PSI sketch —
+``never`` / ``drift`` / ``every_k``), counted in
+``lgbm_continuous_rebin_total`` with the decision + paid cost in the
+cycle events.
+
 Rows are split train/holdout deterministically by global ingest index
 (hash-free modulo walk), so a replayed ingest after a service restart
 reproduces the same split and the gate's AUC series stays comparable.
@@ -27,15 +47,19 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..log import LightGBMError, log_info
 from ..metrics import AUCMetric
+from ..telemetry import get_counter
 
 __all__ = ["ContinuousTrainer", "combine_model_strings", "holdout_auc",
            "checkpoint_prefix_matches"]
+
+_REBIN_POLICIES = ("never", "drift", "every_k")
 
 _TREE_HEAD = re.compile(r"(?m)^Tree=\d+$")
 
@@ -107,10 +131,19 @@ class ContinuousTrainer:
                  rounds_per_cycle: int = 20,
                  holdout_fraction: float = 0.2,
                  checkpoint_freq: int = 1,
-                 keep_checkpoints: int = 3):
+                 keep_checkpoints: int = 3,
+                 incremental: bool = True,
+                 rebin_policy: str = "drift",
+                 rebin_threshold: float = 0.2,
+                 rebin_every_k: int = 10,
+                 metrics_registry=None):
         if not 0.0 < holdout_fraction < 1.0:
             raise LightGBMError("holdout_fraction must be in (0, 1), got "
                                 f"{holdout_fraction}")
+        if rebin_policy not in _REBIN_POLICIES:
+            raise LightGBMError(
+                f"rebin_policy {rebin_policy!r} must be one of "
+                f"{_REBIN_POLICIES}")
         from ..config import resolve_aliases
         self.params = resolve_aliases(dict(params))
         # strip service-level and per-run knobs: rounds_per_cycle is the
@@ -124,6 +157,24 @@ class ContinuousTrainer:
                                "keep_checkpoints", "resume")):
                 self.params.pop(key)
         self.params.setdefault("objective", "binary")
+        self.incremental = bool(incremental)
+        if self.incremental and self.params.get("boosting",
+                                                "gbdt") in ("dart", "rf"):
+            # the incremental init-score cache reads the final train score
+            # as init + delta raw, which DART's averaging and RF's
+            # normalization break — fall back to the legacy per-cycle
+            # rebuild for those modes
+            log_info("continuous: incremental dataset pipeline supports "
+                     "gbdt/goss boosting; falling back to per-cycle "
+                     f"rebuilds for boosting={self.params['boosting']}")
+            self.incremental = False
+        if self.incremental:
+            # stable training shapes are what make the persistent store
+            # pay off (program + AOT bundle reuse across cycles)
+            self.params.setdefault("train_row_buckets", True)
+        self.rebin_policy = str(rebin_policy)
+        self.rebin_threshold = float(rebin_threshold)
+        self.rebin_every_k = max(int(rebin_every_k), 1)
         self.workdir = workdir.rstrip("/")
         self.rounds = int(rounds_per_cycle)
         self.holdout_every = max(int(round(1.0 / holdout_fraction)), 2)
@@ -136,8 +187,22 @@ class ContinuousTrainer:
         self._train_y: List[np.ndarray] = []
         self._hold_X: List[np.ndarray] = []
         self._hold_y: List[np.ndarray] = []
+        self._holdout_cache: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._ingested = 0
         self.resume_events: List[Dict] = []
+        # incremental store state
+        self._store = None            # persistent TrainDataset
+        self._store_segments = 0      # _train_X entries already in store
+        self._sketch = None           # DriftSketch over the store mappers
+        self._cycles_since_rebin = 0
+        self._raw_base: Optional[np.ndarray] = None   # committed raw/train row
+        self._prev_raw_base: Optional[np.ndarray] = None
+        self._last_raw: Optional[np.ndarray] = None   # candidate raw (commit)
+        self.rebin_events: List[Dict] = []
+        self.m_rebins = get_counter(
+            metrics_registry, "lgbm_continuous_rebin_total",
+            "full re-bins paid by the incremental dataset pipeline "
+            "(drift-triggered or every_k scheduled)")
 
     # ------------------------------------------------------------------
     @property
@@ -158,16 +223,124 @@ class ContinuousTrainer:
         if hold.any():
             self._hold_X.append(np.asarray(X[hold], np.float64))
             self._hold_y.append(np.asarray(y[hold], np.float64))
+            self._holdout_cache = None     # invalidate on new holdout rows
         return X[hold], y[hold]
 
     def holdout(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Cumulative holdout (gate AUC input).  Cached: the gate's drift
+        watch polls this every step, and re-concatenating the full holdout
+        list per poll was O(total rows); the cache invalidates on ingest."""
         if not self._hold_y:
             return (np.empty((0, 0)), np.empty((0,)))
-        return (np.concatenate(self._hold_X), np.concatenate(self._hold_y))
+        if self._holdout_cache is None:
+            self._holdout_cache = (np.concatenate(self._hold_X),
+                                   np.concatenate(self._hold_y))
+        return self._holdout_cache
 
     # ------------------------------------------------------------------
     def _cycle_dir(self, cycle: int) -> str:
         return f"{self.workdir}/cycles/cycle_{cycle:05d}"
+
+    # -- incremental store management ----------------------------------
+    def _build_store(self) -> None:
+        """(Re)build the persistent binned store from the raw pool: fresh
+        GreedyFindBin mappers + EFB + device placement over ALL history —
+        the O(total rows) path, paid once at cycle 0 and on re-bin."""
+        from ..config import Config
+        from ..dataset import Metadata, TrainDataset
+        from .drift import DriftSketch
+        X = np.concatenate(self._train_X)
+        y = np.concatenate(self._train_y)
+        self._store = TrainDataset(X, Metadata(y), Config(self.params))
+        self._store_segments = len(self._train_X)
+        self._sketch = DriftSketch(
+            np.asarray(self._store.num_bins_per_feature))
+        self._sketch.set_reference(self._store.bins)
+        self._cycles_since_rebin = 0
+
+    def _sync_store(self) -> int:
+        """Extend the store with segments ingested since the last cycle
+        (O(segment) binning against the frozen mappers), feed the drift
+        sketch, and extend the committed-model raw-score cache for the
+        fresh train rows.  Idempotent per segment — a retried cycle that
+        already synced skips straight through."""
+        fresh = 0
+        while self._store_segments < len(self._train_X):
+            i = self._store_segments
+            Xs, ys = self._train_X[i], self._train_y[i]
+            new_bins = self._store.extend(Xs, ys)
+            self._sketch.update(new_bins)
+            self._store_segments = i + 1
+            fresh += len(ys)
+        return fresh
+
+    def _ensure_raw_base(self) -> None:
+        """Enforce the init-score cache invariant: ``_raw_base`` holds the
+        committed model's raw score for every train row in the store (or
+        is None when no model is committed).  Rows missing from the cache
+        — fresh segments, rows synced after a reverted commit — are
+        backfilled by predicting the committed model over JUST those rows
+        (host-side per-tree traversal: no device compiles, O(missing x
+        trees) instead of O(total x trees) every cycle)."""
+        if self.model_str is None:
+            self._raw_base = None
+            return
+        have = 0 if self._raw_base is None else len(self._raw_base)
+        total = int(self._store.num_data)
+        if have == total:
+            return
+        if have > total:      # cannot happen via commit/revert bookkeeping
+            raise LightGBMError(
+                f"init-score cache holds {have} rows but the store has "
+                f"{total} — trainer state is inconsistent")
+        from ..basic import Booster
+        X_miss = self._train_rows_from(have)
+        raw = np.asarray(
+            Booster(model_str=self.model_str).predict(X_miss,
+                                                      raw_score=True),
+            np.float64).ravel()
+        self._raw_base = (raw if self._raw_base is None
+                          else np.concatenate([self._raw_base, raw]))
+
+    def _maybe_rebin(self) -> Optional[Dict]:
+        """Policy decision: pay a full re-bin now?  Returns the recorded
+        event (with drift scores + paid wall-clock) or None."""
+        reason = None
+        info: Dict = {}
+        if self.rebin_policy == "drift":
+            summ = self._sketch.summary()
+            info = summ
+            if summ["recent_rows"] > 0 and \
+                    summ["max_psi"] > self.rebin_threshold:
+                reason = "drift"
+        elif self.rebin_policy == "every_k":
+            if self._cycles_since_rebin >= self.rebin_every_k:
+                reason = "every_k"
+        if reason is None:
+            return None
+        t0 = time.perf_counter()
+        self._build_store()
+        event = {"cycle": self.cycle, "policy": self.rebin_policy,
+                 "reason": reason,
+                 "rebin_s": round(time.perf_counter() - t0, 4), **info}
+        self.rebin_events.append(event)
+        self.m_rebins.inc()
+        log_info(f"continuous: cycle {self.cycle} paid a full re-bin "
+                 f"({reason}: {info.get('max_psi', '-')}) in "
+                 f"{event['rebin_s']}s")
+        return event
+
+    def _train_rows_from(self, start: int) -> Optional[np.ndarray]:
+        """Concatenated synced train rows [start:] (revert backfill)."""
+        out = []
+        seen = 0
+        for i in range(self._store_segments):
+            seg = self._train_X[i]
+            lo = max(start - seen, 0)
+            if lo < len(seg):
+                out.append(seg[lo:])
+            seen += len(seg)
+        return np.concatenate(out) if out else None
 
     def train_cycle(self, callbacks: Optional[List] = None) -> Dict:
         """Run one continuation cycle; returns a result dict with the
@@ -177,13 +350,18 @@ class ContinuousTrainer:
         (stitched serving artifact), ``auc`` (cumulative-holdout AUC of
         the candidate), ``resumed_from`` (checkpoint iteration a restart
         picked up at, 0 for a fresh cycle; mirrored into
-        ``resume_events`` as ``{"cycle", "iteration"}``), ``cycle_dir``.
+        ``resume_events`` as ``{"cycle", "iteration"}``), ``cycle_dir``,
+        plus the incremental pipeline's accounting: ``setup_s`` (dataset
+        build/extend wall), ``compiles`` (backend-compile delta across
+        the cycle), ``fresh_rows``, ``rebin`` (event or None),
+        ``row_bucket``/``pad_fraction``.
 
         Raises whatever training raises — supervision (restart budget,
         backoff) is the service's job; re-entering with the same cycle
         counter resumes from the cycle's checkpoints."""
         import lightgbm_tpu as lgb
         from ..checkpoint import CheckpointManager
+        from ..telemetry.training import compile_snapshot
         if self.num_train_rows == 0:
             raise LightGBMError("train_cycle with no ingested rows")
         cycle_dir = self._cycle_dir(self.cycle)
@@ -200,13 +378,43 @@ class ContinuousTrainer:
                                        "iteration": resumed_from})
             log_info(f"continuous: cycle {self.cycle} resuming from "
                      f"iteration {resumed_from}")
-        X = np.concatenate(self._train_X)
-        y = np.concatenate(self._train_y)
+        compiles0, _ = compile_snapshot()
+        t_setup = time.perf_counter()
+        rebin_event = None
+        fresh_rows = 0
         init = None
-        if self.model_str is not None:
-            from ..basic import Booster
-            init = Booster(model_str=self.model_str)
-        ds = lgb.Dataset(X, y, free_raw_data=False)
+        init_score_s = 0.0
+        if self.incremental:
+            if self._store is None:
+                fresh_rows = self.num_train_rows
+                self._build_store()
+            else:
+                fresh_rows = self._sync_store()
+                rebin_event = self._maybe_rebin()
+            setup_s = time.perf_counter() - t_setup
+            # init-score maintenance, reported separately from dataset
+            # setup: O(fresh rows x trees) host prediction of the
+            # committed model over JUST the fresh segment (the legacy
+            # path re-predicted the full model over ALL history)
+            t_init = time.perf_counter()
+            self._ensure_raw_base()
+            self._store.set_init_score(self._raw_base)
+            init_score_s = time.perf_counter() - t_init
+            ds = lgb.Dataset._from_handle(self._store, self.params)
+        else:
+            X = np.concatenate(self._train_X)
+            y = np.concatenate(self._train_y)
+            if self.model_str is not None:
+                from ..basic import Booster
+                init = Booster(model_str=self.model_str)
+            ds = lgb.Dataset(X, y, free_raw_data=False)
+            if init is None:
+                # with init_model, engine.train rebuilds the handle after
+                # folding in the init score — constructing here would pay
+                # the full O(total) build twice; measure it only when the
+                # build we trigger is the one training uses
+                ds.construct()
+            setup_s = time.perf_counter() - t_setup
         booster = lgb.train(
             self.params, ds, num_boost_round=self.rounds,
             init_model=init, callbacks=list(callbacks or []),
@@ -215,29 +423,61 @@ class ContinuousTrainer:
         delta_str = booster.model_to_string()
         candidate = (delta_str if self.model_str is None
                      else combine_model_strings(self.model_str, delta_str))
+        if self.incremental:
+            # candidate raw score per train row IS the final train score
+            # (init + delta raw) — cached so the next cycle's init scores
+            # never need an O(total x trees) full-model predict
+            self._last_raw = np.asarray(
+                booster._gbdt.train_score[0],
+                np.float32)[:self._store.num_data].astype(np.float64)
         hx, hy = self.holdout()
         auc = holdout_auc(candidate, hx, hy) if len(hy) else float("nan")
-        return {"cycle": self.cycle, "delta_booster": booster,
-                "candidate_str": candidate, "auc": auc,
-                "resumed_from": resumed_from, "cycle_dir": cycle_dir,
-                "train_rows": len(y)}
+        compiles1, _ = compile_snapshot()
+        out = {"cycle": self.cycle, "delta_booster": booster,
+               "candidate_str": candidate, "auc": auc,
+               "resumed_from": resumed_from, "cycle_dir": cycle_dir,
+               "train_rows": self.num_train_rows,
+               "fresh_rows": fresh_rows,
+               "setup_s": round(setup_s, 6),
+               "init_score_s": round(init_score_s, 6),
+               "compiles": int(compiles1 - compiles0),
+               "rebin": rebin_event}
+        if self.incremental:
+            out["row_bucket"] = int(self._store.num_rows_device)
+            out["pad_fraction"] = round(self._store.pad_fraction, 4)
+            out["drift_max_psi"] = round(self._sketch.max_score(), 5)
+        return out
 
     def commit(self, candidate_str: str) -> None:
         """Advance the committed model (the gate accepted the candidate)
         and move on to the next cycle's checkpoint namespace."""
         self._prev_model_str = self.model_str
+        self._prev_raw_base = self._raw_base
         self.model_str = candidate_str
+        if self.incremental and self._last_raw is not None:
+            self._raw_base = self._last_raw
+            self._last_raw = None
         self.cycle += 1
+        self._cycles_since_rebin += 1
 
     def revert(self) -> None:
         """Post-publish rollback: the gate withdrew the last committed
         model, so future cycles must boost from the model that is
         actually serving again — not the withdrawn one."""
         self.model_str = self._prev_model_str
+        if not self.incremental:
+            return
+        # the restored model's raw cache is the one captured at ITS
+        # commit; rows synced since are backfilled by _ensure_raw_base at
+        # the next cycle (it predicts just the missing tail)
+        self._raw_base = (self._prev_raw_base
+                          if self.model_str is not None else None)
 
     def discard(self) -> None:
         """Gate rejected the candidate: keep the committed model, burn
         the cycle number (its checkpoints describe the rejected run and
         must not be resumed into the next attempt, which will see
         different data)."""
+        self._last_raw = None
         self.cycle += 1
+        self._cycles_since_rebin += 1

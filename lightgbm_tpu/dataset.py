@@ -30,6 +30,70 @@ from .timer import timed
 __all__ = ["Metadata", "TrainDataset", "ValidDataset"]
 
 
+def _train_row_bucket(n: int) -> int:
+    """Power-of-two row bucket for TRAINING shapes (config
+    ``train_row_buckets``): the serving ladder (ops/predict.py) reused so
+    a pool growing across continuation cycles hits a small finite set of
+    compiled training programs instead of recompiling per row count."""
+    from .ops.predict import row_bucket
+    return int(row_bucket(n))
+
+
+class _AppendBuffer:
+    """Amortized-growth row buffer backing the incremental dataset store.
+
+    ``append`` is O(segment) amortized (capacity doubles on overflow, like
+    a vector), so per-cycle extends never re-copy the whole history the
+    way ``np.concatenate`` over the accumulated pool would.  Slack rows
+    past ``used`` stay zero — ``padded_view`` hands them out directly as
+    the row-bucket padding."""
+
+    def __init__(self, arr: np.ndarray):
+        arr = np.asarray(arr)
+        self._n = arr.shape[0]
+        cap = max(1, self._n)
+        self._buf = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+        self._buf[:self._n] = arr
+
+    @property
+    def used(self) -> int:
+        return self._n
+
+    def _reserve(self, cap: int) -> None:
+        if cap <= self._buf.shape[0]:
+            return
+        cap = max(cap, self._buf.shape[0] * 2)
+        nb = np.zeros((cap,) + self._buf.shape[1:], self._buf.dtype)
+        nb[:self._n] = self._buf[:self._n]
+        self._buf = nb
+
+    def append(self, rows: np.ndarray) -> None:
+        rows = np.asarray(rows)
+        self._reserve(self._n + rows.shape[0])
+        self._buf[self._n:self._n + rows.shape[0]] = rows
+        self._n += rows.shape[0]
+
+    def view(self) -> np.ndarray:
+        return self._buf[:self._n]
+
+    def padded_view(self, n_pad: int) -> np.ndarray:
+        """[n_pad] view: real rows then zero padding (rows past ``used``
+        are zero by construction — the buffer is zero-initialized and
+        never written beyond the append cursor)."""
+        self._reserve(n_pad)
+        return self._buf[:n_pad]
+
+
+def _same_pack_plan(a, b) -> bool:
+    """Two PackPlans describe the same packed layout (plans are pure
+    functions of device_col_num_bins, which the frozen-mapper store never
+    changes — this guards against a config flip mid-store)."""
+    if a is None or b is None:
+        return a is b
+    return (a.pack_spec == b.pack_spec
+            and np.array_equal(np.asarray(a.perm), np.asarray(b.perm)))
+
+
 class Metadata:
     """label / weight / query-boundary / init-score arrays
     (reference Metadata, dataset.h:41-249)."""
@@ -81,6 +145,16 @@ def _bin_sparse_columns(csc, real_index, mappers) -> np.ndarray:
 
 class TrainDataset:
     """Binned dataset + feature metadata, ready for the device grower."""
+
+    # incremental store (extend()): None until the first extend; class-level
+    # defaults so the many __new__-based constructors need no boilerplate
+    _store_bins = None      # per-feature host bin matrix buffer
+    _store_dev = None       # device-space (post-EFB) host matrix buffer
+    _store_label = None
+    _store_weight = None
+    _packed_plan = None     # PackPlan of the cached packed planes
+    _packed_store = None    # packed sub-byte planes buffer (quantized)
+    rank_local = False
 
     def __init__(self, data: np.ndarray, metadata: Metadata, config: Config,
                  categorical_features: Optional[Sequence[int]] = None,
@@ -580,6 +654,7 @@ class TrainDataset:
         self.device_col_num_bins = nbins
         if not place_on_device:
             self.device_bins = None   # the parallel learner shards it
+            self.num_rows_device = self.num_data
             self.label = jnp.asarray(metadata.label)
             self.weight = (jnp.asarray(metadata.weight)
                            if metadata.weight is not None else None)
@@ -589,6 +664,7 @@ class TrainDataset:
                                                  - t_construct)
             return
         cfg = self.config
+        host_dev = bins
         if (enable_efb and getattr(cfg, "enable_bundle", True)
                 and self.num_features >= 4):
             from .efb import find_bundles, make_bundle_map, bundle_rows
@@ -604,17 +680,229 @@ class TrainDataset:
                 self.num_bundles = n_bundles
                 self.device_col_num_bins = np.asarray(
                     bundle_widths(bundles, self.feature_mappers), np.int32)
-                bundled = bundle_rows(bins, bundles, self.feature_mappers)
-                self.device_bins = jnp.asarray(bundled)
-        if self.bundle_map is None:
-            self.device_bins = jnp.asarray(bins)
+                host_dev = bundle_rows(bins, bundles, self.feature_mappers)
 
-        self.label = jnp.asarray(metadata.label)
-        self.weight = (jnp.asarray(metadata.weight)
-                       if metadata.weight is not None else None)
+        self._place_on_device(host_dev, metadata)
+        self.setup_timings["construct_s"] = time.perf_counter() - t_construct
+
+    def _row_buckets_on(self, metadata: Metadata) -> bool:
+        """Row-bucket padding gate: config ``train_row_buckets``, minus the
+        shapes the masking contract can't cover (query/group structure
+        would put padded rows inside queries; linear leaves regress on raw
+        values the pad rows don't have)."""
+        return bool(getattr(self.config, "train_row_buckets", False)
+                    and metadata.query_ids is None
+                    and not getattr(self.config, "linear_tree", False)
+                    # RF folds boost_from_average over the raw label array
+                    # (rf.py _rf_init) — padded zeros would shift it
+                    and getattr(self.config, "boosting", "gbdt") != "rf"
+                    # parallel learners shard the REAL row count; padding
+                    # stays a single-process (serial-learner) feature
+                    and int(getattr(self.config, "num_machines", 1)) <= 1)
+
+    def _place_on_device(self, host_dev_bins: np.ndarray,
+                         metadata: Metadata) -> None:
+        """Device placement of the (possibly EFB-bundled) bin matrix and
+        metadata arrays.  With ``train_row_buckets`` on, the row axis is
+        zero-padded up to its power-of-two bucket first: a pool growing
+        across continuation cycles then reuses the same compiled training
+        programs (and AOT bundle entries) until it outgrows the bucket.
+        Padded rows are masked out of gradients/histograms/bagging by the
+        booster (gbdt.py), so training is bit-identical to the unpadded
+        shape."""
+        from .ops.predict import pad_rows
+        n = host_dev_bins.shape[0]
+        n_pad = _train_row_bucket(n) if self._row_buckets_on(metadata) else n
+        self.num_rows_device = int(n_pad)
+        label = metadata.label
+        weight = metadata.weight
+        if n_pad != n:
+            host_dev_bins = pad_rows(host_dev_bins, n_pad)
+            label = pad_rows(np.asarray(label), n_pad)
+            if weight is not None:
+                weight = pad_rows(np.asarray(weight), n_pad)
+        self.device_bins = jnp.asarray(host_dev_bins)
+        self.label = jnp.asarray(label)
+        self.weight = jnp.asarray(weight) if weight is not None else None
         self.query_ids = (jnp.asarray(metadata.query_ids)
                           if metadata.query_ids is not None else None)
-        self.setup_timings["construct_s"] = time.perf_counter() - t_construct
+
+    # ------------------------------------------------------------------
+    # Incremental construction (frozen-mapper continuation datasets)
+    # ------------------------------------------------------------------
+    @property
+    def pad_fraction(self) -> float:
+        """Fraction of device rows that are bucket padding (0.0 when
+        ``train_row_buckets`` is off or the count lands on a bucket)."""
+        nd = getattr(self, "num_rows_device", self.num_data)
+        return float(nd - self.num_data) / max(nd, 1)
+
+    @classmethod
+    def from_reference(cls, ref: "TrainDataset", data: np.ndarray,
+                       metadata: Metadata) -> "TrainDataset":
+        """Construct a TRAIN dataset aligned with ``ref``: frozen bin
+        mappers AND frozen EFB bundles (reference
+        LoadFromFileAlignWithOtherDataset, dataset_loader.cpp — extended
+        to training datasets for continued-training cycles).
+
+        O(rows) — no GreedyFindBin, no bundle search: rows are binned with
+        ``bin_external`` against ``ref``'s mappers and re-encoded with
+        ``ref``'s bundle map, so ``bins``/``device_bins``/packed planes
+        are bit-identical to ``ref.extend()``ing the same rows."""
+        from .log import LightGBMError
+        if ref.device_bins is None or getattr(ref, "rank_local", False):
+            raise LightGBMError(
+                "from_reference needs a full in-memory reference dataset "
+                "(rank-local shards hold no global device matrix)")
+        data = np.ascontiguousarray(np.asarray(data, np.float64))
+        if metadata.num_data != data.shape[0]:
+            raise ValueError(f"label length {metadata.num_data} != rows "
+                             f"{data.shape[0]}")
+        self = cls.__new__(cls)
+        self.config = ref.config
+        self.metadata = metadata
+        self.all_bin_mappers = ref.all_bin_mappers
+        self.num_total_features = ref.num_total_features
+        self.raw_device = None
+        t0 = time.perf_counter()
+        with timed("setup::binning"):
+            bins = ref.bin_external(data)
+        binning_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        # frozen structural metadata — shared with (not copied from) the
+        # reference: mappers/bundles are immutable once constructed
+        self.real_feature_index = list(ref.real_feature_index)
+        self.feature_mappers = list(ref.feature_mappers)
+        self.num_features = ref.num_features
+        self.num_data = int(data.shape[0])
+        self.max_num_bins = ref.max_num_bins
+        self.num_bins_per_feature = ref.num_bins_per_feature
+        self.has_missing_per_feature = ref.has_missing_per_feature
+        self.is_categorical = ref.is_categorical
+        self.bundle_map = ref.bundle_map
+        self.bundles = ref.bundles
+        if ref.bundle_map is not None:
+            self.num_bundles = ref.num_bundles
+        self.device_col_num_bins = ref.device_col_num_bins
+        self.bins = bins
+        user = getattr(ref, "user_feature_names", None)
+        if user:
+            self.user_feature_names = list(user)
+        self._place_on_device(self.to_device_space(bins), metadata)
+        self.setup_timings = {"binning_s": binning_s,
+                              "construct_s": time.perf_counter() - t1}
+        return self
+
+    def _ensure_store(self) -> None:
+        """Materialize the amortized-growth host buffers behind the
+        incremental store on the first extend()."""
+        if self._store_label is not None:
+            return
+        from .log import LightGBMError
+        if self.bins is None or self.device_bins is None:
+            raise LightGBMError(
+                "extend() needs the host bin matrices; this dataset was "
+                "freed (free_dataset) or loaded without them")
+        self._store_bins = _AppendBuffer(self.bins)
+        self._store_dev = _AppendBuffer(
+            np.asarray(self.device_bins)[:self.num_data])
+        self._store_label = _AppendBuffer(
+            np.asarray(self.metadata.label, np.float32))
+        if self.metadata.weight is not None:
+            self._store_weight = _AppendBuffer(
+                np.asarray(self.metadata.weight, np.float32))
+
+    def extend(self, X_new: np.ndarray, y_new: np.ndarray,
+               weight_new: Optional[np.ndarray] = None) -> np.ndarray:
+        """Append fresh rows binned with this dataset's FROZEN mappers.
+
+        The incremental-continuation fast path: only the fresh segment is
+        binned (``bin_external``) and bundle-encoded — O(segment) host
+        work — and appended to a persistent binned store (amortized-growth
+        buffers, so no O(total) re-concatenation per cycle).  The result
+        is bit-identical to a from-scratch build over the concatenated
+        rows under the same mappers (``from_reference``).  Returns the new
+        rows' per-feature bin matrix (drift sketches feed on it).
+
+        Mapper drift is the caller's problem by design: frozen mappers
+        clamp out-of-range values into edge bins exactly like
+        construction-time binning of unseen values — the drift-triggered
+        re-binning policy (continuous/drift.py) decides when that price
+        warrants a full re-bin.
+
+        Extend BETWEEN training runs, never under a live Booster: a
+        Booster snapshots the device shapes (train score, masks, bucket)
+        at construction, exactly like the reference refuses to add rows
+        to a constructed Dataset."""
+        from .log import LightGBMError
+        if getattr(self, "rank_local", False) or self.device_bins is None:
+            raise LightGBMError(
+                "extend() needs the full device-space matrix; rank-local "
+                "shards cannot extend incrementally")
+        if self.metadata.query_ids is not None:
+            raise LightGBMError("extend() does not support query/group "
+                                "structured data")
+        if self.raw_device is not None:
+            raise LightGBMError(
+                "extend() does not support linear_tree datasets (linear "
+                "leaves regress on raw values; rebuild instead)")
+        t0 = time.perf_counter()
+        X_new = np.ascontiguousarray(np.asarray(X_new, np.float64))
+        y_new = np.asarray(y_new, np.float32).reshape(-1)
+        if X_new.shape[0] != len(y_new):
+            raise ValueError(f"label length {len(y_new)} != rows "
+                             f"{X_new.shape[0]}")
+        has_w = self.metadata.weight is not None or (
+            self._store_weight is not None)
+        if has_w != (weight_new is not None):
+            raise LightGBMError(
+                "extend() weights must be given on every call or on none "
+                "(the store holds one weight column for all rows)")
+        with timed("setup::binning"):
+            new_bins = self.bin_external(X_new)
+            new_dev = self.to_device_space(new_bins)
+        binning_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self._ensure_store()
+        self._store_bins.append(new_bins)
+        self._store_dev.append(new_dev)
+        self._store_label.append(y_new)
+        if has_w:
+            self._store_weight.append(
+                np.asarray(weight_new, np.float32).reshape(-1))
+        if self._packed_store is not None:
+            from .ops.histogram import pack_bins
+            self._packed_store.append(pack_bins(new_dev, self._packed_plan))
+        n = self._store_label.used
+        self.num_data = n
+        # host-facing views + metadata stay real-row-sized
+        self.bins = self._store_bins.view()
+        md = self.metadata
+        md.label = self._store_label.view()
+        md.num_data = n
+        if has_w:
+            md.weight = self._store_weight.view()
+        md.init_score = None        # stale for the grown row set
+        n_pad = _train_row_bucket(n) if self._row_buckets_on(md) else n
+        self.num_rows_device = int(n_pad)
+        # device refresh is a plain transfer of the padded host views —
+        # no device-side concatenation, so no per-shape compiles as the
+        # pool grows
+        self.device_bins = jnp.asarray(self._store_dev.padded_view(n_pad))
+        self.label = jnp.asarray(self._store_label.padded_view(n_pad))
+        self.weight = (jnp.asarray(self._store_weight.padded_view(n_pad))
+                       if has_w else None)
+        self.setup_timings = {"binning_s": binning_s,
+                              "construct_s": time.perf_counter() - t1}
+        return new_bins
+
+    def set_init_score(self, init_score) -> None:
+        """Set/clear the metadata init score in place (the continuous
+        trainer re-seeds it each cycle with the committed model's raw
+        scores instead of predicting the full model over all history)."""
+        self.metadata.init_score = (
+            np.asarray(init_score, np.float64).reshape(-1)
+            if init_score is not None else None)
 
     # ------------------------------------------------------------------
     def packed_device_bins(self, plan) -> np.ndarray:
@@ -632,14 +920,34 @@ class TrainDataset:
         ``device_bins`` stays authoritative for traversal-based score
         updates and rollback).
         """
+        from .log import LightGBMError
         from .ops.histogram import pack_bins
         if self.device_bins is None:
             # self.bins is the pre-bundling storage matrix: packing it
             # under a plan built over device_col_num_bins would produce a
-            # plausibly-shaped but WRONG matrix — refuse instead
-            raise ValueError(
+            # plausibly-shaped but WRONG matrix — refuse instead.
+            # Rank-local shards hit this by construction: their loading
+            # skips device_bins entirely (packed bins for the sharded
+            # data-parallel dataset are a ROADMAP quantized-engine
+            # follow-up).
+            raise LightGBMError(
                 "packed_device_bins needs the device-space matrix; this "
-                "dataset has no device_bins (rank-local shard?)")
+                "dataset has no device_bins (rank-local shard?).  Packed "
+                "sub-byte bins for rank-local data-parallel datasets are "
+                "an open ROADMAP item (quantized engine follow-ups) — "
+                "run with quantized_histograms=false for sharded loading")
+        if self._store_dev is not None:
+            # incremental store: keep the packed planes persistent so an
+            # extend() repacks only its fresh segment instead of the
+            # whole history on every cycle's learner construction
+            if (self._packed_store is None
+                    or not _same_pack_plan(self._packed_plan, plan)):
+                self._packed_plan = plan
+                self._packed_store = _AppendBuffer(
+                    pack_bins(self._store_dev.view(), plan))
+            return self._packed_store.padded_view(self.num_rows_device)
+        # pad rows are bin 0 everywhere, which packs to zero bytes — the
+        # padded matrix is exactly the packed real rows plus zero rows
         return pack_bins(np.asarray(self.device_bins), plan)
 
     def bin_external(self, data: np.ndarray) -> np.ndarray:

@@ -43,7 +43,8 @@ from . import spans
 from .registry import REGISTRY
 
 __all__ = ["TrainingTelemetry", "maybe_training_telemetry",
-           "compile_tracker", "PHASE_KEYS", "hist_path_of"]
+           "compile_tracker", "compile_snapshot", "PHASE_KEYS",
+           "hist_path_of"]
 
 PHASE_KEYS = ("grad_s", "grow_s", "hist_s", "split_s", "partition_s",
               "comm_s", "apply_s", "checkpoint_s")
@@ -89,6 +90,16 @@ class _CompileTracker:
 
 
 compile_tracker = _CompileTracker()
+
+
+def compile_snapshot():
+    """(count, seconds) snapshot of the process-wide XLA backend-compile
+    tracker, installing the listener on first use so DELTAS work even when
+    telemetry=off.  The continuous trainer brackets each cycle with this
+    to export per-cycle compile counts — the "steady-state cycles compile
+    nothing" evidence for bucketed incremental training."""
+    compile_tracker.install()
+    return compile_tracker.snapshot()
 
 
 def maybe_training_telemetry(config) -> Optional["TrainingTelemetry"]:

@@ -589,10 +589,18 @@ def grow_tree(cfg: GrowerConfig,
     clips = jnp.zeros((), jnp.int32)
     if cfg.quantized:
         # per-iteration int16 quantization; the accumulator headroom limit
-        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap
+        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap.
+        # When the booster supplies bounds, their third slot carries the
+        # REAL row count (gbdt._quant_bounds_arr): under row-bucket
+        # padding the shape-derived count would be the padded one, which
+        # over-reserves headroom and coarsens the scale vs the unpadded
+        # run — masked pads add nothing to the accumulators, so the real
+        # count is both exact and safe
         n_total = jnp.asarray(n, jnp.float32)
         if ax is not None:
             n_total = jax.lax.psum(n_total, ax)
+        if quant_bounds is not None and quant_bounds.shape[0] >= 3:
+            n_total = quant_bounds[2]
         grad_m, hess_m, count_m, hist_scale, clips = quantize_grad_hess(
             grad_m, hess_m, sample_mask, n_total, quant_bounds,
             axis_name=ax)
@@ -845,10 +853,18 @@ def grow_tree_compact(cfg: GrowerConfig,
     clips = jnp.zeros((), jnp.int32)
     if cfg.quantized:
         # per-iteration int16 quantization; the accumulator headroom limit
-        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap
+        # uses the GLOBAL row count so cross-shard int32 psums cannot wrap.
+        # When the booster supplies bounds, their third slot carries the
+        # REAL row count (gbdt._quant_bounds_arr): under row-bucket
+        # padding the shape-derived count would be the padded one, which
+        # over-reserves headroom and coarsens the scale vs the unpadded
+        # run — masked pads add nothing to the accumulators, so the real
+        # count is both exact and safe
         n_total = jnp.asarray(n, jnp.float32)
         if ax is not None:
             n_total = jax.lax.psum(n_total, ax)
+        if quant_bounds is not None and quant_bounds.shape[0] >= 3:
+            n_total = quant_bounds[2]
         grad_m, hess_m, count_m, hist_scale, clips = quantize_grad_hess(
             grad_m, hess_m, sample_mask, n_total, quant_bounds,
             axis_name=ax)
@@ -1585,9 +1601,12 @@ class SerialTreeLearner:
             self.cegb_lazy_pen = jnp.asarray(lp)
             self.grower_cfg = self.grower_cfg._replace(use_cegb_lazy=True)
             # allocate eagerly so the grower compiles once (None vs array
-            # would be two trace signatures)
+            # would be two trace signatures); sized to the DEVICE rows
+            # (row-bucket padding included — padded rows never gain mass,
+            # their sample_mask is zero)
             self._cegb_used = jnp.zeros(
-                (dataset.num_data, dataset.num_features), bool)
+                (getattr(dataset, "num_rows_device", dataset.num_data),
+                 dataset.num_features), bool)
         # forced splits (reference forcedsplits_filename): compact grower
         # only — the dense grower keeps no per-leaf histogram pool to gather
         # threshold sums from

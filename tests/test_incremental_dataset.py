@@ -1,0 +1,401 @@
+"""Incremental dataset pipeline (ISSUE 10).
+
+Covers the three tentpole pieces and their seams:
+
+- frozen-mapper incremental datasets: ``TrainDataset.extend`` /
+  ``from_reference`` / ``Dataset(reference=, reference_as_train)`` must be
+  bit-identical (bins, device_bins, packed planes, trained model string)
+  to a from-scratch build under the same mappers;
+- ``bin_external`` parity with construction-time binning for NaN/missing,
+  out-of-range, and categorical values — the seam the whole incremental
+  path leans on;
+- row-bucket-padded training (``train_row_buckets``) bit-identical to
+  unpadded training across plain/bagging/GOSS, with the jaxpr-consts
+  static guard extended to the padded fused block (the PR 6
+  HLO-constant-inlining class);
+- the drift-triggered re-binning policy (``continuous_rebin_policy``):
+  fires on an injected distribution shift, silent on stationary replay.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata, TrainDataset
+from lightgbm_tpu.log import LightGBMError
+
+
+def _pool(n, seed=0, f=8, shift=0.0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f) + shift
+    X[::9, 2] = np.nan                      # missing values
+    X[:, 5] = rng.randint(0, 6, n)          # categorical-ish column
+    y = ((X[:, 0] - shift + 0.5 * X[:, 1]
+          + 0.4 * rng.randn(n)) > 0).astype(np.float64)
+    return X, y
+
+
+CFG = {"objective": "binary", "max_bin": 63, "verbosity": -1}
+
+
+# ---------------------------------------------------------------------------
+# bin_external parity — the seam extend()/from_reference lean on
+# ---------------------------------------------------------------------------
+def test_bin_external_parity_nan_categorical_out_of_range():
+    """Rows binned through bin_external must match construction-time
+    binning bit-for-bit — including NaN/missing, raw zeros, categorical
+    ids (seen, unseen, negative) and values far outside the mapper's
+    construction range (which clamp into the edge bins)."""
+    X, y = _pool(900, seed=1)
+    ds = TrainDataset(X, Metadata(y), Config(CFG),
+                      categorical_features=[5])
+    # construction-time binning of the exact same rows
+    assert np.array_equal(ds.bins, ds.bin_external(X))
+
+    # adversarial fresh rows: out-of-range, unseen categories, NaN, zero
+    Xq = np.copy(X[:16])
+    Xq[0, 0] = 1e9
+    Xq[1, 0] = -1e9
+    Xq[2, 1] = np.nan
+    Xq[3, 5] = 99.0        # unseen category -> bin 0 ("other")
+    Xq[4, 5] = -3.0        # negative category = missing-ish
+    Xq[5, 3] = 0.0
+    ref = TrainDataset.from_reference(ds, Xq, Metadata(np.zeros(16)))
+    assert np.array_equal(ref.bins, ds.bin_external(Xq))
+    # extremes clamp into the finite bin range, never overflow it
+    nb = np.asarray([m.num_bin for m in ds.feature_mappers])
+    assert (ds.bin_external(Xq) < nb[None, :]).all()
+
+
+# ---------------------------------------------------------------------------
+# frozen-mapper incremental datasets
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("quantized", [False, True])
+def test_extend_bit_identical_to_from_reference(quantized):
+    """extend()ing a dataset segment by segment must produce bins,
+    device_bins, packed planes and a TRAINED MODEL bit-identical to a
+    from-scratch build over the concatenated rows under the same frozen
+    mappers (from_reference)."""
+    params = dict(CFG, num_leaves=7, min_data_in_leaf=5,
+                  train_row_buckets=True)
+    if quantized:
+        params.update(quantized_histograms=True, max_bin=15,
+                      histogram_impl="onehot")
+    X0, y0 = _pool(700, seed=2)
+    X1, y1 = _pool(300, seed=3)
+    X2, y2 = _pool(250, seed=4)
+    cfg = Config(params)
+
+    inc = TrainDataset(X0, Metadata(y0), cfg, categorical_features=[5])
+    inc.extend(X1, y1)
+    inc.extend(X2, y2)
+    Xall = np.concatenate([X0, X1, X2])
+    yall = np.concatenate([y0, y1, y2])
+    scratch = TrainDataset.from_reference(inc, Xall, Metadata(yall))
+
+    assert np.array_equal(inc.bins, scratch.bins)
+    assert np.array_equal(np.asarray(inc.device_bins),
+                          np.asarray(scratch.device_bins))
+    assert np.array_equal(np.asarray(inc.label), np.asarray(scratch.label))
+    assert inc.num_rows_device == scratch.num_rows_device
+
+    def train_on(handle):
+        ds = lgb.Dataset._from_handle(handle, params)
+        return lgb.train(params, ds, num_boost_round=5).model_to_string()
+
+    a = train_on(inc)
+    b = train_on(scratch)
+    assert a == b
+    if quantized:
+        # packed planes: the incremental packed store must equal a full
+        # repack of the final device matrix (learner construction above
+        # exercised the store path already; compare against a fresh pack)
+        from lightgbm_tpu.ops.histogram import pack_bins, plan_packed_classes
+        plan = plan_packed_classes(inc.device_col_num_bins,
+                                   inc.max_num_bins)
+        assert plan is not None
+        assert np.array_equal(
+            inc.packed_device_bins(plan),
+            pack_bins(np.asarray(scratch.device_bins), plan))
+
+
+def test_extend_is_o_segment_not_o_total():
+    """The per-extend host work must not re-concatenate history: the
+    store's buffers grow amortized, so extending a large pool with a tiny
+    segment re-bins only the segment."""
+    X0, y0 = _pool(4000, seed=5)
+    ds = TrainDataset(X0, Metadata(y0), Config(CFG))
+    binned_before = ds.setup_timings["binning_s"]
+    Xs, ys = _pool(50, seed=6)
+    ds.extend(Xs, ys)
+    # the segment's binning is ~80x smaller than the pool's; even with
+    # fixed overheads it must come in far under the full build
+    assert ds.setup_timings["binning_s"] < max(binned_before, 0.05)
+    assert ds.num_data == 4050
+    # buffer identity: the per-feature matrix is a view of the growing
+    # buffer, not a fresh concatenation
+    buf = ds._store_bins
+    ds.extend(Xs, ys)
+    assert ds._store_bins is buf
+
+
+def test_extend_input_validation():
+    X0, y0 = _pool(300, seed=7)
+    ds = TrainDataset(X0, Metadata(y0), Config(CFG))
+    with pytest.raises(ValueError):
+        ds.extend(_pool(40, seed=8)[0], np.zeros(3))
+    with pytest.raises(LightGBMError):
+        ds.extend(_pool(40, seed=8)[0], np.zeros(40), weight_new=np.ones(40))
+    # weighted store demands weights on every extend
+    dsw = TrainDataset(X0, Metadata(y0, weight=np.ones(300)), Config(CFG))
+    with pytest.raises(LightGBMError):
+        dsw.extend(_pool(40, seed=8)[0], np.zeros(40))
+    dsw.extend(_pool(40, seed=8)[0], np.zeros(40), weight_new=np.ones(40))
+    assert dsw.num_data == 340
+
+
+def test_dataset_reference_as_train():
+    """The public Dataset(reference=..., params={reference_as_train}) path
+    constructs a TRAIN dataset with frozen mappers, trainable end-to-end
+    and aligned with the reference's binning."""
+    X0, y0 = _pool(800, seed=9)
+    X1, y1 = _pool(400, seed=10)
+    base = lgb.Dataset(X0, label=y0, params=CFG)
+    base.construct()
+    aligned = lgb.Dataset(X1, label=y1, reference=base,
+                          params=dict(CFG, reference_as_train=True))
+    aligned.construct()
+    assert isinstance(aligned._handle, TrainDataset)
+    assert np.array_equal(aligned._handle.bins,
+                          base._handle.bin_external(X1))
+    bst = lgb.train(dict(CFG, num_leaves=7), aligned, num_boost_round=3)
+    assert bst.num_trees() == 3
+
+
+# ---------------------------------------------------------------------------
+# row-bucket-padded training
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["plain", "bagging", "goss"])
+def test_bucketed_training_bit_identical(mode):
+    """train_row_buckets pads N=700 up to its 1024 bucket; the padded rows
+    are masked out of gradients/histograms/bagging/GOSS, so the trained
+    model string is BIT-IDENTICAL to the unpadded run — the acceptance
+    bar for shape-bucketed training."""
+    X, y = _pool(700, seed=11)
+    extra = {
+        "plain": {},
+        "bagging": dict(bagging_fraction=0.7, bagging_freq=2),
+        "goss": dict(boosting="goss", top_rate=0.3, other_rate=0.3,
+                     learning_rate=0.5),
+    }[mode]
+
+    def train(bucketed):
+        p = dict(CFG, num_leaves=15, min_data_in_leaf=5, seed=3, **extra)
+        if bucketed:
+            p["train_row_buckets"] = True
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        return lgb.train(p, ds, num_boost_round=10).model_to_string()
+
+    assert train(True) == train(False)
+
+
+def test_bucketed_guards():
+    """Configs the padding contract can't cover are rejected (custom fobj,
+    renew-output objectives) or quietly unpadded (query data)."""
+    X, y = _pool(300, seed=12)
+    p = dict(CFG, train_row_buckets=True, num_leaves=7)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(p, objective="none"), ds, num_boost_round=2,
+                  fobj=lambda s, d: (np.zeros(300), np.ones(300)))
+    with pytest.raises(LightGBMError):
+        lgb.train(dict(p, objective="regression_l1"),
+                  lgb.Dataset(X, label=np.asarray(y, np.float64)),
+                  num_boost_round=2)
+    # ranking data: padding silently disabled (queries must stay intact)
+    handle = TrainDataset(X, Metadata(y, group=np.asarray([150, 150])),
+                          Config(p))
+    assert handle.num_rows_device == handle.num_data == 300
+
+
+def test_fused_signature_stable_across_bucket():
+    """Two boosters over different real row counts in the SAME bucket
+    must produce identical fused-block signatures — the fact that lets
+    continuation cycles reuse AOT bundle entries and the process-wide
+    executable cache (zero steady-state compiles)."""
+    sigs = []
+    for n in (600, 900):
+        X, y = _pool(n, seed=13)
+        p = dict(CFG, num_leaves=7, train_row_buckets=True)
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=1)
+        g = bst._gbdt
+        sigs.append(g._fused_signature(0, 1, g._fused_example_args(1)))
+    assert sigs[0] == sigs[1]
+
+
+def test_no_closure_array_constants_in_padded_programs():
+    """jaxpr-consts static guard (PR 9's class) extended to the padded /
+    bucketed train step over an EXTENDED dataset: padding masks, GOSS
+    priorities, and the appended bin matrix must ride as jit arguments,
+    never closure constants baked into the program."""
+    X0, y0 = _pool(500, seed=14)
+    X1, y1 = _pool(200, seed=15)
+    params = dict(CFG, num_leaves=7, boosting="goss", top_rate=0.3,
+                  other_rate=0.3, learning_rate=0.5,
+                  train_row_buckets=True)
+    # the booster is built over an EXTENDED incremental store (extend
+    # happens between runs, like the continuous trainer's cycles)
+    handle = TrainDataset(X0, Metadata(y0), Config(params),
+                          categorical_features=[5])
+    handle.extend(X1, y1)
+    assert handle.num_rows_device == 1024     # 700 rows -> 1024 bucket
+    ds = lgb.Dataset._from_handle(handle, params)
+    bst = lgb.train(params, ds, num_boost_round=1)
+    gbdt = bst._gbdt
+
+    def max_const_elems(closed_jaxpr):
+        sizes = [int(np.asarray(c).size) for c in closed_jaxpr.consts
+                 if hasattr(c, "shape")]
+        return max(sizes, default=0)
+
+    # variant 1 = GOSS sampling active: the padded payload (priorities,
+    # ks, multiply) and the validity mask must all be arguments
+    block = gbdt._build_fused_block(1, 2)
+    args = gbdt._fused_example_args(2)
+    closed = jax.make_jaxpr(block)(*args)
+    assert max_const_elems(closed) <= 64, (
+        "the padded fused block captured an array constant instead of "
+        "taking it as an argument")
+
+
+# ---------------------------------------------------------------------------
+# drift-triggered re-binning
+# ---------------------------------------------------------------------------
+def test_drift_sketch_scores():
+    from lightgbm_tpu.continuous import DriftSketch
+    X, y = _pool(2000, seed=16)
+    ds = TrainDataset(X, Metadata(y), Config(CFG))
+    sk = DriftSketch(np.asarray(ds.num_bins_per_feature))
+    sk.set_reference(ds.bins)
+    # stationary window: PSI stays small
+    Xs, _ = _pool(1000, seed=17)
+    sk.update(ds.bin_external(Xs))
+    stationary = sk.max_score()
+    assert stationary < 0.2, stationary
+    # shifted window: PSI blows past the threshold
+    Xd, _ = _pool(1000, seed=18, shift=4.0)
+    sk.update(ds.bin_external(Xd))
+    assert sk.max_score() > 0.5
+    top = sk.summary()["top_features"]
+    assert top and top[0]["psi"] > 0.5
+
+
+def test_trainer_rebin_policies(tmp_path):
+    """drift policy: fires on an injected shift, silent on stationary
+    replay; every_k: fires on schedule; never: never.  The persistent
+    store survives cycles untouched until a re-bin rebuilds it."""
+    from lightgbm_tpu.continuous import ContinuousTrainer
+    params = dict(CFG, num_leaves=7, min_data_in_leaf=5)
+
+    def seg(seed, shift=0.0, n=600):
+        return _pool(n, seed=seed, shift=shift)
+
+    # --- drift: stationary replay stays silent -------------------------
+    tr = ContinuousTrainer(params, str(tmp_path / "w1"), rounds_per_cycle=2)
+    tr.ingest(*seg(20))
+    r0 = tr.train_cycle()
+    store = tr._store
+    tr.commit(r0["candidate_str"])
+    tr.ingest(*seg(21))
+    r1 = tr.train_cycle()
+    assert r1["rebin"] is None and tr._store is store
+    assert r1["fresh_rows"] > 0 and r1["setup_s"] < r0["setup_s"] * 5
+    tr.commit(r1["candidate_str"])
+    # --- drift: injected shift fires + rebuilds the store --------------
+    base_rebins = int(tr.m_rebins.value)
+    tr.ingest(*seg(22, shift=4.0))
+    r2 = tr.train_cycle()
+    assert r2["rebin"] is not None and r2["rebin"]["reason"] == "drift"
+    assert tr._store is not store               # rebuilt with fresh mappers
+    assert int(tr.m_rebins.value) == base_rebins + 1
+
+    # --- every_k fires on schedule regardless of drift -----------------
+    tr2 = ContinuousTrainer(params, str(tmp_path / "w2"),
+                            rounds_per_cycle=2, rebin_policy="every_k",
+                            rebin_every_k=2)
+    for i in range(3):
+        tr2.ingest(*seg(30 + i))
+        res = tr2.train_cycle()
+        tr2.commit(res["candidate_str"])
+    assert [e["reason"] for e in tr2.rebin_events] == ["every_k"]
+
+    # --- never ---------------------------------------------------------
+    tr3 = ContinuousTrainer(params, str(tmp_path / "w3"),
+                            rounds_per_cycle=2, rebin_policy="never")
+    tr3.ingest(*seg(40))
+    tr3.commit(tr3.train_cycle()["candidate_str"])
+    tr3.ingest(*seg(41, shift=4.0))
+    assert tr3.train_cycle()["rebin"] is None
+
+
+def test_trainer_incremental_continuation_quality(tmp_path):
+    """The incremental init-score cache must reproduce real continuation:
+    the stitched candidate's raw prediction equals base raw + delta raw,
+    and cumulative AUC stays healthy across cycles."""
+    from lightgbm_tpu.continuous import ContinuousTrainer, holdout_auc
+    params = dict(CFG, num_leaves=15, min_data_in_leaf=10,
+                  learning_rate=0.3)
+    tr = ContinuousTrainer(params, str(tmp_path / "w"), rounds_per_cycle=4)
+    aucs = []
+    for c in range(3):
+        tr.ingest(*_pool(900, seed=50 + c))
+        res = tr.train_cycle()
+        tr.commit(res["candidate_str"])
+        aucs.append(res["auc"])
+    assert all(a > 0.8 for a in aucs), aucs
+    # stitched raw == base raw + delta raw (the continuation contract)
+    from lightgbm_tpu.basic import Booster
+    Xq, _ = _pool(200, seed=60)
+    raw_full = Booster(model_str=tr.model_str).predict(Xq, raw_score=True)
+    raw_base = Booster(model_str=tr._prev_model_str).predict(
+        Xq, raw_score=True)
+    raw_delta = res["delta_booster"].predict(Xq, raw_score=True)
+    np.testing.assert_allclose(raw_full, raw_base + raw_delta, atol=1e-5)
+
+
+def test_holdout_cache_invalidated_on_ingest(tmp_path):
+    from lightgbm_tpu.continuous import ContinuousTrainer
+    tr = ContinuousTrainer(dict(CFG), str(tmp_path / "w"))
+    tr.ingest(*_pool(400, seed=70))
+    hx1, hy1 = tr.holdout()
+    hx2, hy2 = tr.holdout()
+    assert hx1 is hx2 and hy1 is hy2      # cached: no per-poll concat
+    tr.ingest(*_pool(100, seed=71))
+    hx3, _ = tr.holdout()
+    assert hx3 is not hx1 and len(hx3) > len(hx1)
+
+
+# ---------------------------------------------------------------------------
+# small fix: packed bins on rank-local shards
+# ---------------------------------------------------------------------------
+def test_packed_rank_local_raises_lightgbm_error():
+    """A rank-local (device_bins-free) dataset asked for packed planes
+    must raise LightGBMError naming the ROADMAP follow-up — not a bare
+    ValueError."""
+    from lightgbm_tpu.ops.histogram import plan_packed_classes
+    X, y = _pool(400, seed=80)
+    params = dict(CFG, max_bin=15, tree_learner="data", num_machines=2,
+                  num_tpu_devices=8, pre_partition=True)
+    ds = TrainDataset.from_rank_shard(X, y.astype(np.float32),
+                                      Config(params))
+    assert getattr(ds, "rank_local", False)
+    assert ds.device_bins is None
+    plan = plan_packed_classes(ds.device_col_num_bins, ds.max_num_bins)
+    with pytest.raises(LightGBMError, match="ROADMAP"):
+        ds.packed_device_bins(plan)
+    with pytest.raises(LightGBMError):
+        ds.extend(X[:10], y[:10])         # incremental path also refuses
