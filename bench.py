@@ -100,6 +100,31 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  claims are control-flow and persistence claims.  Knobs:
                  BENCH_CONT_{ROUNDS,SEG_ROWS,THREADS,KILL_ITER,MIN_AUC,
                  MAX_REQ_ROWS}.
+- continuous_sharded  sharded-fleet ingest chaos soak
+                 (run_continuous_sharded): TWO supervised continuous
+                 worker PROCESSES (cluster.continuous_distributed), each
+                 tailing its crc32 hash shard of one segment directory
+                 into a rank-local store under fleet-shared fingerprinted
+                 mappers (lightgbm_tpu/continuous/sharded.py).  Faults
+                 armed: LGBM_TPU_FAULT_CYCLE kills rank 1 mid-cycle-0
+                 (after its shard was polled+journaled, before the commit
+                 record) — the supervisor relaunches the fleet and the
+                 journal replay must finish the cycle; one UNREADABLE
+                 segment (a directory where a segment should be — the
+                 bounded-backoff budget must quarantine it whole) and one
+                 POISONED segment (bad rows quarantined).  Mid-soak a
+                 drifted batch lands on ONE rank's shard only: the
+                 psum-reduced PSI must trigger exactly one FLEET-WIDE
+                 re-bin (artifact v2 on every rank).  Reported:
+                 model_bit_identical vs an uninterrupted control fleet
+                 (vs_baseline 1.0 == byte-equal), journal_exactly_once,
+                 fleet_rebins (bar: 1 per rank, same cycle),
+                 steady_compiles_per_rank (bar: 0 at stable buckets),
+                 quarantined rows + unreadable segment count, restarts.
+                 CPU by design (replicated union fallback training —
+                 this backend has no cross-process device collectives);
+                 the claims are coordination claims.  Knobs:
+                 BENCH_SHARD_{ROUNDS,SEG_ROWS,TIMEOUT}.
 """
 
 import json
@@ -1221,6 +1246,225 @@ def run_continuous():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_continuous_sharded():
+    """Child body for BENCH_STAGE=continuous_sharded: the fleet-ingest
+    chaos soak (see the stage doc at the top of this file)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    from lightgbm_tpu.cluster import continuous_distributed
+    from lightgbm_tpu.continuous import shard_of
+
+    rounds = int(os.environ.get("BENCH_SHARD_ROUNDS", 4))
+    seg_rows = int(os.environ.get("BENCH_SHARD_SEG_ROWS", 800))
+    timeout = int(os.environ.get("BENCH_SHARD_TIMEOUT", 420))
+    nf = 8
+
+    def seg_name(i, want_rank):
+        j = 0
+        while True:
+            name = f"seg{i:03d}_{j}.csv"
+            if shard_of(name, 2) == want_rank:
+                return name
+            j += 1
+
+    def write_segment(src, name, seed, shift=0.0, poison=0,
+                      mix=False, rows=None):
+        rows = int(rows or seg_rows)
+        r = np.random.RandomState(seed)
+        X = r.randn(rows, nf)
+        if mix:
+            # post-re-bin traffic: same clean/drifted mixture as the
+            # re-binned reference pool, so PSI stays at noise level and
+            # the soak's "exactly one fleet-wide re-bin" bar is clean
+            X[rows // 2:] += 3.0
+        else:
+            X += shift
+        y = (r.rand(rows) < 1 / (1 + np.exp(
+            -(2 * X[:, 0] + X[:, 1])))).astype(float)
+        lines = [",".join([f"{y[i]:.0f}"]
+                          + [f"{v:.6f}" for v in X[i]])
+                 for i in range(rows)]
+        lines.extend("7,not,a,number" for _ in range(poison))
+        tpath = os.path.join(src, f"_{name}.part")
+        with open(tpath, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tpath, os.path.join(src, name))
+
+    def run_fleet(root, fault_env):
+        src = os.path.join(root, "src")
+        work = os.path.join(root, "work")
+        os.makedirs(src)
+        os.makedirs(work)
+        # cycle 0 data: one clean segment per shard, one POISONED
+        # segment (bad rows quarantine, never a crash), one UNREADABLE
+        # segment (a directory: the bounded retry budget must
+        # quarantine it whole with reason=unreadable)
+        write_segment(src, seg_name(0, 0), seed=10)
+        write_segment(src, seg_name(1, 1), seed=11)
+        write_segment(src, seg_name(2, 1), seed=12, poison=40)
+        os.makedirs(os.path.join(src, seg_name(3, 0)))
+        # segment drops are PROGRESS-driven, not wall-clock: the writer
+        # watches the fleet's commit record and releases batch k+1 only
+        # after cycle k committed (plus a settle window of idle polls —
+        # where the unreadable segment's retry budget burns down).
+        # Wall-clock timers would race the chaos fleet's relaunch and
+        # partition segments into different cycles than the control,
+        # which is a legitimately different training schedule — this
+        # keeps the cycle partitioning identical in both fleets so the
+        # bit-identity bar compares like with like.
+        def late_writes():
+            # DRIFT on rank 0's shard ONLY: the reduced-PSI consensus
+            # must trigger exactly one fleet-wide re-bin.  One segment,
+            # one rename: a multi-file drop could straddle a poll
+            # boundary differently in the control and chaos fleets and
+            # split the cycle partitioning the bit-identity bar needs
+            write_segment(src, seg_name(4, 0), seed=104, shift=3.0,
+                          rows=3 * seg_rows)
+
+        def final_write():
+            write_segment(src, seg_name(7, 1), seed=200, mix=True)
+
+        def steady_write():
+            # small enough to stay inside the union's row bucket: the
+            # cycle it triggers must compile NOTHING (the bar)
+            write_segment(src, seg_name(8, 0), seed=201, mix=True,
+                          rows=120)
+
+        stop_writer = threading.Event()
+
+        def progression_writer():
+            state_path = os.path.join(work, "fleet",
+                                      "commit_state.json")
+            for k, writer in enumerate((late_writes, final_write,
+                                        steady_write)):
+                deadline = time.time() + 240
+                while not stop_writer.is_set() \
+                        and time.time() < deadline:
+                    try:
+                        with open(state_path) as fh:
+                            if json.load(fh)["cycle"] >= k:
+                                break
+                    except (OSError, ValueError, KeyError):
+                        pass
+                    time.sleep(1.0)
+                if stop_writer.is_set():
+                    return
+                time.sleep(6.0)      # idle polls: retry budget burns
+                writer()
+
+        writer_thread = threading.Thread(target=progression_writer,
+                                         daemon=True)
+        writer_thread.start()
+        params = {"objective": "binary", "num_leaves": 15,
+                  "learning_rate": 0.2, "verbosity": -1,
+                  "max_bin": MAX_BIN, "min_data_in_leaf": 20, "seed": 7,
+                  "continuous_source": src, "continuous_dir": work,
+                  "continuous_rounds": rounds,
+                  "continuous_poll_s": 0.3,
+                  "continuous_min_auc": 0.55,
+                  "continuous_segment_retry_max": 2,
+                  "continuous_segment_retry_backoff_s": 0.1,
+                  "continuous_max_idle_polls": 200,
+                  "continuous_max_cycles": 4}
+        old = {k: os.environ.get(k) for k in fault_env}
+        os.environ.update(fault_env)
+        try:
+            bst = continuous_distributed(
+                params, num_workers=2, platform="cpu", timeout=timeout,
+                log_dir=os.path.join(root, "logs"))
+        finally:
+            stop_writer.set()
+            for k, v in old.items():
+                os.environ.pop(k, None) if v is None else \
+                    os.environ.__setitem__(k, v)
+        state = json.load(open(os.path.join(
+            work, "fleet", "commit_state.json")))
+        model = open(state["model_file"]).read()
+        events, journal, quarantined, unreadable = [], [], 0, 0
+        for r in range(2):
+            ep = os.path.join(work, "fleet", f"events_rank{r}.jsonl")
+            if os.path.exists(ep):
+                events.append([json.loads(l) for l in open(ep)
+                               if l.strip()])
+            else:
+                events.append([])
+            jp = os.path.join(work, "fleet", f"journal_rank{r}.jsonl")
+            if os.path.exists(jp):
+                journal += [json.loads(l) for l in open(jp)
+                            if l.strip()]
+            qp = os.path.join(work, f"quarantine_rank{r}.jsonl")
+            if os.path.exists(qp):
+                recs = [json.loads(l) for l in open(qp) if l.strip()]
+                quarantined += sum(1 for q in recs if q["row"] >= 0)
+                unreadable += sum(1 for q in recs
+                                  if q["reason"] == "unreadable")
+        relaunched = sum(
+            1 for f in os.listdir(os.path.join(root, "logs"))
+            if f.endswith("_a1.log"))
+        return model, state, events, journal, quarantined, unreadable, \
+            relaunched
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_shard_")
+    try:
+        c_model, c_state, c_events, *_ = run_fleet(
+            os.path.join(tmp, "control"), {})
+        model, state, events, journal, quarantined, unreadable, \
+            relaunched = run_fleet(
+                os.path.join(tmp, "chaos"),
+                {"LGBM_TPU_FAULT_CYCLE": "0", "LGBM_TPU_FAULT_RANK": "1",
+                 "LGBM_TPU_FAULT_MODE": "exit"})
+        segs = [s for e in journal for s in e["segments"]]
+        rebins = [sum(1 for ev in rank_ev if ev["rebin"])
+                  for rank_ev in events]
+        # steady compiles: trained cycles whose row bucket matches the
+        # previous cycle's (same shapes) must compile nothing
+        steady = []
+        for rank_ev in events:
+            n = 0
+            for prev, cur in zip(rank_ev, rank_ev[1:]):
+                if cur.get("row_bucket") == prev.get("row_bucket") \
+                        and not cur.get("rebin") \
+                        and not cur.get("replayed"):
+                    n += int(cur.get("compiles") or 0)
+            steady.append(n)
+        bit_identical = (model == c_model)
+        result = {
+            "metric": f"continuous_sharded_2workers_{rounds}rounds_"
+                      f"{seg_rows}segrows",
+            "value": round(time.time() - t_start, 1),
+            "unit": "s",
+            "vs_baseline": 1.0 if bit_identical else 0.0,
+            "model_bit_identical": bit_identical,
+            "committed_cycle": state["cycle"],
+            "decision": state["decision"],
+            "journal_exactly_once": len(segs) == len(set(segs)),
+            "fleet_rebins_per_rank": rebins,
+            "artifact_version": state["artifact_version"],
+            "steady_compiles_per_rank": steady,
+            "quarantined_rows": quarantined,
+            "unreadable_segments_quarantined": unreadable,
+            # workers relaunched by the supervisor after the injected
+            # rank-1 kill (2 == the whole fleet came back once)
+            "relaunched_workers": relaunched,
+            "backend": backend,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def run_hist():
     """Child body for BENCH_STAGE=hist: prove the bin-width-class histogram
     engine without the chip.
@@ -1469,6 +1713,8 @@ if __name__ == "__main__":
             run_fleet()
         elif stage == "continuous":
             run_continuous()
+        elif stage == "continuous_sharded":
+            run_continuous_sharded()
         else:
             run_training()
     else:

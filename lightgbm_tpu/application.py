@@ -306,11 +306,20 @@ class Application:
         registry — served over HTTP on ``serving_port`` while training
         runs (port 0 = train/gate only, no server).  ``input_model``
         seeds the registry (and the continuation base) so serving starts
-        from a known-good model before the first cycle completes."""
+        from a known-good model before the first cycle completes.
+
+        With ``continuous_shards > 1`` this process is ONE RANK of a
+        sharded fleet (continuous/sharded.py): it tails only its shard,
+        coordinates mapper refreshes and cycle commits with its peers,
+        and recovers from its ingest journal on relaunch
+        (``cluster.continuous_distributed`` launches+supervises local
+        fleets)."""
         import threading
 
         from .continuous import (ContinuousService, ContinuousTrainer,
-                                 DataTail, PublishGate)
+                                 DataTail, FleetComm, PublishGate,
+                                 ShardedContinuousService,
+                                 ShardedContinuousTrainer)
         from .serving.server import ServingApp, make_server
         cfg = self.config
         if not cfg.continuous_source:
@@ -324,20 +333,53 @@ class Application:
                          continuous=bool(cfg.serving_continuous_batching))
         name = str(cfg.serving_model_name).split(",")[0] or "default"
         bundle = cfg.aot_bundle_dir or None
-        tail = DataTail(
-            cfg.continuous_source,
-            quarantine_path=f"{workdir}/quarantine.jsonl",
-            allow_nan_features=bool(cfg.continuous_allow_nan_features))
-        trainer = ContinuousTrainer(
-            self.raw_params, workdir,
+        shards = int(cfg.continuous_shards or 0)
+        sharded = shards > 1
+        from .io import file_io
+        file_io.makedirs(workdir)
+        trainer_kwargs = dict(
             rounds_per_cycle=cfg.continuous_rounds,
             holdout_fraction=cfg.continuous_holdout_fraction,
             checkpoint_freq=max(cfg.checkpoint_freq, 1),
             keep_checkpoints=cfg.keep_checkpoints,
-            incremental=bool(cfg.continuous_incremental),
             rebin_policy=cfg.continuous_rebin_policy,
             rebin_threshold=cfg.continuous_rebin_threshold,
             rebin_every_k=cfg.continuous_rebin_every_k)
+        if sharded:
+            from .parallel.mesh import comm_rank, maybe_init_distributed
+            maybe_init_distributed(cfg)
+            rank = comm_rank()
+            comm = FleetComm(rank, shards,
+                             exchange_dir=f"{workdir}/fleet/exchange")
+            tail = DataTail(
+                cfg.continuous_source,
+                quarantine_path=f"{workdir}/quarantine_rank{rank}.jsonl",
+                allow_nan_features=bool(
+                    cfg.continuous_allow_nan_features),
+                shard_rank=rank, num_shards=shards,
+                quarantine_max_bytes=cfg.continuous_quarantine_max_bytes,
+                retry_max=cfg.continuous_segment_retry_max,
+                retry_backoff_s=cfg.continuous_segment_retry_backoff_s)
+            # continuous_incremental passes through: an explicit =false
+            # must hit the trainer's clear "requires the incremental
+            # pipeline" error, not be silently overridden
+            trainer = ShardedContinuousTrainer(
+                self.raw_params, workdir, comm,
+                incremental=bool(cfg.continuous_incremental),
+                **trainer_kwargs)
+        else:
+            tail = DataTail(
+                cfg.continuous_source,
+                quarantine_path=f"{workdir}/quarantine.jsonl",
+                allow_nan_features=bool(
+                    cfg.continuous_allow_nan_features),
+                quarantine_max_bytes=cfg.continuous_quarantine_max_bytes,
+                retry_max=cfg.continuous_segment_retry_max,
+                retry_backoff_s=cfg.continuous_segment_retry_backoff_s)
+            trainer = ContinuousTrainer(
+                self.raw_params, workdir,
+                incremental=bool(cfg.continuous_incremental),
+                **trainer_kwargs)
         gate = PublishGate(app.registry, name,
                            min_auc=cfg.continuous_min_auc,
                            max_regression=cfg.continuous_max_regression,
@@ -351,10 +393,15 @@ class Application:
             trainer.model_str = seed
             log_info(f"continuous: seeded {name!r} v{version} from "
                      f"{cfg.input_model}")
-        service = ContinuousService(tail, trainer, gate,
-                                    poll_s=cfg.continuous_poll_s)
-        from .io import file_io
-        file_io.makedirs(workdir)
+        if sharded:
+            # recovery (journal replay + committed model) runs inside
+            # the constructor; an input_model seed never overrides a
+            # recovered commit record
+            service = ShardedContinuousService(
+                tail, trainer, gate, poll_s=cfg.continuous_poll_s)
+        else:
+            service = ContinuousService(tail, trainer, gate,
+                                        poll_s=cfg.continuous_poll_s)
         httpd = None
         if cfg.serving_port > 0:
             httpd = make_server(app, host=cfg.serving_host,

@@ -6,6 +6,12 @@ test (or a chaos-engineering harness) schedule one fault:
 
     LGBM_TPU_FAULT_ITER=<k>     fire when training reaches iteration k
                                 (0-based, BEFORE the iteration runs)
+    LGBM_TPU_FAULT_CYCLE=<c>    fire when a continuous service reaches
+                                CYCLE c (0-based, after the cycle's
+                                segments were polled but BEFORE its model
+                                is committed — the two-phase ingest
+                                window the sharded service's replay must
+                                cover)
     LGBM_TPU_FAULT_REQUEST=<n>  fire when a serving replica has ADMITTED
                                 its n-th predict request (1-based, BEFORE
                                 serving it — the in-flight request is
@@ -37,16 +43,18 @@ import sys
 from typing import Optional
 
 __all__ = ["InjectedWorkerFault", "fault_spec", "maybe_inject_fault",
+           "cycle_fault_spec", "maybe_inject_cycle_fault",
            "request_fault_spec", "RequestFaultLatch",
            "FAULT_ENV_VARS", "DEFAULT_FAULT_EXIT_CODE"]
 
 FAULT_ITER_ENV = "LGBM_TPU_FAULT_ITER"
+FAULT_CYCLE_ENV = "LGBM_TPU_FAULT_CYCLE"
 FAULT_REQUEST_ENV = "LGBM_TPU_FAULT_REQUEST"
 FAULT_RANK_ENV = "LGBM_TPU_FAULT_RANK"
 FAULT_MODE_ENV = "LGBM_TPU_FAULT_MODE"
 FAULT_EXIT_CODE_ENV = "LGBM_TPU_FAULT_EXIT_CODE"
-FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_REQUEST_ENV, FAULT_RANK_ENV,
-                  FAULT_MODE_ENV, FAULT_EXIT_CODE_ENV)
+FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_CYCLE_ENV, FAULT_REQUEST_ENV,
+                  FAULT_RANK_ENV, FAULT_MODE_ENV, FAULT_EXIT_CODE_ENV)
 DEFAULT_FAULT_EXIT_CODE = 43
 
 
@@ -85,6 +93,50 @@ def maybe_inject_fault(iteration: int) -> None:
     sys.stdout.flush()
     sys.stderr.flush()
     # a preempted TPU worker gets no goodbye: skip atexit, GC, flushes
+    os._exit(spec["exit_code"])
+
+
+def cycle_fault_spec() -> Optional[dict]:
+    """Parse the continuous-cycle fault env; None when none scheduled."""
+    raw = os.environ.get(FAULT_CYCLE_ENV)
+    if raw is None or raw == "":
+        return None
+    return {
+        "cycle": int(raw),
+        "rank": int(os.environ.get(FAULT_RANK_ENV, "0") or 0),
+        "mode": os.environ.get(FAULT_MODE_ENV, "exit") or "exit",
+        "exit_code": int(os.environ.get(FAULT_EXIT_CODE_ENV,
+                                        str(DEFAULT_FAULT_EXIT_CODE))),
+    }
+
+
+def maybe_inject_cycle_fault(cycle: int, rank: Optional[int] = None) -> None:
+    """Die (or raise) if a fault is scheduled for this rank+cycle.
+
+    The sharded continuous service calls this after POLLING a cycle's
+    segments but before the cycle's two-phase commit, so the injected
+    death always lands in the window where segments were consumed from
+    the source but their ingest position is not yet journaled — exactly
+    the window the relaunch replay must make exactly-once.  ``rank``
+    defaults to the mesh rank; the sharded service passes its fleet rank
+    explicitly (in-process test fleets carry ranks the mesh knows
+    nothing about)."""
+    spec = cycle_fault_spec()
+    if spec is None or cycle != spec["cycle"]:
+        return
+    if rank is None:
+        from ..parallel.mesh import comm_rank
+        rank = comm_rank()
+    if rank != spec["rank"]:
+        return
+    if spec["mode"] == "raise":
+        raise InjectedWorkerFault(
+            f"injected fault at continuous cycle {cycle} "
+            f"(rank {spec['rank']})")
+    sys.stderr.write(f"LGBM_TPU_FAULT: killing rank {spec['rank']} at "
+                     f"continuous cycle {cycle}\n")
+    sys.stdout.flush()
+    sys.stderr.flush()
     os._exit(spec["exit_code"])
 
 

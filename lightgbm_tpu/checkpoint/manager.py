@@ -36,7 +36,7 @@ from ..timer import timed
 from .state import CheckpointCorruptError, TrainState
 
 __all__ = ["CheckpointManager", "restore_barrier", "atomic_write_text",
-           "CHECKPOINT_SUFFIX"]
+           "atomic_write_bytes", "CHECKPOINT_SUFFIX"]
 
 CHECKPOINT_SUFFIX = ".lgbckpt"
 _NAME_RE = re.compile(r"^(?P<prefix>.+)_(?P<iter>\d{8})" +
@@ -81,8 +81,13 @@ def atomic_write_text(path: str, text: str) -> None:
     _atomic_write(path, text, binary=False)
 
 
-def _atomic_write_bytes(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Binary sibling of ``atomic_write_text`` — also the sharded
+    continuous fleet's commit-record/artifact write primitive."""
     _atomic_write(path, data, binary=True)
+
+
+_atomic_write_bytes = atomic_write_bytes     # internal callers
 
 
 def restore_barrier(iteration: int, timeout_s: float = 600.0) -> None:

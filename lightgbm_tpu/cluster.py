@@ -33,7 +33,8 @@ from typing import Callable, Dict, Optional, Sequence
 
 from .log import log_info, log_warning
 
-__all__ = ["train_distributed", "find_open_ports"]
+__all__ = ["train_distributed", "continuous_distributed",
+           "find_open_ports"]
 
 
 def find_open_ports(n: int, host: str = "127.0.0.1") -> list:
@@ -86,6 +87,77 @@ def _tail(path: str, n: int = 4000) -> str:
             return fh.read()[-n:]
     except OSError:
         return "<no worker log>"
+
+
+def _kill_all(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait()
+
+
+def _supervise(launch, max_restarts: int, backoff_s: float,
+               timeout: int, script: str) -> None:
+    """Synchronous-SPMD supervision shared by the training launcher and
+    the sharded continuous fleet: poll worker processes; on any abnormal
+    exit (or a hung attempt past ``timeout``) kill the survivors and
+    relaunch the WHOLE job — workers recover from their own persistent
+    state (checkpoints / ingest journals) — with bounded exponential
+    backoff up to ``max_restarts``.  ``launch(attempt) -> (procs,
+    logs)``; fault env stripping per attempt is the launcher's job."""
+    attempt = 0
+    while True:
+        procs, logs = launch(attempt)
+        deadline = time.time() + timeout
+        failed_rank = None
+        hung = False
+        while True:
+            rcs = [p.poll() for p in procs]
+            bad = [r for r, rc in enumerate(rcs) if rc not in (None, 0)]
+            if bad:
+                failed_rank = bad[0]
+                break
+            if all(rc == 0 for rc in rcs):
+                break
+            if time.time() > deadline:
+                # a preempted worker often HANGS (survivors block in
+                # collectives) rather than exiting: a timed-out attempt
+                # is a failure like any other and consumes a restart
+                hung = True
+                failed_rank = next((r for r, rc in enumerate(rcs)
+                                    if rc is None), 0)
+                break
+            time.sleep(0.2)
+        if failed_rank is None:
+            return                   # every worker exited cleanly
+        # synchronous SPMD: one death stalls everyone — kill the
+        # survivors, then decide whether the restart budget allows a
+        # relaunch from persistent state
+        rc = procs[failed_rank].returncode
+        _kill_all(procs)
+        why = (f"hung past the {timeout}s attempt deadline" if hung
+               else f"died (rc={rc})")
+        if attempt >= max_restarts:
+            if hung:
+                raise subprocess.TimeoutExpired(
+                    cmd=f"{sys.executable} {script}", timeout=timeout)
+            log_list = "\n".join(f"  rank {r}: {p}"
+                                 for r, p in enumerate(logs))
+            raise RuntimeError(
+                f"worker {failed_rank} failed (rc={rc}) and the restart "
+                f"budget is exhausted ({attempt}/{max_restarts} restarts "
+                f"used); worker logs:\n{log_list}\n"
+                f"--- tail of rank {failed_rank} ---\n"
+                f"{_tail(logs[failed_rank])}")
+        delay = backoff_s * (2.0 ** attempt)
+        log_warning(
+            f"worker {failed_rank} {why}; killed survivors, "
+            f"relaunching from persistent state in {delay:.1f}s "
+            f"(restart {attempt + 1}/{max_restarts})")
+        if delay > 0:
+            time.sleep(delay)
+        attempt += 1
 
 
 def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
@@ -204,65 +276,7 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
             log_fh.close()       # the child keeps its own handle
         return procs, logs
 
-    def _kill_all(procs) -> None:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.wait()
-
-    attempt = 0
-    while True:
-        procs, logs = _launch(attempt)
-        deadline = time.time() + timeout
-        failed_rank = None
-        hung = False
-        while True:
-            rcs = [p.poll() for p in procs]
-            bad = [r for r, rc in enumerate(rcs) if rc not in (None, 0)]
-            if bad:
-                failed_rank = bad[0]
-                break
-            if all(rc == 0 for rc in rcs):
-                break
-            if time.time() > deadline:
-                # a preempted worker often HANGS (survivors block in
-                # collectives) rather than exiting: a timed-out attempt
-                # is a failure like any other and consumes a restart
-                hung = True
-                failed_rank = next((r for r, rc in enumerate(rcs)
-                                    if rc is None), 0)
-                break
-            time.sleep(0.2)
-        if failed_rank is None:
-            break                # every worker exited cleanly
-        # synchronous SPMD: one death stalls everyone — kill the
-        # survivors, then decide whether the restart budget allows a
-        # relaunch from the latest checkpoint
-        rc = procs[failed_rank].returncode
-        _kill_all(procs)
-        why = (f"hung past the {timeout}s attempt deadline" if hung
-               else f"died (rc={rc})")
-        if attempt >= max_restarts:
-            if hung:
-                raise subprocess.TimeoutExpired(
-                    cmd=f"{sys.executable} {script}", timeout=timeout)
-            log_list = "\n".join(f"  rank {r}: {p}"
-                                 for r, p in enumerate(logs))
-            raise RuntimeError(
-                f"worker {failed_rank} failed (rc={rc}) and the restart "
-                f"budget is exhausted ({attempt}/{max_restarts} restarts "
-                f"used); worker logs:\n{log_list}\n"
-                f"--- tail of rank {failed_rank} ---\n"
-                f"{_tail(logs[failed_rank])}")
-        delay = backoff_s * (2.0 ** attempt)
-        log_warning(
-            f"worker {failed_rank} {why}; killed survivors, "
-            f"relaunching from the latest checkpoint in {delay:.1f}s "
-            f"(restart {attempt + 1}/{max_restarts})")
-        if delay > 0:
-            time.sleep(delay)
-        attempt += 1
+    _supervise(_launch, max_restarts, backoff_s, timeout, script)
 
     tdir = params.get("telemetry_dir")
     if tdir and os.path.isdir(tdir):
@@ -281,3 +295,103 @@ def train_distributed(params: Dict, data_fn: Callable, num_boost_round: int,
 
     from .basic import Booster
     return Booster(model_file=model_out)
+
+
+def continuous_distributed(params: Dict, num_workers: int = 2,
+                           hosts: Optional[Sequence[str]] = None,
+                           platform: Optional[str] = None,
+                           timeout: int = 3600,
+                           log_dir: Optional[str] = None):
+    """Launch + supervise a SHARDED continuous fleet on localhost: one
+    ``task=continuous`` CLI worker per rank (``continuous_shards`` set
+    for them), joined through jax.distributed, each tailing its shard of
+    ``continuous_source`` into ``continuous_dir`` (REQUIRED — it holds
+    the fleet's shared mapper artifacts, ingest journals, and commit
+    record, so it must be storage every worker sees).
+
+    Supervision is the same synchronous-SPMD contract as
+    ``train_distributed``: any worker death (``LGBM_TPU_FAULT_CYCLE``
+    makes one schedulable) kills the survivors and relaunches the whole
+    fleet with fresh ports and fault env stripped; relaunched workers
+    recover from their ingest journals + the commit record and replay
+    the in-flight cycle to a bit-identical model.
+
+    Workers exit cleanly via ``continuous_max_cycles`` /
+    ``continuous_max_idle_polls``.  Returns the committed model as a
+    Booster (None when no cycle ever committed a model)."""
+    if hosts is None:
+        hosts = ["127.0.0.1"] * num_workers
+    params = dict(params)
+    workdir = params.get("continuous_dir")
+    if not workdir:
+        raise ValueError("continuous_distributed requires continuous_dir="
+                         "shared storage (fleet journals + commit record)")
+    if not params.get("continuous_source"):
+        raise ValueError("continuous_distributed requires "
+                         "continuous_source=DIR")
+    max_restarts = int(params.get("max_restarts", 2) or 0)
+    backoff_s = float(params.get("restart_backoff_s", 1.0) or 0.0)
+    params["task"] = "continuous"
+    params["continuous_shards"] = num_workers
+    params.pop("max_restarts", None)
+    params.pop("restart_backoff_s", None)
+    tmp = log_dir or tempfile.mkdtemp(prefix="lgbm_tpu_fleet_cont_")
+    os.makedirs(tmp, exist_ok=True)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _launch(attempt: int):
+        ports = find_open_ports(num_workers)
+        machines = ",".join(f"{h}:{p}" for h, p in zip(hosts, ports))
+        log_info(f"launching {num_workers} continuous workers "
+                 f"(attempt {attempt}): {machines}")
+        procs, logs = [], []
+        for rank in range(num_workers):
+            argv = dict(params)
+            argv["num_machines"] = num_workers
+            argv["machines"] = machines
+            argv["local_listen_port"] = ports[rank]
+            # every rank serves its own registry copy: one port each
+            # (0 = train/gate only, the localhost-fleet default — a
+            # front door would sit behind fleet/router.py anyway)
+            base_port = int(params.get("serving_port", 0) or 0)
+            argv["serving_port"] = (base_port + rank) if base_port else 0
+            cmd = [sys.executable, "-m", "lightgbm_tpu"] + [
+                f"{k}={v}" for k, v in argv.items()]
+            env = dict(os.environ)
+            env["LIGHTGBM_TPU_RANK"] = str(rank)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            if platform:
+                env["LIGHTGBM_TPU_PLATFORM"] = platform
+                env["JAX_PLATFORMS"] = platform
+            if attempt > 0:
+                # transient-fault model: an injected fault does not
+                # recur on the relaunch (checkpoint/fault.py)
+                from .checkpoint.fault import FAULT_ENV_VARS
+                for var in FAULT_ENV_VARS:
+                    env.pop(var, None)
+            log_path = os.path.join(tmp, f"worker_{rank}_a{attempt}.log")
+            logs.append(log_path)
+            log_info(f"continuous worker {rank} log: {log_path}")
+            log_fh = open(log_path, "w")
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=log_fh,
+                stderr=subprocess.STDOUT, text=True))
+            log_fh.close()       # the child keeps its own handle
+        return procs, logs
+
+    _supervise(_launch, max_restarts, backoff_s, timeout,
+               "python -m lightgbm_tpu task=continuous")
+    # the fleet's single source of truth for "what is committed"
+    import json as _json
+
+    from .io import file_io
+    try:
+        state = _json.loads(file_io.read_text(
+            f"{workdir}/fleet/commit_state.json"))
+    except OSError:
+        return None
+    if not state.get("model_file"):
+        return None
+    from .basic import Booster
+    return Booster(model_str=file_io.read_text(state["model_file"]))

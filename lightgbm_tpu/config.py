@@ -322,6 +322,33 @@ _PARAMS: List[ParamSpec] = [
     _p("continuous_rebin_every_k", int, 10, (), ">0",
        "every_k policy period: pay a full re-bin every k training "
        "cycles"),
+    _p("continuous_shards", int, 0, (), ">=0",
+       "sharded fleet ingest: run this worker as one of N ranks, each "
+       "tailing its own shard of continuous_source (a <source>/<rank>/ "
+       "subdirectory when present, else a deterministic crc32 hash "
+       "split of the shared directory) into a rank-local store under "
+       "fleet-shared fingerprinted mappers; drift/re-bin decisions are "
+       "fleet consensus and cycle commit is two-phase (journaled ingest "
+       "position + rank-0 commit record) so a killed worker replays to "
+       "a bit-identical model.  0/1 = single-process pipeline.  Rank "
+       "comes from LIGHTGBM_TPU_RANK / the machines list "
+       "(cluster.continuous_distributed launches localhost fleets)"),
+    _p("continuous_quarantine_max_bytes", int, 64 * 1024 * 1024, (),
+       ">=0",
+       "size bound for the quarantine JSONL: an append that would "
+       "overflow it rotates the file to a single .1 sibling (previous "
+       ".1 dropped, lgbm_continuous_quarantine_rotated_total bumps) so "
+       "a poisoned upstream cannot fill a long-running worker's disk.  "
+       "0 = unbounded"),
+    _p("continuous_segment_retry_max", int, 6, (), ">=0",
+       "unreadable-segment retry budget: each failed read backs off "
+       "exponentially (continuous_segment_retry_backoff_s * 2^attempt, "
+       "counted in lgbm_continuous_segment_retry_total); past the "
+       "budget the whole segment is quarantined with reason "
+       "'unreadable' and never retried"),
+    _p("continuous_segment_retry_backoff_s", float, 0.5, (), ">=0",
+       "base backoff before re-reading an unreadable segment (doubles "
+       "per attempt, capped at 60s)"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),

@@ -16,18 +16,27 @@ coverage in tests).
 - :class:`PublishGate` — AUC floor + regression bound + rollback alarm
 - :class:`ContinuousService` — the supervised composition (CLI
   ``task=continuous``)
+- :class:`ShardedContinuousTrainer` / :class:`ShardedContinuousService`
+  — the fleet topology (rank-local tails + stores, fingerprinted mapper
+  consensus, two-phase ingest commit; ``continuous_shards > 1``)
 """
 
-from .drift import DriftSketch
+from .drift import DriftSketch, reduce_sketch
 from .gate import PublishGate
 from .service import ContinuousService
-from .tail import DataTail, SegmentBatch
+from .sharded import (FleetComm, ShardedContinuousService,
+                      ShardedContinuousTrainer, load_mapper_artifact,
+                      save_mapper_artifact)
+from .tail import DataTail, SegmentBatch, shard_of
 from .trainer import (ContinuousTrainer, checkpoint_prefix_matches,
                       combine_model_strings, holdout_auc)
 
 __all__ = [
-    "DataTail", "SegmentBatch", "DriftSketch",
+    "DataTail", "SegmentBatch", "shard_of",
+    "DriftSketch", "reduce_sketch",
     "ContinuousTrainer", "combine_model_strings", "holdout_auc",
     "checkpoint_prefix_matches",
     "PublishGate", "ContinuousService",
+    "FleetComm", "ShardedContinuousTrainer", "ShardedContinuousService",
+    "save_mapper_artifact", "load_mapper_artifact",
 ]

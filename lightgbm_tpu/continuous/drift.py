@@ -33,7 +33,7 @@ import numpy as np
 
 from ..binning import bin_occupancy
 
-__all__ = ["DriftSketch"]
+__all__ = ["DriftSketch", "reduce_sketch"]
 
 
 class DriftSketch:
@@ -101,6 +101,32 @@ class DriftSketch:
         s = self.scores()
         return float(s.max()) if len(s) else 0.0
 
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Serializable sufficient statistics (np.savez-able) for
+        persistence/debug tooling.  Fleet recovery does NOT read these:
+        it reconstructs the sketch deterministically from the replayed
+        pool + journal instead (``ShardedContinuousTrainer.
+        restore_store`` — reference = the first k train rows, recent =
+        the rest), which cannot go stale in a crash window."""
+        return {"nb": np.asarray(self.nb, np.int64),
+                "ref": np.asarray(self.ref, np.int64),
+                "recent": np.asarray(self.recent, np.int64),
+                "rows": np.asarray([self.ref_rows, self.recent_rows],
+                                   np.int64)}
+
+    def load_state(self, state: Dict[str, np.ndarray]) -> None:
+        nb = np.asarray(state["nb"], np.int64)
+        if not np.array_equal(nb, self.nb):
+            raise ValueError(
+                "drift sketch state was recorded for different per-"
+                "feature bin counts — it belongs to other mappers")
+        self.ref = np.asarray(state["ref"], np.int64).copy()
+        self.recent = np.asarray(state["recent"], np.int64).copy()
+        rows = np.asarray(state["rows"], np.int64)
+        self.ref_rows = int(rows[0])
+        self.recent_rows = int(rows[1])
+
     def summary(self, top: int = 3) -> Dict:
         """Compact event payload: max PSI + the worst features."""
         s = self.scores()
@@ -112,3 +138,31 @@ class DriftSketch:
             "top_features": [{"feature": int(f), "psi": round(float(s[f]), 5)}
                              for f in order if len(s)],
         }
+
+
+def reduce_sketch(sketch: DriftSketch, allreduce=None) -> DriftSketch:
+    """Fleet-global sketch: element-wise sum of every rank's occupancy
+    counts and row totals — bin counts are linear, so the reduced sketch
+    IS the single-process sketch over the concatenated rows, and every
+    rank scoring it reaches the SAME re-bin decision (consensus, never a
+    per-rank disagreement).
+
+    ``allreduce`` defaults to ``parallel.mesh.allreduce_sum`` (a device
+    ``psum`` through ``compat_shard_map`` on a multi-process mesh,
+    host-allgather sum under injected collectives, identity single-
+    process); tests inject a thread-backed reduction to simulate a fleet
+    in one process."""
+    if allreduce is None:
+        from ..parallel.mesh import allreduce_sum as allreduce
+    F, B = sketch.ref.shape
+    payload = np.concatenate(
+        [sketch.ref.reshape(-1), sketch.recent.reshape(-1),
+         np.asarray([sketch.ref_rows, sketch.recent_rows], np.int64)]
+    ).astype(np.int64)
+    total = np.asarray(allreduce(payload), np.int64)
+    out = DriftSketch(sketch.nb)
+    out.ref = total[:F * B].reshape(F, B)
+    out.recent = total[F * B:2 * F * B].reshape(F, B)
+    out.ref_rows = int(total[-2])
+    out.recent_rows = int(total[-1])
+    return out

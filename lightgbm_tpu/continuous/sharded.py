@@ -1,0 +1,917 @@
+"""Sharded continuous ingest: rank-local tails, drift consensus, and
+chaos-hardened cycle coordination.
+
+The single-process continuous pipeline (tail → extend → train → gate)
+scales to a fleet by making INGEST rank-local and COORDINATION explicit:
+
+- **rank-local tails** — each worker's ``DataTail`` consumes only its
+  shard of the segment stream (``<source>/<rank>/`` subdirectories, or a
+  deterministic crc32 hash split of a shared directory — tail.py
+  ``shard_of``), bins fresh rows against the FLEET-SHARED frozen mappers
+  into its rank-local store, and quarantines bad rows locally.  Per-rank
+  memory is O(shard), exactly the property the reference's distributed
+  loading establishes for one-shot training.
+- **drift consensus** — per-feature ``DriftSketch`` occupancy is linear,
+  so the fleet-global sketch is an element-wise sum: ``reduce_sketch``
+  allreduces every rank's counts (a ``psum`` through
+  ``mesh.compat_shard_map`` on a multi-process mesh) and the PSI re-bin
+  decision is computed from the REDUCED sketch on every rank — a
+  fleet-wide consensus, never a per-rank disagreement (cf. the voting
+  reduction in arxiv 1706.08359's distributed histogram design).
+- **fingerprinted mapper refresh** — cycle 0 and every triggered re-bin
+  are a fleet-wide mapper construction: ranks allgather a row sample,
+  rank 0 runs GreedyFindBin and publishes a sha256-fingerprinted mapper
+  artifact through the io scheme registry, everyone rendezvouses at the
+  restore barrier, loads the artifact, verifies the digest, and
+  allgathers digests for consensus.  Any mismatch aborts the cycle with
+  a ``LightGBMError`` — the registry keeps serving the last accepted
+  model, which is the failure contract everything in this subsystem
+  degrades to.
+- **two-phase cycle commit** — a cycle's segments are journaled as
+  *prepared* when polled and only become the committed ingest position
+  once rank 0 writes the cycle's commit record (after the gate
+  decision).  A worker killed mid-cycle (``LGBM_TPU_FAULT_CYCLE``)
+  relaunches, replays committed segments into its pool (validated
+  through the tail again — deterministic), re-reads the in-flight
+  cycle's prepared segments, and resumes that cycle from its
+  checkpoints: no segment is consumed twice or skipped, and the finished
+  model is bit-identical to an uninterrupted run.
+
+Training over the union of shards is the existing rank-local
+data-parallel path: each cycle wraps the rank's store in a rank-local
+training VIEW (global allgathered labels/init scores, local bin shard)
+that ``DataParallelTreeLearner`` shards, with per-rank blocks padded to
+the serving power-of-two ladder under ``train_row_buckets`` so stable
+buckets mean zero steady-state compiles per rank.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io import file_io
+from ..log import LightGBMError, log_info, log_warning
+from .service import ContinuousService
+from .trainer import ContinuousTrainer
+
+__all__ = ["FleetComm", "ShardedContinuousTrainer",
+           "ShardedContinuousService", "save_mapper_artifact",
+           "load_mapper_artifact", "mapper_artifact_path"]
+
+
+def _alloc_bucket(n: int) -> int:
+    """Power-of-two padding bucket for variable-length host allgathers:
+    cross-rank exchanges reuse a handful of shapes instead of minting a
+    new collective program per cycle (the zero-steady-state-compile bar
+    applies to coordination traffic too)."""
+    from ..ops.predict import row_bucket
+    return int(row_bucket(max(int(n), 1)))
+
+
+class FleetComm:
+    """Cross-rank exchange seam for the sharded continuous pipeline.
+
+    Three transports, chosen by what the environment can actually do:
+
+    - **device** — ``mesh.host_allgather`` / ``mesh.allreduce_sum`` (a
+      psum through ``compat_shard_map`` on a multi-process mesh) when
+      the jax backend supports cross-process collectives (TPU/GPU pods);
+    - **filesystem** — on backends that cannot (multi-process CPU: jax
+      raises "Multiprocess computations aren't implemented on the CPU
+      backend"), payloads ride the shared ``exchange_dir`` through the
+      io scheme registry, sequenced by the jax.distributed
+      coordination-service barrier (which IS available on every
+      backend).  Collective calls are made in lockstep on every rank, so
+      a monotonic per-comm counter names each exchange uniquely;
+    - **injected** — tests pass thread-backed ``allgather_fn`` /
+      ``barrier_fn`` to drive an N-rank fleet inside one process, the
+      same injected-collective pattern the loading-phase exchanges use.
+    """
+
+    def __init__(self, rank: int = 0, size: int = 1,
+                 allgather_fn=None, barrier_fn=None,
+                 exchange_dir: Optional[str] = None):
+        self.rank = int(rank)
+        self.size = max(int(size), 1)
+        if not 0 <= self.rank < self.size:
+            raise ValueError(f"rank {rank} not in [0, {self.size})")
+        self._allgather_fn = allgather_fn
+        self._barrier_fn = barrier_fn
+        self.exchange_dir = exchange_dir
+        self._xchg = 0
+
+    # -- transport choice ----------------------------------------------
+    def _fs_mode(self) -> bool:
+        """True when cross-process device collectives are unavailable
+        (multi-process CPU) and the shared filesystem must carry the
+        exchange instead."""
+        if self.size <= 1 or self._allgather_fn is not None:
+            return False
+        import jax
+        return jax.process_count() > 1 and jax.default_backend() == "cpu"
+
+    def device_collectives_ok(self) -> bool:
+        """Whether TRAINING can run the rank-local data-parallel path
+        (needs real cross-process device collectives).  When false the
+        trainer falls back to replicated union training."""
+        if self.size <= 1:
+            return True
+        if self._allgather_fn is not None:
+            return False               # in-process fleet: no real mesh
+        import jax
+        return jax.default_backend() != "cpu"
+
+    # -- primitives ----------------------------------------------------
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        """Equal-shaped per-rank array -> [size, ...] stacked."""
+        arr = np.ascontiguousarray(arr)
+        if self.size <= 1:
+            return arr[None]
+        if self._allgather_fn is not None:
+            return np.asarray(self._allgather_fn(arr))
+        if self._fs_mode():
+            return self._fs_allgather(arr)
+        from ..parallel.mesh import host_allgather
+        return host_allgather(arr)
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """Element-wise int64 sum across ranks (drift-sketch consensus
+        and fleet train decisions): device psum on a real multi-process
+        mesh, allgather-sum otherwise."""
+        arr = np.ascontiguousarray(np.asarray(arr, np.int64))
+        if self.size <= 1:
+            return arr.copy()
+        if self._allgather_fn is not None:
+            return np.asarray(self._allgather_fn(arr)).sum(axis=0)
+        if self._fs_mode():
+            return self._fs_allgather(arr).sum(axis=0)
+        from ..parallel.mesh import allreduce_sum
+        return allreduce_sum(arr)
+
+    def barrier(self, tag: str, timeout_s: float = 600.0) -> None:
+        """Named fleet rendezvous (mapper publish, cycle commit)."""
+        if self.size <= 1:
+            return
+        if self._barrier_fn is not None:
+            self._barrier_fn(tag)
+            return
+        try:
+            from jax._src import distributed as _jd
+            client = getattr(_jd.global_state, "client", None)
+        except ImportError:          # pragma: no cover - jax internal move
+            client = None
+        if client is not None:
+            client.wait_at_barrier(f"lgbm_tpu_fleet_{tag}",
+                                   timeout_in_ms=int(timeout_s * 1000))
+            return
+        # injected external collectives (no coordination service): a
+        # tag-keyed allgather doubles as the rendezvous
+        import zlib
+        from ..checkpoint.manager import restore_barrier
+        restore_barrier(zlib.crc32(f"fleet:{tag}".encode()),
+                        timeout_s=timeout_s)
+
+    def _fs_allgather(self, arr: np.ndarray) -> np.ndarray:
+        """Filesystem allgather: write own payload (tmp+rename), barrier,
+        read everyone's, barrier, clean own file.  The exchange counter
+        advances identically on every rank (lockstep collectives), so
+        file names never collide across calls; a relaunch overwrites any
+        stale files a killed run left at the same counter BEFORE the
+        read barrier admits a reader."""
+        if not self.exchange_dir:
+            raise LightGBMError(
+                "FleetComm needs exchange_dir on backends without cross-"
+                "process device collectives (multi-process CPU)")
+        self._xchg += 1
+        file_io.makedirs(self.exchange_dir)
+        mine = f"{self.exchange_dir}/x{self._xchg:06d}_r{self.rank}.npz"
+        buf = io.BytesIO()
+        np.savez(buf, a=arr)
+        _write_bytes_atomic(mine, buf.getvalue())
+        self.barrier(f"x{self._xchg}w")
+        blocks = []
+        for r in range(self.size):
+            path = f"{self.exchange_dir}/x{self._xchg:06d}_r{r}.npz"
+            with np.load(io.BytesIO(file_io.read_bytes(path))) as z:
+                blocks.append(np.asarray(z["a"]))
+        self.barrier(f"x{self._xchg}r")
+        try:
+            file_io.remove(mine)
+        except OSError:
+            pass
+        return np.stack(blocks)
+
+    # -- composites ----------------------------------------------------
+    def allgather_blocks(self, arr: np.ndarray):
+        """Variable-length per-rank blocks -> (concatenated-in-rank-order
+        array, [size] block sizes).  Blocks are padded to a power-of-two
+        bucket so the underlying collective reuses stable shapes."""
+        arr = np.ascontiguousarray(arr)
+        n = arr.shape[0]
+        sizes = self.allgather(np.asarray([n], np.int64)).reshape(-1)
+        if self.size <= 1:
+            return arr, sizes
+        m = _alloc_bucket(int(sizes.max()))
+        padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
+        padded[:n] = arr
+        stacked = self.allgather(padded)
+        return (np.concatenate([stacked[r, :sizes[r]]
+                                for r in range(self.size)]), sizes)
+
+
+# ----------------------------------------------------------------------
+# Fingerprinted mapper artifact (fleet-wide frozen-mapper broadcast)
+# ----------------------------------------------------------------------
+def mapper_artifact_path(fleet_dir: str, version: int) -> str:
+    return f"{fleet_dir}/mapper_v{int(version):05d}.pkl"
+
+
+def _write_bytes_atomic(path: str, data: bytes) -> None:
+    # the checkpoint manager's primitive: tmp+rename retried as ONE unit
+    # on transient backend errors, tmp cleaned up on failure — the files
+    # bit-identical recovery rides (commit record, mapper artifact, raw
+    # cache) get the same durability story as checkpoints themselves
+    from ..checkpoint.manager import atomic_write_bytes
+    atomic_write_bytes(path, data)
+
+
+def save_mapper_artifact(fleet_dir: str, version: int, mappers,
+                         meta: Dict) -> str:
+    """Persist the fleet's frozen bin mappers as a fingerprinted
+    artifact (rank 0 only): pickled payload + a ``.sha256`` sidecar, both
+    committed tmp+rename through the io scheme registry.  Returns the
+    payload digest every rank must agree on before swapping mappers."""
+    file_io.makedirs(fleet_dir)
+    payload = pickle.dumps({"version": int(version), "mappers": mappers,
+                            "meta": dict(meta)},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(payload).hexdigest()
+    path = mapper_artifact_path(fleet_dir, version)
+    _write_bytes_atomic(path, payload)
+    _write_bytes_atomic(
+        f"{path}.sha256",
+        json.dumps({"sha256": digest, "version": int(version)}).encode())
+    return digest
+
+
+def load_mapper_artifact(fleet_dir: str, version: int):
+    """Load + VERIFY a mapper artifact: the payload's sha256 must match
+    the published fingerprint BEFORE unpickling (a flipped bit must
+    never reach pickle.loads — same contract as checkpoint checksums).
+    Returns (payload dict, digest)."""
+    path = mapper_artifact_path(fleet_dir, version)
+    data = file_io.read_bytes(path)
+    want = json.loads(file_io.read_text(f"{path}.sha256"))["sha256"]
+    digest = hashlib.sha256(data).hexdigest()
+    if digest != want:
+        raise LightGBMError(
+            f"mapper artifact {path} failed sha256 verification "
+            f"(expected {want[:12]}…, got {digest[:12]}…) — the fleet "
+            "mapper refresh is aborted; keep serving the last accepted "
+            "model")
+    obj = pickle.loads(data)
+    if int(obj.get("version", -1)) != int(version):
+        raise LightGBMError(
+            f"mapper artifact {path} carries version {obj.get('version')}"
+            f" but version {version} was requested")
+    return obj, digest
+
+
+# ----------------------------------------------------------------------
+class ShardedContinuousTrainer(ContinuousTrainer):
+    """Rank-local continuation trainer: local shard store under
+    fleet-shared frozen mappers, trained through the rank-local
+    data-parallel view each cycle.
+
+    Differences from the base trainer, all consensus-preserving:
+
+    - store mappers come from the fingerprinted fleet artifact (rank 0
+      constructs from the allgathered row sample, everyone verifies);
+    - EFB is disabled (bundling decisions from local conflict counts
+      would diverge across ranks — the same reason rank-sharded loading
+      disables it);
+    - the re-bin policy scores the fleet-REDUCED drift sketch;
+    - cycle AUC is computed over the allgathered (raw, label) holdout
+      pairs, so gate decisions cannot diverge.
+    """
+
+    def __init__(self, params: Dict, workdir: str, comm: FleetComm,
+                 fleet_dir: Optional[str] = None, **kwargs):
+        kwargs.setdefault("incremental", True)
+        super().__init__(params, workdir, **kwargs)
+        if not self.incremental:
+            raise LightGBMError(
+                "the sharded continuous trainer requires the incremental "
+                "pipeline (boosting=dart/rf fall back to per-cycle "
+                "rebuilds, which have no rank-local story)")
+        self.comm = comm
+        # EFB bundling decisions must agree across ranks; like
+        # rank-sharded loading, disable it fleet-wide
+        self.params["enable_bundle"] = False
+        if self.comm.size > 1:
+            # the rank-local training view is consumed by the parallel
+            # learners; a leaked serial selection would need the global
+            # matrix nobody holds
+            self.params.setdefault("tree_learner", "data")
+            self.params["num_machines"] = self.comm.size
+        if self.comm.size > 1 and comm._allgather_fn is None:
+            # real fleet: the first collective fires in the mapper sync,
+            # long before any training builds a mesh — join the
+            # jax.distributed cluster up front
+            from ..config import Config
+            from ..parallel.mesh import maybe_init_distributed
+            maybe_init_distributed(Config(self.params))
+        # the fleet dir (mapper artifacts, commit record, journals) must
+        # be SHARED storage; per-rank cycle checkpoints live under
+        # workdir, which in-process test fleets keep rank-private (one
+        # process means one pid for every rank's tmp names)
+        self.fleet_dir = fleet_dir or f"{self.workdir}/fleet"
+        self.artifact_version = 0
+        self.artifact_digest: Optional[str] = None
+        self._view_row_offset = 0
+
+    # -- fleet mapper construction -------------------------------------
+    def _fleet_mappers(self, X: np.ndarray):
+        """One fleet-wide mapper construction: sample → allgather →
+        rank 0 constructs + publishes the fingerprinted artifact →
+        barrier → all ranks load, verify, and agree on the digest."""
+        from ..binning import find_bin_mappers
+        from ..config import Config
+        cfg = Config(self.params)
+        n = X.shape[0]
+        rng = np.random.RandomState(cfg.data_random_seed + self.comm.rank)
+        take = min(n, max(1, int(cfg.bin_construct_sample_cnt)
+                          // self.comm.size))
+        pick = np.sort(rng.choice(n, size=take, replace=False))
+        sample, _ = self.comm.allgather_blocks(
+            np.ascontiguousarray(X[pick], np.float64))
+        version = self.artifact_version + 1
+        if self.comm.rank == 0:
+            min_split = (cfg.min_data_in_leaf
+                         if cfg.feature_pre_filter else 0)
+            mappers = find_bin_mappers(
+                sample, max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                categorical_features=[], use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                min_split_data=min_split,
+                max_bin_by_feature=cfg.max_bin_by_feature,
+                feature_pre_filter=cfg.feature_pre_filter,
+                forced_bins_path=cfg.forcedbins_filename)
+            save_mapper_artifact(
+                self.fleet_dir, version, mappers,
+                {"sample_rows": int(sample.shape[0]),
+                 "num_features": int(sample.shape[1]),
+                 "built_cycle": int(self.cycle)})
+        self.comm.barrier(f"mapper_publish_{version}")
+        obj, digest = load_mapper_artifact(self.fleet_dir, version)
+        # digest consensus: every rank must have read the SAME bytes —
+        # a rank that loaded a torn or stale artifact must abort the
+        # cycle, not train under silently different bins
+        mine = np.frombuffer(bytes.fromhex(digest), np.uint8)
+        everyone = self.comm.allgather(mine)
+        if not (everyone == everyone[0]).all():
+            raise LightGBMError(
+                "fleet mapper refresh aborted: ranks read different "
+                "artifact fingerprints "
+                f"({[bytes(e).hex()[:12] for e in everyone]}) — keep "
+                "serving the last accepted model")
+        self.artifact_version = version
+        self.artifact_digest = digest
+        log_info(f"continuous[shard {self.comm.rank}]: mapper artifact "
+                 f"v{version} verified ({digest[:12]}…)")
+        return obj["mappers"]
+
+    def _construct_store(self, X: np.ndarray, y: np.ndarray):
+        from ..config import Config
+        from ..dataset import Metadata, TrainDataset
+        mappers = self._fleet_mappers(X)
+        return TrainDataset(X, Metadata(y), Config(self.params),
+                            bin_mappers=mappers)
+
+    def restore_store(self, artifact_version: int,
+                      reference_train_rows: int) -> None:
+        """Relaunch recovery: rebuild the rank-local store from the
+        replayed pool under the CURRENT artifact's mappers (no new fleet
+        construction), and reconstruct the drift sketch exactly — the
+        first ``reference_train_rows`` store rows were the reference
+        population when the artifact was built, the rest are the recent
+        window.  Occupancy is linear, so this equals the uninterrupted
+        sketch state."""
+        from ..config import Config
+        from ..dataset import Metadata, TrainDataset
+        from .drift import DriftSketch
+        obj, digest = load_mapper_artifact(self.fleet_dir,
+                                           artifact_version)
+        self.artifact_version = int(artifact_version)
+        self.artifact_digest = digest
+        X, y = self._pool()
+        self._store = TrainDataset(X, Metadata(y), Config(self.params),
+                                   bin_mappers=obj["mappers"])
+        self._store_segments = len(self._train_X)
+        self._sketch = DriftSketch(
+            np.asarray(self._store.num_bins_per_feature))
+        k = int(reference_train_rows)
+        self._sketch.set_reference(self._store.bins[:k])
+        if k < self._store.num_data:
+            self._sketch.update(self._store.bins[k:])
+
+    # -- consensus seams ------------------------------------------------
+    def _decision_sketch(self):
+        from .drift import reduce_sketch
+        return reduce_sketch(self._sketch, allreduce=self.comm.allreduce)
+
+    def _engine_params(self) -> Dict:
+        if self.comm.size <= 1 or self.comm.device_collectives_ok():
+            return self.params
+        # replicated fallback: every rank trains the allgathered union
+        # serially — strip the distributed learner selection so the
+        # engine does not look for the mesh the backend cannot build,
+        # and let the union dataset bucket its row axis
+        out = dict(self.params)
+        out["num_machines"] = 1
+        out["tree_learner"] = "serial"
+        out.pop("machines", None)
+        return out
+
+    def _training_handle(self):
+        if self.comm.size <= 1:
+            return super()._training_handle()
+        import lightgbm_tpu as lgb
+        if self.comm.device_collectives_ok():
+            view = self._rank_local_view()
+            return lgb.Dataset._from_handle(view, self.params)
+        # Replicated union fallback: backends without cross-process
+        # device collectives (multi-process CPU — jax: "Multiprocess
+        # computations aren't implemented on the CPU backend") cannot
+        # run the rank-local data-parallel program, so each rank
+        # allgathers the BINNED shards (no re-binning — the shared
+        # frozen mappers make the union exact) and trains it serially.
+        # Per-rank memory is O(total) here; the rank-local path above is
+        # what runs on a pod.  Every coordination property (shared
+        # mappers, consensus decisions, two-phase commit, bit-identical
+        # recovery) is identical in both modes.
+        return lgb.Dataset._from_handle(self._union_training_store(),
+                                        self._engine_params())
+
+    def _union_training_store(self):
+        from ..config import Config
+        from ..dataset import Metadata, TrainDataset
+        store = self._store
+        bins_g, sizes = self.comm.allgather_blocks(np.asarray(store.bins))
+        y_local = np.asarray(store.metadata.label,
+                             np.float32).reshape(-1)[:store.num_data]
+        label_g, _ = self.comm.allgather_blocks(y_local)
+        init_g = self._allgather_init(store)
+        md = Metadata(label_g, None, init_score=init_g)
+        union = TrainDataset.__new__(TrainDataset)
+        union._init_from_binned(bins_g, store.all_bin_mappers,
+                                store.num_total_features, md,
+                                Config(self._engine_params()))
+        self._view_row_offset = int(sizes[:self.comm.rank].sum())
+        self._last_train_bucket = int(union.num_rows_device)
+        return union
+
+    def _train_row_bucket(self) -> int:
+        if self.comm.size <= 1:
+            return super()._train_row_bucket()
+        return int(getattr(self, "_last_train_bucket", 0))
+
+    def _allgather_init(self, store) -> Optional[np.ndarray]:
+        """Global init-score vector (or None), with an all-or-none
+        consensus check — commit/revert bookkeeping must agree fleet-
+        wide before scores are exchanged."""
+        init_local = store.metadata.init_score
+        has_init = self.comm.allgather(
+            np.asarray([init_local is not None], np.int64)).reshape(-1)
+        if not has_init.any():
+            return None
+        if not has_init.all():
+            raise LightGBMError(
+                "sharded continuation diverged: some ranks carry an "
+                "init score and some do not — commit/revert "
+                "bookkeeping is inconsistent across the fleet")
+        init_g, _ = self.comm.allgather_blocks(
+            np.asarray(init_local, np.float64).reshape(-1))
+        return init_g
+
+    def _rank_local_view(self):
+        """Wrap the rank-local store in the layout the data-parallel
+        learner consumes (``TrainDataset.from_rank_shard`` semantics):
+        global allgathered labels/init scores, the LOCAL bin shard, no
+        device matrix.  Rebuilt per cycle — labels grow with the pool."""
+        from ..dataset import Metadata, TrainDataset
+        store = self._store
+        y_local = np.asarray(store.metadata.label,
+                             np.float32).reshape(-1)[:store.num_data]
+        label_g, sizes = self.comm.allgather_blocks(y_local)
+        n_global = int(sizes.sum())
+        row_offset = int(sizes[:self.comm.rank].sum())
+        md = Metadata(label_g, None,
+                      init_score=self._allgather_init(store))
+        view = TrainDataset.__new__(TrainDataset)
+        view.config = store.config
+        view.metadata = md
+        view.all_bin_mappers = store.all_bin_mappers
+        view.raw_device = None
+        view.num_total_features = store.num_total_features
+        view._finish_init_rank_local(
+            store.bins, store.all_bin_mappers,
+            list(store.real_feature_index), store.num_total_features,
+            md, n_global, np.asarray(sizes, np.int64), row_offset)
+        self._view_row_offset = row_offset
+        # compiled-shape proxy: the data-parallel learner pads each
+        # rank's block to the serving ladder (train_row_buckets), so the
+        # programs re-key exactly when the max block crosses a bucket
+        self._last_train_bucket = (_alloc_bucket(int(sizes.max()))
+                                   * self.comm.size)
+        return view
+
+    def _harvest_candidate_raw(self, booster) -> np.ndarray:
+        raw = np.asarray(booster._gbdt.train_score[0], np.float32)
+        lo = self._view_row_offset if self.comm.size > 1 else 0
+        return raw[lo:lo + self._store.num_data].astype(np.float64)
+
+    def _cycle_auc(self, candidate_str: str) -> float:
+        if self.comm.size <= 1:
+            return super()._cycle_auc(candidate_str)
+        from ..basic import Booster
+        from ..metrics import AUCMetric
+        hx, hy = self.holdout()
+        if len(hy):
+            raw_local = np.asarray(
+                Booster(model_str=candidate_str).predict(
+                    hx, raw_score=True), np.float64).reshape(-1)
+        else:
+            raw_local = np.empty((0,), np.float64)
+        raw_g, _ = self.comm.allgather_blocks(raw_local)
+        y_g, _ = self.comm.allgather_blocks(
+            np.asarray(hy, np.float64).reshape(-1))
+        if len(y_g) == 0:
+            return float("nan")
+        return float(AUCMetric(None).eval(raw_g, y_g, None, None)[0][1])
+
+
+# ----------------------------------------------------------------------
+class ShardedContinuousService(ContinuousService):
+    """The fleet-coordinated poll → ingest → train → gate → commit loop.
+
+    Every rank runs one instance over its shard tail; collectives inside
+    ``step()`` keep the fleet in lockstep (the first reduction doubles
+    as the rendezvous).  Cycle commit is two-phase:
+
+    1. *prepare* — polled segment names are appended to this rank's
+       journal BEFORE training; until the commit record exists they are
+       in-flight and a relaunch replays them into the same cycle.
+    2. *commit* — after the (fleet-identical) gate decision, rank 0
+       atomically writes ``commit_state.json`` (cycle, decision,
+       committed-model file + sha256, artifact version, gate baseline)
+       and every rank persists its raw-score cache, then the fleet
+       rendezvouses and moves on.
+
+    ``recover()`` (run at construction when a commit record or journal
+    exists) replays committed segments through the tail (same
+    validation, same deterministic split), restores the committed model
+    and store/sketch under the current mapper artifact, marks the
+    journal's segments seen, and queues any in-flight prepared segments
+    so the interrupted cycle re-runs on exactly its original data —
+    resuming from its checkpoints, hence bit-identical."""
+
+    def __init__(self, tail, trainer: ShardedContinuousTrainer, gate,
+                 poll_s: float = 1.0,
+                 max_cycle_retries: int = 2,
+                 retry_backoff_s: float = 0.2,
+                 metrics_registry=None):
+        super().__init__(tail, trainer, gate, poll_s=poll_s,
+                         max_cycle_retries=max_cycle_retries,
+                         retry_backoff_s=retry_backoff_s,
+                         metrics_registry=metrics_registry)
+        self.comm: FleetComm = trainer.comm
+        if self.comm.size > 1:
+            # in-process cycle retries are a SINGLE-rank recovery tool:
+            # re-entering train_cycle on one rank re-issues collectives
+            # its peers never see and desynchronizes the lockstep
+            # exchange.  Multi-rank fleets fail fast instead and let
+            # cluster._supervise relaunch the whole fleet — the journal
+            # replay is built for exactly that
+            self.max_cycle_retries = 0
+            # every rank must agree on the shard layout: half the fleet
+            # reading <source>/<rank>/ subdirs while the other half
+            # hash-splits the top directory would orphan segments with
+            # no error (the layout is probed once at tail construction —
+            # create ALL rank subdirectories before starting the fleet)
+            layouts = self.comm.allgather(np.asarray(
+                [1 if getattr(tail, "_subdir_layout", False) else 0],
+                np.int64)).reshape(-1)
+            if not (layouts == layouts[0]).all():
+                raise LightGBMError(
+                    "sharded continuous fleet has a MIXED shard layout: "
+                    f"ranks report subdir-layout={layouts.tolist()} — "
+                    "create every <source>/<rank>/ subdirectory before "
+                    "starting the fleet, or none of them")
+        self.fleet_dir = trainer.fleet_dir
+        file_io.makedirs(self.fleet_dir)
+        self._journal_path = (f"{self.fleet_dir}/journal_rank"
+                              f"{self.comm.rank}.jsonl")
+        self._raw_base_path = (f"{self.fleet_dir}/raw_base_rank"
+                               f"{self.comm.rank}.npz")
+        self._state_path = f"{self.fleet_dir}/commit_state.json"
+        self._pending_replay: List[str] = []
+        self._reference_train_rows = 0   # train rows when store was built
+        self.recovered_from: Optional[Dict] = None
+        self.recover()
+
+    # -- journal / commit-record IO ------------------------------------
+    def _journal_append(self, entry: Dict) -> None:
+        with file_io.open_writable(self._journal_path, append=True) as fh:
+            fh.write(json.dumps(entry) + "\n")
+
+    def _read_journal(self) -> List[Dict]:
+        try:
+            text = file_io.read_text(self._journal_path)
+        except OSError:
+            return []
+        out = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+        return out
+
+    def _read_commit_state(self) -> Optional[Dict]:
+        try:
+            return json.loads(file_io.read_text(self._state_path))
+        except OSError:
+            return None
+
+    def _write_commit_state(self, decision: Dict) -> None:
+        """Phase 2, rank 0: the single fleet-wide commit record."""
+        tr = self.trainer
+        state = {"cycle": tr.cycle - 1,   # commit/discard just advanced it
+                 "decision": decision["action"],
+                 "artifact_version": tr.artifact_version,
+                 "store_built_cycle": int(tr._store_built_cycle),
+                 "cycles_since_rebin": int(tr._cycles_since_rebin),
+                 "best_auc": self.gate.best_auc,
+                 "live_auc": self.gate.live_auc,
+                 "model_file": None, "model_sha256": None,
+                 "prev_model_file": None}
+        if tr.model_str is not None:
+            mf = f"{self.fleet_dir}/committed_model.txt"
+            payload = tr.model_str.encode("utf-8")
+            _write_bytes_atomic(mf, payload)
+            state["model_file"] = mf
+            state["model_sha256"] = hashlib.sha256(payload).hexdigest()
+        if tr._prev_model_str is not None:
+            pf = f"{self.fleet_dir}/prev_model.txt"
+            _write_bytes_atomic(pf, tr._prev_model_str.encode("utf-8"))
+            state["prev_model_file"] = pf
+        tmp_state = json.dumps(state, indent=1)
+        _write_bytes_atomic(self._state_path, tmp_state.encode("utf-8"))
+
+    def _write_raw_base(self) -> None:
+        """Persist this rank's committed raw-score cache (phase 2): the
+        uninterrupted pipeline's init scores are the HARVESTED f32 train
+        scores, which a relaunch cannot reproduce by re-predicting (host
+        f64 traversal rounds differently) — so bit-identical recovery
+        rides this file.  Tagged with the committed cycle; a stale tag
+        falls back to host prediction with a warning."""
+        tr = self.trainer
+        buf = io.BytesIO()
+        raw = (tr._raw_base if tr._raw_base is not None
+               else np.empty((0,), np.float64))
+        np.savez(buf, cycle=np.asarray([tr.cycle - 1], np.int64), raw=raw)
+        _write_bytes_atomic(self._raw_base_path, buf.getvalue())
+
+    # -- recovery -------------------------------------------------------
+    def recover(self) -> None:
+        state = self._read_commit_state()
+        journal = self._read_journal()
+        if state is None and not journal:
+            return
+        committed = int(state["cycle"]) if state is not None else -1
+        tr = self.trainer
+        committed_entries = [e for e in journal
+                             if int(e["cycle"]) <= committed]
+        inflight = [e for e in journal if int(e["cycle"]) > committed]
+        # 1) replay committed segments: same bytes, same validation,
+        #    same deterministic split — the pool is rebuilt exactly
+        replayed_names: List[str] = []
+        train_rows_at_cycle: Dict[int, int] = {}
+        for e in committed_entries:
+            batches = self.tail.read_segments(e["segments"])
+            for b in batches:
+                tr.ingest(b.X, b.y)
+            replayed_names.extend(e["segments"])
+            train_rows_at_cycle[int(e["cycle"])] = tr.num_train_rows
+        self.tail.mark_seen(replayed_names)
+        # 2) committed model + gate baseline
+        if state is not None:
+            if state.get("model_file"):
+                text = file_io.read_text(state["model_file"])
+                digest = hashlib.sha256(text.encode("utf-8")).hexdigest()
+                if digest != state.get("model_sha256"):
+                    raise LightGBMError(
+                        "committed model failed sha256 verification on "
+                        "recovery — refusing to continue from corrupt "
+                        f"state ({state['model_file']})")
+                tr.model_str = text
+            if state.get("prev_model_file"):
+                tr._prev_model_str = file_io.read_text(
+                    state["prev_model_file"])
+            tr.cycle = committed + 1
+            tr._cycles_since_rebin = int(
+                state.get("cycles_since_rebin", 0))
+            self.gate.best_auc = state.get("best_auc")
+            self.gate.live_auc = state.get("live_auc")
+            if self.gate.live_auc is not None:
+                self.gate._live_model_str = tr.model_str
+            if tr.model_str is not None and self.gate.registry is not None:
+                # serving resumes from the committed model immediately,
+                # before the first recovered cycle finishes
+                self.gate.registry.publish(
+                    self.gate.model_name, model_str=tr.model_str,
+                    aot_bundle_dir=self.gate.aot_bundle_dir)
+            # 3) store + sketch under the CURRENT mapper artifact
+            if int(state.get("artifact_version", 0)) > 0 \
+                    and tr.num_train_rows > 0:
+                built = int(state.get("store_built_cycle", 0))
+                # reference = this rank's cumulative train rows through
+                # the cycle the store was (re)built at (this rank may
+                # have had no segments in some cycles — take the last
+                # journaled cycle at or before the build)
+                ref_rows = 0
+                for c_, n_ in train_rows_at_cycle.items():
+                    if c_ <= built:
+                        ref_rows = n_
+                self._reference_train_rows = ref_rows
+                tr.restore_store(int(state["artifact_version"]), ref_rows)
+                tr._store_built_cycle = built
+            # 4) committed raw-score cache (bit-identity of init scores)
+            try:
+                blob = file_io.read_bytes(self._raw_base_path)
+                with np.load(io.BytesIO(blob)) as z:
+                    tag = int(z["cycle"][0])
+                    raw = np.asarray(z["raw"], np.float64)
+                if tag == committed and tr.model_str is not None:
+                    tr._raw_base = raw if raw.size else None
+                elif tr.model_str is not None:
+                    log_warning(
+                        "continuous: raw-score cache is tagged cycle "
+                        f"{tag} but cycle {committed} committed — init "
+                        "scores will be re-predicted host-side (model "
+                        "quality unaffected; bit-identity to the "
+                        "uninterrupted run is not guaranteed)")
+            except OSError:
+                pass
+        # 5) the in-flight cycle replays on exactly its prepared
+        #    segments before any new polling
+        pending: List[str] = []
+        for e in inflight:
+            pending.extend(e["segments"])
+        self._pending_replay = pending
+        self.tail.mark_seen(pending)
+        self.recovered_from = {
+            "committed_cycle": committed,
+            "replayed_segments": len(replayed_names),
+            "inflight_segments": len(pending),
+        }
+        log_info(f"continuous[shard {self.comm.rank}]: recovered at "
+                 f"cycle {committed} ({len(replayed_names)} committed "
+                 f"segments replayed, {len(pending)} in-flight)")
+
+    # -- the coordinated step ------------------------------------------
+    def step(self) -> Dict:
+        from ..checkpoint.fault import maybe_inject_cycle_fault
+        tr = self.trainer
+        replaying = bool(self._pending_replay)
+        # replay must be FLEET-consistent: while any rank is replaying
+        # its in-flight cycle, the others consume NOTHING this step —
+        # otherwise segments that arrived during the downtime would be
+        # merged into the replayed cycle, which must re-run on exactly
+        # its original data (the checkpoints it resumes from are keyed
+        # to that data)
+        fleet_replaying = int(self.comm.allreduce(np.asarray(
+            [1 if replaying else 0], np.int64))[0]) > 0
+        if replaying:
+            batches = self.tail.read_segments(self._pending_replay)
+            self._pending_replay = []
+        elif fleet_replaying:
+            batches = []
+        else:
+            batches = self.tail.poll()
+        names = [b.name for b in batches]
+        new_rows = int(sum(len(b.y) for b in batches))
+        summary: Dict = {"new_rows": new_rows, "trained": False,
+                         "decision": None, "rollback": None,
+                         "segments": names, "replayed": replaying}
+        cycle = tr.cycle
+        # phase 1: journal the consumed segments as PREPARED before
+        # anything can die — a replayed cycle's prepare already exists
+        if names and not replaying:
+            self._journal_append({"phase": "prepare", "cycle": cycle,
+                                  "segments": names})
+        maybe_inject_cycle_fault(cycle, rank=self.comm.rank)
+        fresh_hX, fresh_hy = [], []
+        for b in batches:
+            hx, hy = tr.ingest(b.X, b.y)
+            if len(hy):
+                fresh_hX.append(hx)
+                fresh_hy.append(hy)
+        # fleet train decision (one reduction, doubles as the lockstep
+        # rendezvous): train only when SOMEONE has fresh rows and EVERY
+        # rank has pool rows (an empty shard cannot join the collective
+        # training program)
+        nf_local = self.tail.num_features or (
+            tr._train_X[0].shape[1] if tr._train_X else 0)
+        flags = self.comm.allgather(np.asarray(
+            [new_rows, 1 if tr.num_train_rows > 0 else 0, nf_local],
+            np.int64))
+        total_fresh = int(flags[:, 0].sum())
+        ranks_with_rows = int(flags[:, 1].sum())
+        # fleet-agreed feature count: a rank whose shard never produced
+        # a segment has no local width yet, and its empty (0, 0) window
+        # must still allgather against the others' (k, F) windows
+        nf = int(flags[:, 2].max())
+        summary["fleet_fresh_rows"] = total_fresh
+        if total_fresh == 0:
+            return summary
+        # fleet-global fresh-holdout window -> identical watch verdict.
+        # Watched BEFORE the deferral below: rows ingested while the
+        # fleet waits for an empty shard must still be monitored for a
+        # live-model regression (the base service watches every fresh
+        # window, so the sharded one must too)
+        wX = (np.concatenate(fresh_hX) if fresh_hy
+              else np.empty((0, nf), np.float64))
+        wy = (np.concatenate(fresh_hy) if fresh_hy
+              else np.empty((0,), np.float64))
+        wX_g, _ = self.comm.allgather_blocks(
+            np.ascontiguousarray(wX, np.float64))
+        wy_g, _ = self.comm.allgather_blocks(
+            np.asarray(wy, np.float64).reshape(-1))
+        if len(wy_g):
+            rb = self.gate.watch(wX_g, wy_g)
+            if rb is not None:
+                summary["rollback"] = rb
+                tr.revert()
+        if ranks_with_rows < self.comm.size:
+            log_info(f"continuous[shard {self.comm.rank}]: "
+                     f"{self.comm.size - ranks_with_rows} rank(s) have "
+                     "no training rows yet; deferring the cycle")
+            return summary
+        result = self._train_cycle_supervised()
+        summary["trained"] = True
+        summary["resumed_from"] = result["resumed_from"]
+        for key in ("setup_s", "init_score_s", "compiles", "fresh_rows",
+                    "rebin", "row_bucket", "pad_fraction",
+                    "drift_max_psi"):
+            if key in result:
+                summary[key] = result[key]
+        decision = self.gate.consider(result["candidate_str"],
+                                      result["auc"],
+                                      cycle=result["cycle"])
+        if decision["action"] == "publish":
+            tr.commit(result["candidate_str"])
+        else:
+            tr.discard()
+        # phase 2: the cycle is decided — rank 0 publishes the commit
+        # record, every rank persists its raw cache, and the fleet
+        # rendezvouses so nobody starts cycle N+1 against an unwritten
+        # commit record
+        self._write_raw_base()
+        if self.comm.rank == 0:
+            self._write_commit_state(decision)
+        self.comm.barrier(f"commit_{cycle}")
+        self.m_cycles.inc()
+        summary["decision"] = decision
+        self.events.append(summary)
+        self._append_event(summary)
+        return summary
+
+    def _append_event(self, summary: Dict) -> None:
+        """Per-rank cycle event log under the fleet dir (best-effort):
+        the observable the sharded soak reads its per-rank bars from —
+        compiles per cycle, setup wall, re-bin decisions — without
+        scraping worker stdout."""
+        ev = {k: summary.get(k) for k in
+              ("new_rows", "segments", "replayed", "setup_s",
+               "init_score_s", "compiles", "fresh_rows", "row_bucket",
+               "pad_fraction", "drift_max_psi", "resumed_from")}
+        ev["cycle"] = self.trainer.cycle - 1
+        ev["rebin"] = bool(summary.get("rebin"))
+        dec = summary.get("decision")
+        ev["decision"] = dec["action"] if dec else None
+        try:
+            with file_io.open_writable(
+                    f"{self.fleet_dir}/events_rank{self.comm.rank}.jsonl",
+                    append=True) as fh:
+                fh.write(json.dumps(ev) + "\n")
+        except OSError as exc:
+            log_warning(f"continuous: could not append fleet event log: "
+                        f"{exc}")

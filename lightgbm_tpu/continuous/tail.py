@@ -21,9 +21,30 @@ and parses them with PER-RECORD validation:
 Bad rows land in a quarantine JSONL (one ``{"segment", "row", "reason",
 "raw"}`` line each, append-mode so restarts keep history) and bump
 ``lgbm_continuous_quarantined_total`` — a poisoned segment costs its bad
-rows, never the trainer.  An unreadable segment is logged and retried on
-the next poll; transient backend errors are already retried inside
-file_io.
+rows, never the trainer.  The quarantine file is size-bounded
+(``quarantine_max_bytes``): when an append would overflow it, the file
+rotates to a single ``.1`` sibling (the previous ``.1`` is dropped) and
+``lgbm_continuous_quarantine_rotated_total`` bumps — a poisoned upstream
+fills at most two files, never the disk of a long-running worker.
+
+An unreadable segment is retried with BOUNDED per-segment exponential
+backoff (``retry_backoff_s * 2^attempts``, capped), counted in
+``lgbm_continuous_segment_retry_total``; past ``retry_max`` attempts the
+whole segment is quarantined with reason ``unreadable`` and never
+retried again — a segment the producer half-deleted must not pin the
+poll loop forever.  Transient backend errors are additionally retried
+inside file_io.
+
+**Sharding** (the fleet ingest topology): with ``num_shards > 1`` each
+rank's tail consumes only ITS shard of the segment stream — either a
+rank-owned subdirectory ``<source>/<rank>/`` (used when it exists:
+producers that partition explicitly) or a deterministic hash split of a
+shared directory (crc32 of the segment name modulo ``num_shards``), so
+any fleet size agrees on ownership without coordination and no segment
+is consumed by two ranks.  The layout is probed ONCE at construction:
+create every rank subdirectory before starting the fleet, or none of
+them (the sharded service allgathers the per-rank decision and refuses
+a mixed fleet).
 
 The tail itself is deliberately stateless on disk: a restarted service
 re-polls every segment from the top and rebuilds the same cumulative
@@ -37,7 +58,9 @@ from __future__ import annotations
 
 import json
 import math
-from typing import List, NamedTuple, Optional, Set
+import time
+import zlib
+from typing import Dict, List, NamedTuple, Optional, Set
 
 import numpy as np
 
@@ -45,7 +68,16 @@ from ..io import file_io
 from ..log import log_info, log_warning
 from ..telemetry import get_counter
 
-__all__ = ["DataTail", "SegmentBatch"]
+__all__ = ["DataTail", "SegmentBatch", "shard_of"]
+
+
+def shard_of(name: str, num_shards: int) -> int:
+    """Deterministic shard owner of a segment name: stable across
+    processes, platforms and restarts (crc32, not ``hash()`` — the
+    latter is salted per interpreter)."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(name.encode("utf-8")) % int(num_shards)
 
 
 class SegmentBatch(NamedTuple):
@@ -63,13 +95,37 @@ class DataTail:
                  registry=None,
                  label_kind: str = "binary",
                  allow_nan_features: bool = False,
-                 sep: str = ","):
+                 sep: str = ",",
+                 shard_rank: int = 0,
+                 num_shards: int = 1,
+                 quarantine_max_bytes: int = 0,
+                 retry_max: int = 6,
+                 retry_backoff_s: float = 0.5,
+                 retry_backoff_cap_s: float = 60.0):
         self.source = source.rstrip("/")
         self.num_features = num_features
         self.quarantine_path = quarantine_path
         self.label_kind = label_kind
         self.allow_nan_features = bool(allow_nan_features)
         self.sep = sep
+        self.shard_rank = int(shard_rank)
+        self.num_shards = max(int(num_shards), 1)
+        if not 0 <= self.shard_rank < self.num_shards:
+            raise ValueError(f"shard_rank {shard_rank} not in "
+                             f"[0, {self.num_shards})")
+        self._subdir_layout = False
+        if self.num_shards > 1 and file_io.exists(
+                f"{self.source}/{self.shard_rank}"):
+            # rank-owned subdirectory layout: the producer partitions;
+            # the hash split below covers unpartitioned shared dirs
+            self.source = f"{self.source}/{self.shard_rank}"
+            self._subdir_layout = True
+        self.quarantine_max_bytes = int(quarantine_max_bytes)
+        self._quarantine_bytes: Optional[int] = None   # lazy size probe
+        self.retry_max = int(retry_max)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
+        self._retry: Dict[str, List[float]] = {}   # name -> [attempts, t_next]
         self._seen: Set[str] = set()
         self.m_segments = get_counter(
             registry, "lgbm_continuous_segments_total",
@@ -83,6 +139,13 @@ class DataTail:
         self.m_segment_errors = get_counter(
             registry, "lgbm_continuous_segment_errors_total",
             "segments that could not be read (left for the next poll)")
+        self.m_segment_retries = get_counter(
+            registry, "lgbm_continuous_segment_retry_total",
+            "unreadable-segment retries scheduled with exponential "
+            "backoff (past the budget the segment is quarantined)")
+        self.m_quarantine_rotated = get_counter(
+            registry, "lgbm_continuous_quarantine_rotated_total",
+            "quarantine JSONL size-based rotations (.1 sibling replaced)")
 
     # ------------------------------------------------------------------
     def mark_seen(self, names) -> None:
@@ -98,10 +161,14 @@ class DataTail:
             # not a trainer crash; the next poll retries
             log_warning(f"continuous: cannot list {self.source}: {exc}")
             return []
+        now = time.monotonic()
         fresh = [n for n in sorted(names)
                  if n not in self._seen
                  and not n.startswith((".", "_"))
-                 and not n.endswith(".tmp")]
+                 and not n.endswith(".tmp")
+                 and (self.num_shards <= 1 or self._subdir_layout
+                      or shard_of(n, self.num_shards) == self.shard_rank)
+                 and (n not in self._retry or self._retry[n][1] <= now)]
         return fresh
 
     # ------------------------------------------------------------------
@@ -127,7 +194,9 @@ class DataTail:
                 return None, f"feature {j}: NaN"
         return (feats, label), ""
 
-    def _read_segment(self, name: str) -> Optional[SegmentBatch]:
+    def _read_segment(self, name: str,
+                      record_quarantine: bool = True
+                      ) -> Optional[SegmentBatch]:
         path = f"{self.source}/{name}"
         try:
             text = file_io.read_text(path)
@@ -153,7 +222,7 @@ class DataTail:
                 self.num_features = len(feats)
             rows.append(feats)
             labels.append(label)
-        if quarantined:
+        if quarantined and record_quarantine:
             self._quarantine(quarantined)
         X = (np.asarray(rows, np.float64) if rows
              else np.empty((0, self.num_features or 0), np.float64))
@@ -164,17 +233,99 @@ class DataTail:
         self.m_quarantined.inc(len(records))
         if not self.quarantine_path:
             return
+        payload = "".join(json.dumps(rec) + "\n" for rec in records)
+        nbytes = len(payload.encode("utf-8"))
         try:
+            self._maybe_rotate_quarantine(nbytes)
             with file_io.open_writable(self.quarantine_path,
                                        append=True) as fh:
-                for rec in records:
-                    fh.write(json.dumps(rec) + "\n")
+                fh.write(payload)
+            if self._quarantine_bytes is not None:
+                self._quarantine_bytes += nbytes
         except OSError as exc:
             # the quarantine file is evidence, not a dependency
             log_warning(f"continuous: could not write quarantine file "
                         f"{self.quarantine_path}: {exc}")
 
+    def _maybe_rotate_quarantine(self, incoming: int) -> None:
+        """Size-bound the quarantine JSONL (``quarantine_max_bytes``):
+        when the next append would overflow, the current file becomes the
+        single ``.1`` sibling (the previous ``.1`` — the oldest evidence
+        — is dropped), so a poisoned upstream costs at most two files of
+        bounded size on a worker that runs for months."""
+        if self.quarantine_max_bytes <= 0:
+            return
+        if self._quarantine_bytes is None:
+            # one-time size probe of whatever a previous run left behind
+            try:
+                self._quarantine_bytes = file_io.filesize(
+                    self.quarantine_path)
+            except OSError:
+                self._quarantine_bytes = 0
+        if self._quarantine_bytes == 0 or \
+                self._quarantine_bytes + incoming <= self.quarantine_max_bytes:
+            return
+        rotated = f"{self.quarantine_path}.1"
+        try:
+            try:
+                file_io.remove(rotated)
+            except OSError:
+                pass                          # no previous .1 to drop
+            file_io.rename(self.quarantine_path, rotated)
+        except OSError as exc:
+            log_warning(f"continuous: quarantine rotation failed for "
+                        f"{self.quarantine_path}: {exc}")
+            return
+        self._quarantine_bytes = 0
+        self.m_quarantine_rotated.inc()
+        log_info(f"continuous: rotated quarantine file to {rotated}")
+
+    def _schedule_retry(self, name: str) -> None:
+        """Unreadable segment: bounded exponential backoff, then give up
+        and quarantine the whole segment (reason ``unreadable``) — a
+        half-written or permission-broken file must neither crash the
+        trainer nor be re-read on every poll forever."""
+        attempts, _ = self._retry.get(name, (0, 0.0))
+        attempts += 1
+        if attempts > self.retry_max:
+            self._retry.pop(name, None)
+            self._seen.add(name)       # consumed-as-quarantined: never again
+            self._quarantine([{"segment": name, "row": -1,
+                               "reason": "unreadable", "raw": ""}])
+            log_warning(
+                f"continuous: segment {name} unreadable after "
+                f"{self.retry_max} retries — quarantined whole "
+                "(reason=unreadable)")
+            return
+        self.m_segment_retries.inc()
+        delay = min(self.retry_backoff_s * (2.0 ** (attempts - 1)),
+                    self.retry_backoff_cap_s)
+        self._retry[name] = [attempts, time.monotonic() + delay]
+        log_warning(f"continuous: segment {name} unreadable (attempt "
+                    f"{attempts}/{self.retry_max}); next retry in "
+                    f"{delay:.2f}s")
+
     # ------------------------------------------------------------------
+    def read_segments(self, names) -> List[SegmentBatch]:
+        """Re-read specific segments by name, bypassing discovery and the
+        seen-set (the sharded service's journal REPLAY path: a relaunch
+        re-validates exactly the segments its journal says were consumed,
+        in journal order).  Side-effect-free: bad rows are DROPPED
+        identically but not re-quarantined — the first read already
+        recorded the evidence, and a fleet that restarts N times must
+        not log it N+1 times or N+1-count the alarm counter.  Unreadable
+        segments raise — replay must be exact or fail loudly, never
+        silently partial."""
+        out: List[SegmentBatch] = []
+        for name in names:
+            batch = self._read_segment(name, record_quarantine=False)
+            if batch is None:
+                raise OSError(
+                    f"continuous: journaled segment {name} is unreadable "
+                    "— cannot replay the committed ingest position")
+            out.append(batch)
+        return out
+
     def poll(self) -> List[SegmentBatch]:
         """Validated batches for every NEW segment (name order); a
         segment is consumed exactly once per tail instance."""
@@ -182,8 +333,10 @@ class DataTail:
         for name in self._discover():
             batch = self._read_segment(name)
             if batch is None:
-                continue                    # unreadable: retry next poll
+                self._schedule_retry(name)  # unreadable: bounded backoff
+                continue
             self._seen.add(name)
+            self._retry.pop(name, None)
             self.m_segments.inc()
             self.m_rows.inc(len(batch.y))
             log_info(f"continuous: ingested segment {name}: "

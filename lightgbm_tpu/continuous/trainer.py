@@ -45,7 +45,6 @@ reproduces the same split and the gate's AUC series stays comparable.
 
 from __future__ import annotations
 
-import os
 import re
 import time
 from typing import Dict, List, Optional, Tuple
@@ -194,6 +193,7 @@ class ContinuousTrainer:
         self._store = None            # persistent TrainDataset
         self._store_segments = 0      # _train_X entries already in store
         self._sketch = None           # DriftSketch over the store mappers
+        self._store_built_cycle = 0   # cycle the store's mappers date from
         self._cycles_since_rebin = 0
         self._raw_base: Optional[np.ndarray] = None   # committed raw/train row
         self._prev_raw_base: Optional[np.ndarray] = None
@@ -242,20 +242,37 @@ class ContinuousTrainer:
         return f"{self.workdir}/cycles/cycle_{cycle:05d}"
 
     # -- incremental store management ----------------------------------
-    def _build_store(self) -> None:
-        """(Re)build the persistent binned store from the raw pool: fresh
-        GreedyFindBin mappers + EFB + device placement over ALL history —
-        the O(total rows) path, paid once at cycle 0 and on re-bin."""
+    def _pool(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenated raw train pool (this rank's rows)."""
+        return np.concatenate(self._train_X), np.concatenate(self._train_y)
+
+    def _construct_store(self, X: np.ndarray, y: np.ndarray):
+        """Build the binned store over the pool — the subclass seam the
+        sharded trainer overrides to bin against FLEET-SHARED mappers
+        instead of deriving them from this rank's rows alone."""
         from ..config import Config
         from ..dataset import Metadata, TrainDataset
+        return TrainDataset(X, Metadata(y), Config(self.params))
+
+    def _build_store(self, reset_sketch: bool = True) -> None:
+        """(Re)build the persistent binned store from the raw pool: fresh
+        GreedyFindBin mappers + EFB + device placement over ALL history —
+        the O(total rows) path, paid once at cycle 0 and on re-bin.
+        ``reset_sketch=False`` is the relaunch-recovery path: the sketch
+        state is restored from its journal instead of re-deriving a
+        reference from the full (replayed) pool."""
         from .drift import DriftSketch
-        X = np.concatenate(self._train_X)
-        y = np.concatenate(self._train_y)
-        self._store = TrainDataset(X, Metadata(y), Config(self.params))
+        X, y = self._pool()
+        self._store = self._construct_store(X, y)
         self._store_segments = len(self._train_X)
-        self._sketch = DriftSketch(
-            np.asarray(self._store.num_bins_per_feature))
-        self._sketch.set_reference(self._store.bins)
+        # which cycle the store's mappers were built at: rows ingested up
+        # to here are the drift sketch's REFERENCE; the sharded service
+        # journals this so a relaunch reconstructs the same split
+        self._store_built_cycle = self.cycle
+        if reset_sketch or self._sketch is None:
+            self._sketch = DriftSketch(
+                np.asarray(self._store.num_bins_per_feature))
+            self._sketch.set_reference(self._store.bins)
         self._cycles_since_rebin = 0
 
     def _sync_store(self) -> int:
@@ -302,13 +319,20 @@ class ContinuousTrainer:
         self._raw_base = (raw if self._raw_base is None
                           else np.concatenate([self._raw_base, raw]))
 
+    def _decision_sketch(self):
+        """The sketch the re-bin policy scores.  Base: this trainer's own
+        (single-process) sketch; the sharded trainer returns the fleet-
+        REDUCED sketch so every rank reads identical PSI and the re-bin
+        decision is a consensus, never a per-rank disagreement."""
+        return self._sketch
+
     def _maybe_rebin(self) -> Optional[Dict]:
         """Policy decision: pay a full re-bin now?  Returns the recorded
         event (with drift scores + paid wall-clock) or None."""
         reason = None
         info: Dict = {}
         if self.rebin_policy == "drift":
-            summ = self._sketch.summary()
+            summ = self._decision_sketch().summary()
             info = summ
             if summ["recent_rows"] > 0 and \
                     summ["max_psi"] > self.rebin_threshold:
@@ -400,7 +424,7 @@ class ContinuousTrainer:
             self._ensure_raw_base()
             self._store.set_init_score(self._raw_base)
             init_score_s = time.perf_counter() - t_init
-            ds = lgb.Dataset._from_handle(self._store, self.params)
+            ds = self._training_handle()
         else:
             X = np.concatenate(self._train_X)
             y = np.concatenate(self._train_y)
@@ -416,7 +440,7 @@ class ContinuousTrainer:
                 ds.construct()
             setup_s = time.perf_counter() - t_setup
         booster = lgb.train(
-            self.params, ds, num_boost_round=self.rounds,
+            self._engine_params(), ds, num_boost_round=self.rounds,
             init_model=init, callbacks=list(callbacks or []),
             checkpoint_dir=cycle_dir, checkpoint_freq=self.checkpoint_freq,
             keep_checkpoints=self.keep_checkpoints, resume="auto")
@@ -427,11 +451,8 @@ class ContinuousTrainer:
             # candidate raw score per train row IS the final train score
             # (init + delta raw) — cached so the next cycle's init scores
             # never need an O(total x trees) full-model predict
-            self._last_raw = np.asarray(
-                booster._gbdt.train_score[0],
-                np.float32)[:self._store.num_data].astype(np.float64)
-        hx, hy = self.holdout()
-        auc = holdout_auc(candidate, hx, hy) if len(hy) else float("nan")
+            self._last_raw = self._harvest_candidate_raw(booster)
+        auc = self._cycle_auc(candidate)
         compiles1, _ = compile_snapshot()
         out = {"cycle": self.cycle, "delta_booster": booster,
                "candidate_str": candidate, "auc": auc,
@@ -443,10 +464,50 @@ class ContinuousTrainer:
                "compiles": int(compiles1 - compiles0),
                "rebin": rebin_event}
         if self.incremental:
-            out["row_bucket"] = int(self._store.num_rows_device)
+            out["row_bucket"] = self._train_row_bucket()
             out["pad_fraction"] = round(self._store.pad_fraction, 4)
-            out["drift_max_psi"] = round(self._sketch.max_score(), 5)
+            out["drift_max_psi"] = round(
+                self._decision_sketch().max_score(), 5)
         return out
+
+    # -- subclass seams (sharded trainer, continuous/sharded.py) --------
+    def _engine_params(self) -> Dict:
+        """Params the engine trains a cycle with.  The sharded trainer's
+        replicated fallback strips the distributed learner selection
+        (every rank trains the union serially there)."""
+        return self.params
+
+    def _training_handle(self):
+        """The dataset engine.train consumes: the persistent store
+        itself.  The sharded trainer returns a rank-local training VIEW
+        over the store instead (global metadata, local bin shard)."""
+        import lightgbm_tpu as lgb
+        return lgb.Dataset._from_handle(self._store, self.params)
+
+    def _train_row_bucket(self) -> int:
+        """The padded row-axis shape training compiled against — the
+        stable-bucket signal the zero-steady-state-compile bar is read
+        by.  The sharded trainer reports the FLEET training shape (union
+        bucket / per-rank block bucket), which is what actually keys the
+        compiled programs there."""
+        return int(self._store.num_rows_device)
+
+    def _harvest_candidate_raw(self, booster) -> np.ndarray:
+        """Candidate raw score for THIS trainer's train rows, read off
+        the booster's final train score (init + delta).  The sharded
+        trainer slices its rank's block out of the global score."""
+        return np.asarray(
+            booster._gbdt.train_score[0],
+            np.float32)[:self._store.num_data].astype(np.float64)
+
+    def _cycle_auc(self, candidate_str: str) -> float:
+        """Cumulative-holdout AUC of the candidate.  The sharded trainer
+        allgathers per-rank (raw, label) pairs so every rank computes the
+        identical fleet-global number and gate decisions cannot
+        diverge."""
+        hx, hy = self.holdout()
+        return holdout_auc(candidate_str, hx, hy) if len(hy) \
+            else float("nan")
 
     def commit(self, candidate_str: str) -> None:
         """Advance the committed model (the gate accepted the candidate)

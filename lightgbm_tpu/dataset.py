@@ -531,6 +531,11 @@ class TrainDataset:
                           enable_efb=False, place_on_device=False)
         self.rank_local = True
         self.num_data = n_global               # override: GLOBAL row count
+        # score/gradient arrays are GLOBAL on every rank (the learner
+        # scatters them into its padded layout); _finish_init left the
+        # LOCAL row count here, which would size the booster's train
+        # score under the global gradient exchange
+        self.num_rows_device = n_global
         self.local_num_data = bins.shape[0]
         self.block_sizes = np.asarray(sizes, np.int64)
         self.row_offset = row_offset
@@ -923,19 +928,28 @@ class TrainDataset:
         from .log import LightGBMError
         from .ops.histogram import pack_bins
         if self.device_bins is None:
-            # self.bins is the pre-bundling storage matrix: packing it
-            # under a plan built over device_col_num_bins would produce a
-            # plausibly-shaped but WRONG matrix — refuse instead.
-            # Rank-local shards hit this by construction: their loading
-            # skips device_bins entirely (packed bins for the sharded
-            # data-parallel dataset are a ROADMAP quantized-engine
-            # follow-up).
+            if getattr(self, "rank_local", False) \
+                    and self.bundle_map is None and self.bins is not None:
+                # rank-local shard: EFB is disabled at construction
+                # (bundling decisions from local conflict counts would
+                # diverge across ranks), so the per-feature storage
+                # matrix IS device space and the shard packs directly —
+                # the plan is a pure function of device_col_num_bins,
+                # which the synced mappers make identical on every rank,
+                # so every rank packs against the same replicated layout.
+                return pack_bins(np.asarray(self.bins), plan)
+            # Anything else without a device matrix is genuinely
+            # unsupported: a freed dataset (bins dropped), or an
+            # EFB-bundled dataset whose device-space matrix is gone —
+            # packing self.bins under a plan built over
+            # device_col_num_bins would produce a plausibly-shaped but
+            # WRONG matrix, so refuse instead.
             raise LightGBMError(
-                "packed_device_bins needs the device-space matrix; this "
-                "dataset has no device_bins (rank-local shard?).  Packed "
-                "sub-byte bins for rank-local data-parallel datasets are "
-                "an open ROADMAP item (quantized engine follow-ups) — "
-                "run with quantized_histograms=false for sharded loading")
+                "packed_device_bins needs a device-space matrix; this "
+                "dataset has neither device_bins nor an unbundled host "
+                "bin matrix (freed with free_dataset, or loaded without "
+                "them) — rebuild the dataset, or run with "
+                "quantized_histograms=false")
         if self._store_dev is not None:
             # incremental store: keep the packed planes persistent so an
             # extend() repacks only its fresh segment instead of the

@@ -31,7 +31,7 @@ import time
 from typing import Callable, Dict, Optional
 
 __all__ = ["open_readable", "open_writable", "register_scheme", "exists",
-           "rename", "remove", "listdir", "makedirs",
+           "rename", "remove", "listdir", "makedirs", "filesize",
            "TransientIOError", "configure_retries", "with_retry",
            "read_bytes", "read_text"]
 
@@ -171,6 +171,19 @@ def exists(path: str) -> bool:
             return True
     except OSError:
         return False
+
+
+def filesize(path: str) -> int:
+    """Size of ``path`` in bytes.  O(1) stat for local paths; registered
+    schemes without a native size op fall back to seeking to the end of
+    an opened handle (never a whole-file read)."""
+    scheme, rest = _split_scheme(path)
+    if scheme in (None, "file"):
+        return os.path.getsize(rest if scheme == "file" else path)
+    def _do():
+        with _open(path, "rb") as fh:
+            return fh.seek(0, 2)
+    return int(with_retry(_do))
 
 
 def _rename_once(src: str, dst: str) -> None:

@@ -87,9 +87,28 @@ class DataParallelTreeLearner(SerialTreeLearner):
             dev_per_proc = max(self.n_dev // nproc, 1)
             sizes = dataset.block_sizes
             n_per = -(-int(sizes.max()) // dev_per_proc) * dev_per_proc
+            if getattr(config, "train_row_buckets", False):
+                # sharded continuous ingest: each rank's block grows
+                # cycle over cycle; rounding the per-rank block up to the
+                # serving power-of-two ladder keeps the sharded grow
+                # program's shapes stable across cycles (zero steady-
+                # state compiles until a rank outgrows its bucket), and
+                # the pad rows are already masked out of every histogram
+                # (zero grad/hess/mask below)
+                from ..ops.predict import row_bucket
+                n_per = -(-int(row_bucket(n_per)) // dev_per_proc) \
+                    * dev_per_proc
             self.n_per = n_per
             self.pad = nproc * n_per - n       # total pad rows (interleaved)
-            local = dataset.bins
+            if self.pack_plan is not None:
+                # quantized engine on a rank-local shard: pack THIS
+                # rank's storage matrix against the replicated plan
+                # (dataset.packed_device_bins handles the EFB-off
+                # storage==device-space equivalence) and shard the
+                # packed planes exactly like the unpacked matrix
+                local = dataset.packed_device_bins(self.pack_plan)
+            else:
+                local = dataset.bins
             if local.shape[0] < n_per:
                 local = np.pad(local,
                                ((0, n_per - local.shape[0]), (0, 0)))

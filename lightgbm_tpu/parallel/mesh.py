@@ -19,7 +19,8 @@ from ..log import log_info, log_warning
 
 __all__ = ["build_mesh", "maybe_init_distributed", "shutdown_distributed",
            "register_external_collectives", "external_collectives",
-           "comm_size", "comm_rank", "host_allgather", "compat_shard_map"]
+           "comm_size", "comm_rank", "host_allgather", "compat_shard_map",
+           "allreduce_sum", "psum_blocks"]
 
 
 def compat_shard_map(f, mesh, in_specs, out_specs):
@@ -133,6 +134,86 @@ def host_allgather(arr: np.ndarray) -> np.ndarray:
         block_len.ctypes.data_as(c_i32p), n,
         out.ctypes.data_as(buf_t), out.nbytes)
     return out.view(arr.dtype).reshape((n,) + arr.shape)
+
+
+# compiled psum cache: jax.jit keys on function identity, so a fresh
+# lambda per call would retrace+recompile the same [n_blocks, K] psum
+# every cycle — the coordination traffic is shape-bucketed precisely so
+# this cache stays tiny
+_PSUM_CACHE: dict = {}
+
+
+def psum_blocks(stacked) -> np.ndarray:
+    """Device-side block sum: ``[n_blocks, K] -> [K]`` via a ``psum``
+    under ``compat_shard_map`` over a 1-D mesh of ``n_blocks`` devices.
+
+    The compiled reduction the fleet drift consensus runs on a pod —
+    every device contributes its block and reads back the identical sum,
+    so no host is a special snowflake.  ``stacked`` may be a host array
+    (single-process: device_put shards it) or a jax Array already built
+    from process-local blocks (``jax.make_array_from_process_local_data``
+    — the multi-process caller's job, see ``allreduce_sum``)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n_blocks = int(stacked.shape[0])
+    devices = jax.devices()[:n_blocks]
+    if len(devices) < n_blocks:
+        raise ValueError(
+            f"psum_blocks needs one device per block ({n_blocks} blocks, "
+            f"{len(devices)} devices)")
+    key = (tuple(id(d) for d in devices), tuple(stacked.shape),
+           np.dtype(stacked.dtype).str)
+    cached = _PSUM_CACHE.get(key)
+    if cached is None:
+        mesh = Mesh(np.asarray(devices), ("rank",))
+        f = jax.jit(compat_shard_map(
+            lambda x: jax.lax.psum(x, "rank"), mesh,
+            in_specs=P("rank"), out_specs=P("rank")))
+        cached = (f, NamedSharding(mesh, P("rank")))
+        _PSUM_CACHE[key] = cached
+    f, sharding = cached
+    if isinstance(stacked, np.ndarray):
+        stacked = jax.device_put(stacked, sharding)
+    out = f(stacked)
+    # every block now holds the sum; read back this process's first shard
+    # (a multi-process global array is only partially addressable here)
+    shard = np.asarray(jax.device_get(out.addressable_shards[0].data))
+    return shard[0]
+
+
+def allreduce_sum(arr: np.ndarray) -> np.ndarray:
+    """Sum an equal-shaped host array across machines.
+
+    On a multi-process jax cluster the reduction is a device ``psum``
+    through ``compat_shard_map`` (``psum_blocks`` over one block per
+    process, riding ICI/DCN on a pod); with injected external collectives
+    or a single process it degrades to ``host_allgather(...).sum(0)`` /
+    identity.  Used by the sharded continuous pipeline's drift-sketch
+    consensus, where every rank must read back the identical fleet-wide
+    occupancy."""
+    arr = np.ascontiguousarray(arr)
+    n = comm_size()
+    if n <= 1:
+        return arr.copy()
+    if _external is None and jax.process_count() == n:
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            devices = jax.devices()
+            per = len(devices) // n
+            if per >= 1 and len(devices) == per * n:
+                # contribute the payload on this process's FIRST device and
+                # zeros on the rest, so psum over all device blocks is the
+                # true cross-process sum regardless of devices-per-process
+                local = np.zeros((per,) + arr.shape, arr.dtype)
+                local[0] = arr
+                mesh = Mesh(np.asarray(devices), ("rank",))
+                stacked = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P("rank")), local,
+                    global_shape=(len(devices),) + arr.shape)
+                return np.asarray(psum_blocks(stacked), arr.dtype)
+        except Exception as exc:   # pragma: no cover - backend-dependent
+            log_warning(f"allreduce_sum: device psum unavailable "
+                        f"({exc!r}); falling back to host allgather")
+    return np.asarray(host_allgather(arr).sum(axis=0), arr.dtype)
 
 
 def shutdown_distributed() -> None:

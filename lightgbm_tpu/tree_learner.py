@@ -1520,11 +1520,19 @@ class SerialTreeLearner:
         self.train_bins = dataset.device_bins   # None for rank-local shards
         if getattr(config, "quantized_histograms", False):
             self.grower_cfg = self.grower_cfg._replace(quantized=True)
+            # the matrix a pack plan would apply to: the device-space
+            # matrix, or — for rank-local shards, where EFB is disabled
+            # so storage IS device space — the local storage matrix (the
+            # data-parallel learner packs+shards it itself)
+            packable = dataset.device_bins
+            if packable is None and getattr(dataset, "rank_local", False) \
+                    and dataset.bundle_map is None:
+                packable = dataset.bins
             if (self.PACK_BINS
                     and resolve_impl(config.histogram_impl) != "segment"
                     and getattr(config, "histogram_width_classes", True)
-                    and dataset.device_bins is not None
-                    and dataset.device_bins.dtype == jnp.uint8
+                    and packable is not None
+                    and packable.dtype == jnp.uint8
                     and getattr(dataset, "device_col_num_bins", None)
                     is not None):
                 plan = plan_packed_classes(dataset.device_col_num_bins,

@@ -139,7 +139,11 @@ def test_tail_unreadable_segment_left_for_next_poll(tmp_path):
     # every attempt (not transient, not retried by file_io)
     os.makedirs(os.path.join(src, "seg000.csv"))
     reg = MetricsRegistry()
-    tail = DataTail(src, num_features=NF, registry=reg)
+    # zero backoff: this test covers the retry-then-recover contract;
+    # the exponential-backoff schedule has its own tests
+    # (test_sharded_continuous.py)
+    tail = DataTail(src, num_features=NF, registry=reg,
+                    retry_backoff_s=0.0)
     assert tail.poll() == []
     assert tail.m_segment_errors.value == 1
     # producer fixes it: the same name is ingested on the next poll

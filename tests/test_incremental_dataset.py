@@ -380,13 +380,13 @@ def test_holdout_cache_invalidated_on_ingest(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# small fix: packed bins on rank-local shards
+# packed bins on rank-local shards (the PR 10 placeholder is gone: a
+# rank-local shard packs its own storage matrix — EFB is disabled there,
+# so storage IS device space; end-to-end training parity is covered by
+# test_sharded_continuous.test_rank_local_packed_device_bins_*)
 # ---------------------------------------------------------------------------
-def test_packed_rank_local_raises_lightgbm_error():
-    """A rank-local (device_bins-free) dataset asked for packed planes
-    must raise LightGBMError naming the ROADMAP follow-up — not a bare
-    ValueError."""
-    from lightgbm_tpu.ops.histogram import plan_packed_classes
+def test_packed_rank_local_packs_local_shard():
+    from lightgbm_tpu.ops.histogram import pack_bins, plan_packed_classes
     X, y = _pool(400, seed=80)
     params = dict(CFG, max_bin=15, tree_learner="data", num_machines=2,
                   num_tpu_devices=8, pre_partition=True)
@@ -395,7 +395,8 @@ def test_packed_rank_local_raises_lightgbm_error():
     assert getattr(ds, "rank_local", False)
     assert ds.device_bins is None
     plan = plan_packed_classes(ds.device_col_num_bins, ds.max_num_bins)
-    with pytest.raises(LightGBMError, match="ROADMAP"):
-        ds.packed_device_bins(plan)
+    packed = ds.packed_device_bins(plan)
+    np.testing.assert_array_equal(
+        packed, pack_bins(np.asarray(ds.bins), plan))
     with pytest.raises(LightGBMError):
-        ds.extend(X[:10], y[:10])         # incremental path also refuses
+        ds.extend(X[:10], y[:10])         # incremental path still refuses

@@ -1,0 +1,589 @@
+"""Sharded continuous ingest (continuous/sharded.py): rank-local tails,
+drift consensus, fingerprinted mapper artifacts, and two-phase cycle
+commit with bit-identical replay.
+
+Fast tests drive in-process fleets through injected thread-backed
+collectives (the same pattern as test_injected_collectives); the
+end-to-end 2-worker chaos run with real process kills is slow-marked
+(cluster.continuous_distributed supervision).
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.continuous import (DataTail, DriftSketch, FleetComm,
+                                     PublishGate, ShardedContinuousService,
+                                     ShardedContinuousTrainer,
+                                     load_mapper_artifact, reduce_sketch,
+                                     save_mapper_artifact, shard_of)
+from lightgbm_tpu.log import LightGBMError
+from lightgbm_tpu.telemetry import MetricsRegistry
+
+NF = 6
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 5, "max_bin": 31, "seed": 3}
+
+
+def _xy(n, seed=0, shift=0.0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, NF) + shift
+    y = (r.rand(n) < 1 / (1 + np.exp(-(2 * X[:, 0] + X[:, 1])))
+         ).astype(float)
+    return X, y
+
+
+def _write_segment(src, name, X, y):
+    lines = [",".join([f"{y[i]:.0f}"] + [f"{v:.6f}" for v in X[i]])
+             for i in range(len(y))]
+    tmp = os.path.join(src, f"_{name}.part")
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _seg_name(i, want_rank, num_shards=2):
+    """A segment name the crc32 split assigns to ``want_rank``."""
+    j = 0
+    while True:
+        name = f"seg{i:03d}_{j}.csv"
+        if shard_of(name, num_shards) == want_rank:
+            return name
+        j += 1
+
+
+class ThreadFleet:
+    """Thread-backed injected collectives: N in-process ranks exchange
+    through a shared slot table + reusable barrier (lockstep contract,
+    like the real fleet)."""
+
+    def __init__(self, size):
+        self.size = size
+        self._slots = [None] * size
+        self._bar = threading.Barrier(size)
+
+    def comm(self, rank):
+        def ag(arr, _r=rank):
+            self._slots[_r] = np.asarray(arr).copy()
+            self._bar.wait()
+            out = np.stack([self._slots[r] for r in range(self.size)])
+            self._bar.wait()
+            return out
+
+        def bar(tag):
+            self._bar.wait()
+
+        return FleetComm(rank, self.size, allgather_fn=ag, barrier_fn=bar)
+
+    def run(self, fn):
+        """fn(rank) on every rank concurrently; re-raises the first
+        failure."""
+        errs = [None] * self.size
+        outs = [None] * self.size
+
+        def wrap(r):
+            try:
+                outs[r] = fn(r)
+            except BaseException as exc:   # noqa: BLE001 - test harness
+                errs[r] = exc
+                self._bar.abort()
+        ts = [threading.Thread(target=wrap, args=(r,))
+              for r in range(self.size)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for e in errs:
+            if e is not None:
+                raise e
+        return outs
+
+
+# ---------------------------------------------------------------------------
+# shard split + tail satellites
+# ---------------------------------------------------------------------------
+def test_shard_of_deterministic_and_covering():
+    names = [f"seg{i:04d}.csv" for i in range(64)]
+    owners = [shard_of(n, 4) for n in names]
+    assert owners == [shard_of(n, 4) for n in names]    # stable
+    assert set(owners) == {0, 1, 2, 3}                  # every shard used
+    assert all(shard_of(n, 1) == 0 for n in names)
+
+
+def test_tail_hash_shard_consumes_only_own_segments(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    names = [_seg_name(i, i % 2) for i in range(4)]
+    for i, n in enumerate(names):
+        X, y = _xy(10, seed=i)
+        _write_segment(src, n, X, y)
+    t0 = DataTail(src, num_features=NF, shard_rank=0, num_shards=2)
+    t1 = DataTail(src, num_features=NF, shard_rank=1, num_shards=2)
+    got0 = [b.name for b in t0.poll()]
+    got1 = [b.name for b in t1.poll()]
+    assert sorted(got0 + got1) == sorted(names)
+    assert not set(got0) & set(got1)              # disjoint ownership
+    assert all(shard_of(n, 2) == 0 for n in got0)
+
+
+def test_tail_subdir_shard_layout(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(os.path.join(src, "0"))
+    os.makedirs(os.path.join(src, "1"))
+    X, y = _xy(10, seed=1)
+    _write_segment(os.path.join(src, "1"), "a.csv", X, y)
+    t1 = DataTail(src, num_features=NF, shard_rank=1, num_shards=2)
+    assert t1._subdir_layout and t1.source.endswith("/1")
+    assert [b.name for b in t1.poll()] == ["a.csv"]
+    t0 = DataTail(src, num_features=NF, shard_rank=0, num_shards=2)
+    assert t0.poll() == []
+
+
+def test_quarantine_rotation_bounds_disk(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    reg = MetricsRegistry()
+    tail = DataTail(src, num_features=NF, quarantine_path=qp,
+                    quarantine_max_bytes=400, registry=reg)
+    for i in range(30):
+        tail._quarantine([{"segment": "s", "row": i,
+                           "reason": "poison", "raw": "x" * 40}])
+    assert os.path.exists(qp + ".1")
+    assert tail.m_quarantine_rotated.value >= 1
+    # both files stay under ~2x the bound (current + one rotated)
+    assert os.path.getsize(qp) <= 400
+    assert os.path.getsize(qp + ".1") <= 400 + 120
+    # a restarted tail probes the existing size (file_io.filesize, an
+    # O(1) stat) instead of starting its byte counter at zero
+    tail2 = DataTail(src, num_features=NF, quarantine_path=qp,
+                     quarantine_max_bytes=400, registry=MetricsRegistry())
+    tail2._maybe_rotate_quarantine(0)
+    assert tail2._quarantine_bytes == os.path.getsize(qp)
+
+
+def test_unreadable_segment_backoff_then_quarantined_whole(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    os.makedirs(os.path.join(src, "bad.csv"))   # reads as a directory
+    reg = MetricsRegistry()
+    tail = DataTail(src, num_features=NF, quarantine_path=qp,
+                    retry_max=2, retry_backoff_s=0.0, registry=reg)
+    for _ in range(4):
+        tail.poll()
+    # 2 scheduled retries, then the whole segment quarantined + skipped
+    assert tail.m_segment_retries.value == 2
+    assert "bad.csv" in tail._seen
+    recs = [json.loads(l) for l in open(qp)]
+    assert recs[-1]["reason"] == "unreadable" and recs[-1]["row"] == -1
+    n_err = tail.m_segment_errors.value
+    tail.poll()
+    assert tail.m_segment_errors.value == n_err   # never read again
+
+
+def test_unreadable_backoff_delays_next_attempt(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    os.makedirs(os.path.join(src, "bad.csv"))
+    tail = DataTail(src, num_features=NF, retry_max=5,
+                    retry_backoff_s=60.0)
+    tail.poll()
+    n = tail.m_segment_errors.value
+    tail.poll()                                   # within backoff window
+    assert tail.m_segment_errors.value == n
+    assert tail._retry["bad.csv"][0] == 1
+
+
+# ---------------------------------------------------------------------------
+# drift consensus
+# ---------------------------------------------------------------------------
+def test_reduce_sketch_equals_single_process_over_concat():
+    nb = np.asarray([8, 8, 4], np.int64)
+    r = np.random.RandomState(0)
+    ref_a = r.randint(0, 8, size=(500, 3))
+    ref_b = r.randint(0, 8, size=(300, 3))
+    rec_a = r.randint(0, 8, size=(200, 3))
+    rec_b = r.randint(0, 4, size=(100, 3))       # shifted on rank b only
+    for m in (ref_a, ref_b, rec_a, rec_b):
+        m[:, 2] %= 4
+    # single-process oracle over the concatenated rows
+    oracle = DriftSketch(nb)
+    oracle.set_reference(np.concatenate([ref_a, ref_b]))
+    oracle.update(np.concatenate([rec_a, rec_b]))
+
+    fleet = ThreadFleet(2)
+
+    def rank_fn(rank):
+        sk = DriftSketch(nb)
+        sk.set_reference(ref_a if rank == 0 else ref_b)
+        sk.update(rec_a if rank == 0 else rec_b)
+        comm = fleet.comm(rank)
+        return reduce_sketch(sk, allreduce=comm.allreduce)
+
+    red0, red1 = fleet.run(rank_fn)
+    np.testing.assert_array_equal(red0.ref, oracle.ref)
+    np.testing.assert_array_equal(red0.recent, oracle.recent)
+    np.testing.assert_allclose(red0.scores(), oracle.scores())
+    np.testing.assert_allclose(red1.scores(), oracle.scores())
+    assert red0.ref_rows == oracle.ref_rows == 800
+    assert red0.recent_rows == oracle.recent_rows == 300
+
+
+def test_psum_blocks_device_reduction():
+    """The compiled psum-through-compat_shard_map reduction the fleet
+    consensus rides on a pod, exercised over the virtual device mesh."""
+    from lightgbm_tpu.parallel.mesh import psum_blocks
+    r = np.random.RandomState(1)
+    stacked = r.randint(0, 1000, size=(4, 37)).astype(np.int64)
+    out = psum_blocks(stacked)
+    np.testing.assert_array_equal(out, stacked.sum(axis=0))
+
+
+def test_sketch_state_roundtrip():
+    nb = np.asarray([4, 4], np.int64)
+    sk = DriftSketch(nb)
+    sk.set_reference(np.random.RandomState(0).randint(0, 4, (50, 2)))
+    sk.update(np.random.RandomState(1).randint(0, 4, (20, 2)))
+    sk2 = DriftSketch(nb)
+    sk2.load_state(sk.state_dict())
+    np.testing.assert_array_equal(sk2.ref, sk.ref)
+    np.testing.assert_array_equal(sk2.recent, sk.recent)
+    assert (sk2.ref_rows, sk2.recent_rows) == (sk.ref_rows,
+                                               sk.recent_rows)
+    with pytest.raises(ValueError):
+        DriftSketch(np.asarray([8, 8], np.int64)).load_state(
+            sk.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# mapper artifact
+# ---------------------------------------------------------------------------
+def test_mapper_artifact_roundtrip_and_bitflip(tmp_path):
+    from lightgbm_tpu.binning import find_bin_mappers
+    X, _ = _xy(200, seed=5)
+    mappers = find_bin_mappers(X, max_bin=15, min_data_in_bin=3)
+    d = str(tmp_path / "fleet")
+    digest = save_mapper_artifact(d, 1, mappers, {"note": "t"})
+    obj, digest2 = load_mapper_artifact(d, 1)
+    assert digest == digest2
+    assert len(obj["mappers"]) == NF
+    # corrupt one payload byte: verification must refuse BEFORE unpickle
+    path = os.path.join(d, "mapper_v00001.pkl")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(LightGBMError, match="sha256"):
+        load_mapper_artifact(d, 1)
+
+
+def test_fleet_mapper_consensus_two_ranks(tmp_path):
+    """Rank 0 constructs + publishes; rank 1 loads + verifies; both end
+    with the identical fingerprint and bin boundaries."""
+    fleet = ThreadFleet(2)
+    fleet_dir = str(tmp_path / "fleet")
+
+    def rank_fn(rank):
+        comm = fleet.comm(rank)
+        tr = ShardedContinuousTrainer(
+            dict(PARAMS), str(tmp_path / f"work{rank}"), comm,
+            fleet_dir=fleet_dir, rounds_per_cycle=2)
+        X, y = _xy(400, seed=rank)
+        mappers = tr._fleet_mappers(np.asarray(X))
+        return tr.artifact_digest, [m.num_bin for m in mappers]
+
+    (d0, nb0), (d1, nb1) = fleet.run(rank_fn)
+    assert d0 == d1 and nb0 == nb1
+    assert os.path.exists(os.path.join(fleet_dir, "mapper_v00001.pkl"))
+
+
+# ---------------------------------------------------------------------------
+# fault switch
+# ---------------------------------------------------------------------------
+def test_fault_cycle_spec_and_injection(monkeypatch):
+    from lightgbm_tpu.checkpoint.fault import (FAULT_ENV_VARS,
+                                               InjectedWorkerFault,
+                                               cycle_fault_spec,
+                                               maybe_inject_cycle_fault)
+    assert "LGBM_TPU_FAULT_CYCLE" in FAULT_ENV_VARS
+    assert cycle_fault_spec() is None
+    monkeypatch.setenv("LGBM_TPU_FAULT_CYCLE", "3")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    spec = cycle_fault_spec()
+    assert spec["cycle"] == 3 and spec["rank"] == 1
+    maybe_inject_cycle_fault(2, rank=1)       # wrong cycle: no-op
+    maybe_inject_cycle_fault(3, rank=0)       # wrong rank: no-op
+    with pytest.raises(InjectedWorkerFault):
+        maybe_inject_cycle_fault(3, rank=1)
+
+
+# ---------------------------------------------------------------------------
+# two-phase commit + replay (single-rank fleet: full machinery, no
+# cross-rank collectives — the 2-worker variant is the slow test below)
+# ---------------------------------------------------------------------------
+def _build_service(tmp, tag):
+    from lightgbm_tpu.serving.server import ServingApp
+    src = os.path.join(tmp, "src")
+    os.makedirs(src, exist_ok=True)
+    os.makedirs(os.path.join(tmp, "work"), exist_ok=True)
+    app = ServingApp()
+    trainer = ShardedContinuousTrainer(
+        dict(PARAMS), os.path.join(tmp, "work"), FleetComm(0, 1),
+        rounds_per_cycle=3)
+    gate = PublishGate(app.registry, tag, min_auc=0.55)
+    tail = DataTail(src, num_features=NF,
+                    quarantine_path=os.path.join(tmp, "work", "q.jsonl"))
+    svc = ShardedContinuousService(tail, trainer, gate, poll_s=0.0,
+                                   retry_backoff_s=0.0)
+    return src, app, svc
+
+
+def test_two_phase_replay_bit_identity(tmp_path, monkeypatch):
+    from lightgbm_tpu.checkpoint.fault import InjectedWorkerFault
+    # control: uninterrupted
+    tc = str(tmp_path / "control")
+    os.makedirs(tc)
+    src_c, _, svc_c = _build_service(tc, "c")
+    Xa, ya = _xy(300, seed=10)
+    Xb, yb = _xy(300, seed=11)
+    _write_segment(src_c, "seg000.csv", Xa, ya)
+    assert svc_c.step()["decision"]["action"] == "publish"
+    _write_segment(src_c, "seg001.csv", Xb, yb)
+    assert svc_c.step()["decision"]["action"] == "publish"
+    control_model = svc_c.trainer.model_str
+
+    # faulted: die at cycle 1 after the poll, before the commit
+    tf = str(tmp_path / "fault")
+    os.makedirs(tf)
+    src_f, _, svc_f = _build_service(tf, "f")
+    _write_segment(src_f, "seg000.csv", Xa, ya)
+    assert svc_f.step()["decision"]["action"] == "publish"
+    _write_segment(src_f, "seg001.csv", Xb, yb)
+    monkeypatch.setenv("LGBM_TPU_FAULT_CYCLE", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        svc_f.step()
+    monkeypatch.delenv("LGBM_TPU_FAULT_CYCLE")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+
+    # relaunch: fresh objects over the same workdir + source
+    src_f2, app2, svc_f2 = _build_service(tf, "f")
+    rec = svc_f2.recovered_from
+    assert rec["committed_cycle"] == 0 and rec["inflight_segments"] == 1
+    # serving resumed from the committed model before any cycle ran
+    assert app2.registry.current_version("f") == 1
+    s1 = svc_f2.step()
+    assert s1["replayed"] and s1["segments"] == ["seg001.csv"]
+    assert s1["decision"]["action"] == "publish"
+    assert svc_f2.trainer.model_str == control_model   # BIT-identical
+    # exactly-once: the journal holds each segment once
+    segs = [s for e in svc_f2._read_journal() for s in e["segments"]]
+    assert sorted(segs) == ["seg000.csv", "seg001.csv"]
+
+
+def test_recovery_without_commit_record_replays_everything(tmp_path):
+    """Crash before any commit: every journaled segment is in-flight and
+    cycle 0 re-runs on exactly the prepared data."""
+    from lightgbm_tpu.checkpoint.fault import InjectedWorkerFault
+    t = str(tmp_path / "t")
+    os.makedirs(t)
+    src, _, svc = _build_service(t, "m")
+    X, y = _xy(200, seed=1)
+    _write_segment(src, "seg000.csv", X, y)
+    os.environ["LGBM_TPU_FAULT_CYCLE"] = "0"
+    os.environ["LGBM_TPU_FAULT_MODE"] = "raise"
+    try:
+        with pytest.raises(InjectedWorkerFault):
+            svc.step()
+    finally:
+        os.environ.pop("LGBM_TPU_FAULT_CYCLE", None)
+        os.environ.pop("LGBM_TPU_FAULT_MODE", None)
+    _, _, svc2 = _build_service(t, "m")
+    assert svc2.recovered_from["committed_cycle"] == -1
+    assert svc2.recovered_from["inflight_segments"] == 1
+    s = svc2.step()
+    assert s["replayed"] and s["trained"]
+    assert s["decision"]["action"] == "publish"
+
+
+# ---------------------------------------------------------------------------
+# in-process 2-rank fleet: identical models + consensus re-bin
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_fleet_two_ranks_identical_models_and_consensus_rebin(tmp_path):
+    from lightgbm_tpu.serving.server import ServingApp
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    fleet_dir = str(tmp_path / "fleet")
+    fleet = ThreadFleet(2)
+    svcs = [None, None]
+
+    def build(rank):
+        app = ServingApp()
+        tr = ShardedContinuousTrainer(
+            dict(PARAMS), str(tmp_path / f"work{rank}"), fleet.comm(rank),
+            fleet_dir=fleet_dir, rounds_per_cycle=3,
+            rebin_policy="drift")
+        gate = PublishGate(app.registry, "m", min_auc=0.55)
+        tail = DataTail(src, num_features=NF, shard_rank=rank,
+                        num_shards=2)
+        svcs[rank] = ShardedContinuousService(tail, tr, gate, poll_s=0.0)
+
+    fleet.run(build)
+    Xa, ya = _xy(300, seed=10)
+    Xb, yb = _xy(300, seed=11)
+    _write_segment(src, _seg_name(0, 0), Xa, ya)
+    _write_segment(src, _seg_name(1, 1), Xb, yb)
+    r0 = fleet.run(lambda r: svcs[r].step())
+    assert all(s["trained"] for s in r0)
+    assert svcs[0].trainer.model_str == svcs[1].trainer.model_str
+    assert r0[0]["segments"] != r0[1]["segments"]     # disjoint shards
+
+    # drift lands on rank 0's shard ONLY; the decision is fleet-wide
+    for i in range(2, 5):
+        Xd, yd = _xy(500, seed=100 + i, shift=3.0)
+        _write_segment(src, _seg_name(i, 0), Xd, yd)
+    fleet.run(lambda r: svcs[r].step())
+    n0 = len(svcs[0].trainer.rebin_events)
+    n1 = len(svcs[1].trainer.rebin_events)
+    assert n0 == n1 == 1, (n0, n1)        # exactly one fleet-wide re-bin
+    assert svcs[0].trainer.artifact_version == \
+        svcs[1].trainer.artifact_version == 2
+    assert svcs[0].trainer.model_str == svcs[1].trainer.model_str
+
+
+# ---------------------------------------------------------------------------
+# rank-local packed bins (quantized engine satellite)
+# ---------------------------------------------------------------------------
+def test_rank_local_packed_device_bins_trains_and_matches():
+    X, y = _xy(1200, seed=0)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 10, "tree_learner": "data",
+              "num_machines": 2, "num_tpu_devices": 8, "max_bin": 15,
+              "quantized_histograms": True, "histogram_impl": "onehot"}
+    # rank-local loading (pre_partition single process: the whole data
+    # is the one shard) previously raised the PR 10 placeholder error
+    b_local = lgb.train(dict(params, pre_partition=True),
+                        lgb.Dataset(X, y), num_boost_round=3)
+    b_global = lgb.train(dict(params), lgb.Dataset(X, y),
+                         num_boost_round=3)
+    assert b_local.model_to_string() == b_global.model_to_string()
+
+
+def test_packed_device_bins_refuses_freed_dataset():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Metadata, TrainDataset
+    from lightgbm_tpu.ops.histogram import plan_packed_classes
+    X, y = _xy(300, seed=2)
+    ds = TrainDataset(X, Metadata(np.asarray(y)),
+                      Config({"max_bin": 15, "enable_bundle": False}))
+    plan = plan_packed_classes(ds.device_col_num_bins, ds.max_num_bins)
+    assert plan is not None
+    ds.packed_device_bins(plan)               # works while matrices live
+    ds.bins = None
+    ds.device_bins = None                     # freed
+    with pytest.raises(LightGBMError, match="device-space matrix"):
+        ds.packed_device_bins(plan)
+
+
+# ---------------------------------------------------------------------------
+# static guard: continuous/ IO goes through the scheme registry
+# ---------------------------------------------------------------------------
+def test_continuous_package_uses_io_scheme_registry_only():
+    """No module under lightgbm_tpu/continuous/ may touch the filesystem
+    directly: every read of continuous_dir/continuous_source must ride
+    the io scheme registry (file_io) so chaosio:// fault injection and
+    remote backends cover the whole pipeline."""
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu", "continuous")
+    forbidden = re.compile(
+        r"(?<![\w.])open\(|os\.(path|listdir|makedirs|remove|rename|"
+        r"replace|scandir|walk|stat|getsize)\b|shutil\.|\bglob\.")
+    offenders = []
+    for fn in sorted(os.listdir(pkg)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(pkg, fn)) as fh:
+            for i, line in enumerate(fh, 1):
+                code = line.split("#", 1)[0]
+                if forbidden.search(code):
+                    offenders.append(f"{fn}:{i}: {line.strip()}")
+    assert not offenders, (
+        "direct filesystem access in lightgbm_tpu/continuous/ (use "
+        "io.file_io):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2 worker PROCESSES, kill rank 1 mid-cycle, supervised
+# relaunch, byte-equal to an uninterrupted control fleet
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_worker_fleet_chaos_bit_identity(tmp_path):
+    from lightgbm_tpu.cluster import continuous_distributed
+
+    def run_fleet(root, fault_env):
+        src = os.path.join(root, "src")
+        work = os.path.join(root, "work")
+        logs = os.path.join(root, "logs")
+        os.makedirs(src)
+        os.makedirs(work)
+        Xa, ya = _xy(300, seed=10)
+        Xb, yb = _xy(300, seed=11)
+        Xc, yc = _xy(300, seed=12)
+        _write_segment(src, _seg_name(0, 0), Xa, ya)
+        _write_segment(src, _seg_name(1, 1), Xb, yb)
+        _write_segment(src, _seg_name(2, 1), Xc, yc)
+        params = dict(PARAMS)
+        params.update({
+            "continuous_source": src, "continuous_dir": work,
+            "continuous_rounds": 3, "continuous_poll_s": 0.2,
+            "continuous_min_auc": 0.55,
+            "continuous_max_idle_polls": 3,
+            "continuous_max_cycles": 2,
+        })
+        old = {k: os.environ.get(k) for k in fault_env}
+        os.environ.update(fault_env)
+        try:
+            bst = continuous_distributed(params, num_workers=2,
+                                         platform="cpu", timeout=420,
+                                         log_dir=logs)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        assert bst is not None
+        state = json.load(open(os.path.join(work, "fleet",
+                                            "commit_state.json")))
+        model = open(state["model_file"]).read()
+        journal = []
+        for r in range(2):
+            jp = os.path.join(work, "fleet", f"journal_rank{r}.jsonl")
+            if os.path.exists(jp):
+                journal += [json.loads(l) for l in open(jp) if l.strip()]
+        return model, state, journal, logs
+
+    control_model, cstate, _, _ = run_fleet(str(tmp_path / "control"), {})
+    # rank 1 is KILLED (os._exit) mid-cycle-0: after polling its shard
+    # and journaling the prepare, before the commit record exists
+    chaos_model, state, journal, logs = run_fleet(
+        str(tmp_path / "chaos"),
+        {"LGBM_TPU_FAULT_CYCLE": "0", "LGBM_TPU_FAULT_RANK": "1",
+         "LGBM_TPU_FAULT_MODE": "exit"})
+    # the kill really fired, and the supervisor really relaunched
+    log1 = open(os.path.join(logs, "worker_1_a0.log")).read()
+    assert "LGBM_TPU_FAULT: killing rank 1 at continuous cycle 0" in log1
+    assert os.path.exists(os.path.join(logs, "worker_0_a1.log"))
+    # byte-equal final model across a real mid-cycle worker kill
+    assert chaos_model == control_model
+    assert state["cycle"] == cstate["cycle"] \
+        and state["decision"] == "publish"
+    # ingest-position replay: every journaled segment consumed once
+    segs = [s for e in journal for s in e["segments"]]
+    assert len(segs) == len(set(segs)), segs
