@@ -82,6 +82,29 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  the claims are topology claims.  Knobs:
                  BENCH_FLEET_{REPLICAS,MODELS,THREADS,SECONDS,TREES,
                  TRAIN_ROWS,MAX_REQ_ROWS,FAULT_REQUEST}.
+- fleet_gray     gray-failure soak (run_fleet_gray): two replica
+                 PROCESSES behind an in-process router, with the gray
+                 replica's endpoint wrapped in chaosnet (ChaosReplica,
+                 lightgbm_tpu/fleet/chaosnet.py).  Four phases: (A)
+                 no-fault baseline p99 on the HARDENED router; (B) one
+                 replica at 20x injected data-path latency (health polls
+                 stay clean — the gray failure) through the UN-HARDENED
+                 router (hedging/breaker/retry-budget/latency-routing
+                 off), which must FAIL the p99 <= 2x baseline bound for
+                 contrast; (C) the same fault through the hardened
+                 router — deadline-carrying clients, hedges, latency-
+                 weight drain, plus a black-hole burst that walks the
+                 gray replica's breaker closed->open->half_open->closed
+                 (calm at 60%) — bars: ZERO failed requests, p99 <= 2x
+                 baseline, full breaker walk observed; (D) an overload
+                 storm (more client threads than capacity, tight
+                 deadlines) — bars: retry amplification <= 1.1x (the
+                 10% retry budget), failures are ONLY 503/504
+                 (budgeted refusals, no transport errors escape), and
+                 replica deadline-admission refusals > 0 (device time
+                 never spent on doomed work).  CPU by design: topology
+                 claims.  Knobs: BENCH_GRAY_{THREADS,SECONDS,TREES,
+                 TRAIN_ROWS,STORM_THREADS,STORM_SECONDS,FACTOR}.
 - continuous     train→serve chaos soak (run_continuous): one in-process
                  continuous-boosting service (lightgbm_tpu/continuous/)
                  with ALL persistence on the chaosio:// fault injector,
@@ -913,6 +936,331 @@ def run_fleet():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_fleet_gray():
+    """Child body for BENCH_STAGE=fleet_gray: the gray-failure soak.
+
+    One replica is made GRAY — alive, passing every health poll,
+    answering predicts at 20x latency (chaosnet wraps its endpoint at
+    the router side, health untouched) — and the hardened router must
+    hold the fleet's p99 within 2x of no-fault with zero failed
+    requests, while the un-hardened router demonstrably cannot.  A
+    black-hole burst walks the gray replica's circuit breaker through
+    its full closed -> open -> half_open -> closed cycle, and an
+    overload storm proves the retry budget caps amplification at
+    honest, budgeted 503s/504s."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.fleet import (ChaosReplica, FleetRouter,
+                                    FleetSupervisor, HttpReplica, SLOPolicy,
+                                    default_replica_argv)
+    from lightgbm_tpu.fleet.breaker import RetryBudget
+
+    # 3 concurrent clients: enough to exercise routing/hedging, low
+    # enough that this 2-CPU box keeps queueing headroom — the p99 bars
+    # compare fleet BEHAVIOR, and a box saturated by its own load
+    # generator measures scheduler contention, not the gray drain
+    n_threads = int(os.environ.get("BENCH_GRAY_THREADS", 3))
+    rounds = int(os.environ.get("BENCH_GRAY_TREES", 20))
+    train_rows = int(os.environ.get("BENCH_GRAY_TRAIN_ROWS", 10_000))
+    phase_s = float(os.environ.get("BENCH_GRAY_SECONDS", 8.0))
+    storm_threads = int(os.environ.get("BENCH_GRAY_STORM_THREADS", 12))
+    storm_s = float(os.environ.get("BENCH_GRAY_STORM_SECONDS", 8.0))
+    gray_factor = float(os.environ.get("BENCH_GRAY_FACTOR", 20.0))
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_gray_")
+    params = {"objective": "binary", "num_leaves": 63, "learning_rate": 0.1,
+              "verbosity": -1, "max_bin": MAX_BIN, "min_data_in_leaf": 20}
+    X, y = synth_binary(train_rows, seed=3)
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+    model_path = os.path.join(tmp, "model.txt")
+    bst.save_model(model_path)
+    pred = bst.to_compiled()
+    pred.warmup()
+    bundle = os.path.join(tmp, "bundle")
+    pred.save_bundle(bundle)
+
+    ports = find_open_ports(2)
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(
+            {"input_model": model_path, "aot_bundle_dir": bundle,
+             "serving_max_wait_ms": "2", "verbosity": "-1",
+             # small enough that the storm's offered load genuinely
+             # backs the queue up (429s + deadline admission refusals)
+             "serving_max_queue_rows": "1024",
+             "serving_max_batch": "256"}, port),
+        ports, log_dir=os.path.join(tmp, "logs"),
+        max_restarts=2, restart_backoff_s=0.5)
+
+    pool = np.random.RandomState(1).randn(4096, N_FEATURES).astype(np.float64)
+
+    def drive(router, seconds, seed0, threads, max_rows=8,
+              deadline_ms=None):
+        """Concurrent clients; returns (statuses Counter-ish dict,
+        latencies list seconds, rows_ok)."""
+        stop = time.time() + seconds
+        lat = [[] for _ in range(threads)]
+        stat = [{} for _ in range(threads)]
+        rows_ok = [0] * threads
+
+        def client(i):
+            r = np.random.RandomState(seed0 + i)
+            while time.time() < stop:
+                n = int(r.randint(1, max_rows + 1))
+                lo = int(r.randint(0, pool.shape[0] - n))
+                body = {"rows": pool[lo:lo + n].tolist()}
+                if deadline_ms is not None:
+                    body["deadline_ms"] = deadline_ms
+                t0 = time.perf_counter()
+                status, _ = router.handle(
+                    "POST", "/v1/models/default:predict", body)
+                lat[i].append(time.perf_counter() - t0)
+                stat[i][status] = stat[i].get(status, 0) + 1
+                if status == 200:
+                    rows_ok[i] += n
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(seconds + 120)
+        statuses: dict = {}
+        for s in stat:
+            for k, v in s.items():
+                statuses[k] = statuses.get(k, 0) + v
+        all_lat = sorted(x for part in lat for x in part)
+        return statuses, all_lat, sum(rows_ok)
+
+    def p99_ms(lat):
+        if not lat:
+            return 0.0
+        return lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+
+    hardened = dict(policy=SLOPolicy(recover_polls=1), poll_interval_ms=50)
+    unhardened = dict(policy=SLOPolicy(recover_polls=1),
+                      poll_interval_ms=50, hedge_quantile=0.0,
+                      retry_budget_pct=0.0, breaker_failures=0,
+                      latency_routing=False)
+    result = {}
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=min(
+            180.0, max(deadline - time.time() - 90.0, 30.0)))
+        sup.start_watching(interval_s=0.2)
+        setup_s = time.time() - t_start
+        urls = sup.urls
+
+        def endpoints():
+            """Fresh endpoints per phase: replica 0 wrapped in chaosnet
+            (the gray one), replica 1 plain."""
+            gray = ChaosReplica(HttpReplica(urls[0]))
+            return gray, [gray, HttpReplica(urls[1])]
+
+        # --- phase A: no-fault baseline on the hardened router -------
+        gray, eps = endpoints()
+        with FleetRouter(eps, **hardened) as r:
+            drive(r, 1.5, 90, n_threads)    # warm conns/paths, discard
+            stat_a, lat_a, _ = drive(r, phase_s, 100, n_threads)
+        base_p50_ms = (lat_a[len(lat_a) // 2] * 1e3) if lat_a else 25.0
+        base_p99 = p99_ms(lat_a)
+        # 20x the healthy median is the injected gray latency, bounded
+        # so one request never outlives a phase
+        gray_latency_s = min(max(gray_factor * base_p50_ms / 1e3, 0.15),
+                             2.0)
+
+        # --- phase B: gray replica, UN-hardened router (contrast) -----
+        gray, eps = endpoints()
+        gray.add_latency(gray_latency_s)
+        with FleetRouter(eps, **unhardened) as r:
+            stat_b, lat_b, _ = drive(r, phase_s, 200, n_threads)
+        unhard_p99 = p99_ms(lat_b)
+        unhard_failed = sum(v for k, v in stat_b.items() if k != 200)
+
+        # --- phase C1: gray replica at 20x, HARDENED router -----------
+        # the headline phase: latency armed the whole time, deadline-
+        # carrying clients, zero failures and p99 <= 2x baseline via
+        # latency-weight drain + hedging
+        gray, eps = endpoints()
+        gray.add_latency(gray_latency_s)
+        with FleetRouter(eps, **hardened) as r:
+            # unmeasured discovery: the router's first picks of the gray
+            # replica pay full gray latency until its digest crosses
+            # min_samples — that is the (bounded, one-off) cost of
+            # learning, excluded from the steady-state p99 claim
+            drive(r, 2.0, 290, n_threads, deadline_ms=8000.0)
+            stat_c, lat_c, rows_c = drive(
+                r, phase_s + 2.0, 300, n_threads, deadline_ms=8000.0)
+            hard_p99 = p99_ms(lat_c)
+            hard_failed = sum(v for k, v in stat_c.items() if k != 200)
+            csnap = r.registry.snapshot()
+            hedges = int(csnap["lgbm_fleet_hedges_total"]["_"])
+            hedge_wins = int(csnap["lgbm_fleet_hedge_wins_total"]["_"])
+            hedge_denied = int(csnap["lgbm_fleet_hedge_denied_total"]["_"])
+            c_requests = int(csnap["lgbm_fleet_requests_total"]["_"])
+            c_reroutes = int(csnap["lgbm_fleet_reroutes_total"]["_"])
+            gray_counters = dict(gray.counters)
+
+        # --- phase C2: breaker walk (fresh router, black-hole burst) --
+        # a burst of holes on a FRESH router (neutral weights, so the
+        # gray replica still takes traffic): consecutive timeout-
+        # failures walk the breaker open — MORE holes than the failure
+        # threshold, because in-flight latency successes completing
+        # between hole failures reset the streak; residual holes may
+        # bounce a half-open probe back to open (the walk check allows
+        # bounces).  After calm() the probes meet a healthy data path,
+        # succeed, and close the breaker — the full cycle
+        gray, eps = endpoints()
+        gray.add_latency(gray_latency_s)
+        gray.black_hole(12, cap_s=0.3)
+        with FleetRouter(eps, **hardened) as r:
+            stat_w1, _, _ = drive(r, 6.0, 350, n_threads,
+                                  deadline_ms=8000.0)
+            gray.calm()
+            stat_w2, _, _ = drive(r, 3.0, 360, n_threads,
+                                  deadline_ms=8000.0)
+            walk_failed = sum(v for k, v in
+                              list(stat_w1.items()) + list(stat_w2.items())
+                              if k != 200)
+            breaker_walk = [(f, t) for (_, f, t)
+                            in r._replicas[0].breaker.history]
+            walk_counters = dict(gray.counters)
+
+        def _walked(history):
+            """closed->open, open->half_open, half_open->closed appear
+            in order (bounces from residual faults allowed)."""
+            want = [("closed", "open"), ("open", "half_open"),
+                    ("half_open", "closed")]
+            i = 0
+            for step in history:
+                if i < len(want) and tuple(step) == want[i]:
+                    i += 1
+            return i == len(want)
+
+        # --- phase D: overload storm, hardened + tight deadlines ------
+        # the gray replica stays gray: half the fleet's capacity is
+        # crawling while more clients than the box can serve demand
+        # answers within a few healthy-p50s — the budget, not a retry
+        # storm, must decide who gets an honest refusal
+        gray, eps = endpoints()
+        gray.add_latency(gray_latency_s)
+        storm_deadline_ms = max(3.0 * base_p50_ms, 60.0)
+        with FleetRouter(eps, **hardened) as r:
+            # a small initial float so amplification stays budget-bound
+            # even against the storm's short request count
+            r.retry_budget = RetryBudget(ratio=0.10, initial=2.0)
+            stat_d, lat_d, _ = drive(
+                r, storm_s, 400, storm_threads, max_rows=512,
+                deadline_ms=storm_deadline_ms)
+            dsnap = r.registry.snapshot()
+            d_requests = int(dsnap["lgbm_fleet_requests_total"]["_"])
+            d_retry_spent = r.retry_budget.spent
+            d_retry_denied = int(
+                dsnap["lgbm_fleet_retry_budget_exhausted_total"]["_"])
+            d_shed = int(dsnap["lgbm_fleet_shed_total"]["_"])
+            d_router_deadline = int(
+                dsnap["lgbm_fleet_deadline_refused_total"]["_"])
+        storm_failed = {k: v for k, v in stat_d.items() if k != 200}
+        storm_other = sum(v for k, v in storm_failed.items()
+                          if k not in (503, 504))
+        amplification = (1.0 + d_retry_spent / d_requests
+                         if d_requests else 1.0)
+
+        # replica-side admission refusals (the acceptance counter):
+        # device time was never spent on these
+        admission_refused = 0
+        queue_wait_p50 = 0.0
+        for u in urls:
+            try:
+                _, metrics = HttpReplica(u).request("GET", "/v1/metrics")
+                for m in metrics.values():
+                    if isinstance(m, dict):
+                        admission_refused += m.get("deadline_refused", 0)
+                        queue_wait_p50 = max(queue_wait_p50,
+                                             m.get("queue_wait_p50_ms", 0.0))
+            except Exception:
+                pass
+
+        result = {
+            "metric": f"fleet_gray_2replicas_{rounds}trees_"
+                      f"{n_threads}threads",
+            "value": round(hard_p99, 1),
+            "unit": "ms_p99_under_gray_fault",
+            # the headline bar: hardened p99 under a 20x-latency gray
+            # replica over the no-fault fleet p99 (<= 2.0 passes)
+            "vs_baseline": (round(hard_p99 / base_p99, 3)
+                            if base_p99 else None),
+            "p99_nofault_ms": round(base_p99, 1),
+            "p50_nofault_ms": round(base_p50_ms, 1),
+            "gray_latency_injected_ms": round(gray_latency_s * 1e3, 1),
+            "unhardened": {
+                "p99_ms": round(unhard_p99, 1),
+                "ratio_vs_nofault": (round(unhard_p99 / base_p99, 3)
+                                     if base_p99 else None),
+                "fails_2x_bound": bool(base_p99
+                                       and unhard_p99 > 2.0 * base_p99),
+                "failed_requests": unhard_failed,
+            },
+            "hardened": {
+                "p99_ms": round(hard_p99, 1),
+                "within_2x_bound": bool(base_p99
+                                        and hard_p99 <= 2.0 * base_p99),
+                "failed_requests": hard_failed,
+                "requests": c_requests,
+                "rows_served": rows_c,
+                "reroutes": c_reroutes,
+                "hedges": hedges,
+                "hedge_wins": hedge_wins,
+                "hedge_denied": hedge_denied,
+                "hedge_fraction": (round(hedges / c_requests, 4)
+                                   if c_requests else 0.0),
+                "chaos_counters": gray_counters,
+            },
+            "breaker_walk": {
+                "history": breaker_walk,
+                "full_cycle": _walked(breaker_walk),
+                "failed_requests": walk_failed,
+                "chaos_counters": walk_counters,
+            },
+            "storm": {
+                "requests": d_requests,
+                "deadline_ms": round(storm_deadline_ms, 1),
+                "retry_amplification": round(amplification, 4),
+                "retry_budget_spent": d_retry_spent,
+                "retry_budget_503s": d_retry_denied,
+                "shed_503s": d_shed,
+                "router_deadline_504s": d_router_deadline,
+                "failed_by_status": {str(k): v
+                                     for k, v in storm_failed.items()},
+                "non_budgeted_failures": storm_other,
+            },
+            "replica_admission_refusals": admission_refused,
+            "replica_queue_wait_p50_ms": round(queue_wait_p50, 2),
+            "setup_s": round(setup_s, 1),
+            "backend": backend,
+        }
+    finally:
+        try:
+            sup.stop_all()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def _continuous_incremental_phase(params, tmp):
     """Growing-pool probe for the incremental dataset pipeline (ISSUE 10):
     N stationary cycles, each ingesting one fresh segment into the
@@ -1711,6 +2059,8 @@ if __name__ == "__main__":
             run_hist()
         elif stage == "fleet":
             run_fleet()
+        elif stage == "fleet_gray":
+            run_fleet_gray()
         elif stage == "continuous":
             run_continuous()
         elif stage == "continuous_sharded":

@@ -236,7 +236,8 @@ class Application:
         app = ServingApp(max_batch=cfg.serving_max_batch,
                          max_wait_ms=cfg.serving_max_wait_ms,
                          max_queue_rows=cfg.serving_max_queue_rows,
-                         continuous=bool(cfg.serving_continuous_batching))
+                         continuous=bool(cfg.serving_continuous_batching),
+                         default_deadline_ms=cfg.serving_default_deadline_ms)
         models = [m for m in str(cfg.input_model).split(",") if m]
         names = [n for n in str(cfg.serving_model_name).split(",") if n]
         if len(names) > len(models):
@@ -330,7 +331,8 @@ class Application:
         app = ServingApp(max_batch=cfg.serving_max_batch,
                          max_wait_ms=cfg.serving_max_wait_ms,
                          max_queue_rows=cfg.serving_max_queue_rows,
-                         continuous=bool(cfg.serving_continuous_batching))
+                         continuous=bool(cfg.serving_continuous_batching),
+                         default_deadline_ms=cfg.serving_default_deadline_ms)
         name = str(cfg.serving_model_name).split(",")[0] or "default"
         bundle = cfg.aot_bundle_dir or None
         shards = int(cfg.continuous_shards or 0)

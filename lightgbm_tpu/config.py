@@ -214,16 +214,33 @@ _PARAMS: List[ParamSpec] = [
     _p("output_result", str, "LightGBM_predict_result.txt",
        ("predict_result", "prediction_result", "predict_name", "pred_name", "name_pred")),
     # ---- Serving (task=serve; lightgbm_tpu/serving/) ----
-    _p("serving_host", str, "127.0.0.1"),
-    _p("serving_port", int, 8080, (), ">=0"),
-    _p("serving_model_name", str, "default", ("model_name",)),
-    _p("serving_max_batch", int, 1024, ("max_batch",), ">0"),
-    _p("serving_max_wait_ms", float, 2.0, ("max_wait_ms",), ">=0"),
-    _p("serving_max_queue_rows", int, 16384, ("max_queue_rows",), ">0"),
+    _p("serving_host", str, "127.0.0.1", (),
+       desc="interface the HTTP inference server binds"),
+    _p("serving_port", int, 8080, (), ">=0",
+       "port the HTTP inference server (or fleet router) listens on"),
+    _p("serving_model_name", str, "default", ("model_name",),
+       desc="registry name(s) the input_model file(s) publish under "
+            "(comma list for multi-model replicas)"),
+    _p("serving_max_batch", int, 1024, ("max_batch",), ">0",
+       "micro-batcher flush bound: coalesce at most this many rows into "
+       "one device batch"),
+    _p("serving_max_wait_ms", float, 2.0, ("max_wait_ms",), ">=0",
+       "micro-batcher coalescing window: how long the oldest queued "
+       "request may wait for ride-alongs before its batch launches"),
+    _p("serving_max_queue_rows", int, 16384, ("max_queue_rows",), ">0",
+       "micro-batcher backpressure bound: requests beyond this many "
+       "queued rows are rejected 429 instead of growing the queue"),
     _p("serving_continuous_batching", bool, True, ("continuous_batching",),
        desc="admit requests into the next in-flight padded batch while "
             "the device is busy (launch the moment it frees) instead of "
             "flush-and-wait; bit-identical results, same bucket ladder"),
+    _p("serving_default_deadline_ms", float, 0.0, (), ">=0",
+       "deadline budget applied to predict requests whose body carries "
+       "no deadline_ms: queue time counts against it and the "
+       "micro-batcher refuses 504 at admission (or drops at batch take) "
+       "work that cannot finish in time, before any device dispatch "
+       "(lgbm_serving_deadline_refused_total).  0 = no default; "
+       "requests wait as long as they must"),
     # ---- Fleet serving (task=serve + fleet_*; lightgbm_tpu/fleet/) ----
     _p("fleet_role", str, "", (), "in:|replica|router",
        "task=serve role: empty = single server (or full fleet launch "
@@ -260,6 +277,54 @@ _PARAMS: List[ParamSpec] = [
     _p("fleet_restart_backoff_s", float, 0.5, (), ">=0",
        "base backoff before relaunching a dead replica (doubles per "
        "restart)"),
+    _p("fleet_deadline_ms", float, 0.0, (), ">=0",
+       "deadline budget the router stamps on predicts that carry no "
+       "deadline_ms of their own: expired requests are refused 504 at "
+       "the router, per-hop HTTP read timeouts derive from the "
+       "remaining budget, and each replica receives what is left so "
+       "its admission check can refuse in time (0 = none)"),
+    _p("fleet_hedge_quantile", float, 0.95, (), ">=0",
+       "hedged requests: when a forwarded predict outlives this "
+       "quantile of the target replica's own recent data-path "
+       "latencies, duplicate it to the next-best replica and take the "
+       "first answer (0 = hedging off; a replica without enough recent "
+       "latency evidence is never hedged against)"),
+    _p("fleet_hedge_min_ms", float, 20.0, (), ">=0",
+       "floor for the hedge delay, so a very fast replica's quantile "
+       "cannot make the router duplicate near-every request"),
+    _p("fleet_hedge_budget_pct", float, 5.0, (), ">=0",
+       "hedge budget: hedged duplicates may add at most this percent "
+       "of request volume as extra load (volume-coupled token bucket; "
+       "denials count lgbm_fleet_hedge_denied_total)"),
+    _p("fleet_retry_budget_pct", float, 10.0, (), ">=0",
+       "adaptive retry budget shared by reroutes AND hedges: every "
+       "request deposits this percent of a token, every extra attempt "
+       "spends one, so a fleet-wide brownout degrades to honest 503s "
+       "(lgbm_fleet_retry_budget_exhausted_total) at bounded "
+       "amplification instead of a retry storm (0 = unlimited retries, "
+       "the pre-hardening behavior)"),
+    _p("fleet_breaker_failures", int, 5, (), ">=0",
+       "per-replica circuit breaker: consecutive data-path failures "
+       "that open it — an open replica gets no traffic until a "
+       "cooldown probe succeeds (0 = breakers off).  Failures are "
+       "connection failures, timeouts under a >=1s allowance, and "
+       "5xx answers other than 504; deadline verdicts (504, "
+       "deadline-squeezed timeouts) and queue-full 429s reroute but "
+       "are breaker-NEUTRAL, so a storm of impatient clients cannot "
+       "breaker-open the whole fleet into a full outage"),
+    _p("fleet_breaker_cooldown_s", float, 2.0, (), ">=0",
+       "how long an open breaker blocks all traffic before moving to "
+       "half-open and admitting probe requests"),
+    _p("fleet_breaker_probes", int, 2, (), ">0",
+       "half-open trial requests: all succeeding closes the breaker, "
+       "any failing re-opens it for another cooldown"),
+    _p("fleet_latency_routing", bool, True, (),
+       desc="scale each replica's routing score by a continuous latency "
+            "weight (router-observed windowed p50 + the replica's "
+            "reported queue wait, relative to the fleet's best) so a "
+            "slow-but-alive gray replica is organically drained and — "
+            "once its stale evidence ages out — re-admitted for a "
+            "probe; off restores pure least-loaded ranking"),
     # ---- Continuous boosting service (task=continuous;
     # lightgbm_tpu/continuous/) ----
     _p("continuous_source", str, "",
@@ -593,6 +658,12 @@ class Config:
             self.label_gain = [float((1 << min(i, 30)) - 1) for i in range(31)]
         if self.is_unbalance and self.scale_pos_weight != 1.0:
             raise ValueError("cannot set both is_unbalance and scale_pos_weight")
+        if not 0.0 <= self.fleet_hedge_quantile <= 1.0:
+            # 95 almost certainly meant the 95th percentile; silently
+            # clamping would disable hedging (delay = slowest sample)
+            raise ValueError(
+                f"fleet_hedge_quantile={self.fleet_hedge_quantile} must "
+                "be in [0, 1] (a fraction, e.g. 0.95 — not a percent)")
         if self.monotone_constraints_method == "advanced":
             # the reference's AdvancedLeafConstraints is not implemented; it
             # silently aliasing the intermediate path was VERDICT weak #7 —

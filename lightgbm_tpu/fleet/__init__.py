@@ -26,13 +26,16 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .breaker import CircuitBreaker, LatencyDigest, RetryBudget
+from .chaosnet import ChaosReplica
 from .router import FleetRouter, HttpReplica, ReplicaTransportError
 from .slo import DOWN, HEALTHY, SHED, ReplicaSLO, SLOPolicy
 from .supervisor import FleetSupervisor, default_replica_argv
 
 __all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError",
            "SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN",
-           "FleetSupervisor", "default_replica_argv",
+           "CircuitBreaker", "LatencyDigest", "RetryBudget",
+           "ChaosReplica", "FleetSupervisor", "default_replica_argv",
            "policy_from_config", "serve_fleet", "serve_router"]
 
 
@@ -43,10 +46,21 @@ def policy_from_config(config) -> SLOPolicy:
                      recover_polls=config.fleet_recover_polls)
 
 
-def _make_router(config, urls) -> FleetRouter:
+def _make_router(config, urls, registry=None, supervisor=None) -> FleetRouter:
     return FleetRouter([HttpReplica(u) for u in urls],
                        policy=policy_from_config(config),
-                       poll_interval_ms=config.fleet_poll_ms)
+                       poll_interval_ms=config.fleet_poll_ms,
+                       registry=registry,
+                       supervisor=supervisor,
+                       hedge_quantile=config.fleet_hedge_quantile,
+                       hedge_min_ms=config.fleet_hedge_min_ms,
+                       hedge_budget_pct=config.fleet_hedge_budget_pct,
+                       retry_budget_pct=config.fleet_retry_budget_pct,
+                       breaker_failures=config.fleet_breaker_failures,
+                       breaker_cooldown_s=config.fleet_breaker_cooldown_s,
+                       breaker_probes=config.fleet_breaker_probes,
+                       latency_routing=bool(config.fleet_latency_routing),
+                       default_deadline_ms=config.fleet_deadline_ms)
 
 
 def serve_router(config, urls: Optional[list] = None) -> None:
@@ -84,16 +98,20 @@ def serve_fleet(raw_params: dict, config) -> None:
         ports = [config.fleet_base_port + i for i in range(n)]
     else:
         ports = find_open_ports(n, host=config.serving_host)
+    from ..telemetry.registry import MetricsRegistry
+    registry = MetricsRegistry()   # shared: router gauges + supervisor
     sup = FleetSupervisor(
         lambda idx, port: default_replica_argv(raw_params, port),
         ports, host=config.serving_host,
         max_restarts=config.fleet_max_restarts,
-        restart_backoff_s=config.fleet_restart_backoff_s)
+        restart_backoff_s=config.fleet_restart_backoff_s,
+        metrics_registry=registry)
     try:
         sup.spawn_all()
         sup.wait_ready(timeout_s=config.fleet_ready_timeout_s)
         sup.start_watching()
-        router = _make_router(config, sup.urls)
+        router = _make_router(config, sup.urls, registry=registry,
+                              supervisor=sup)
         log_info(f"fleet: {n} replicas ready on ports {ports}; router on "
                  f"http://{config.serving_host}:{config.serving_port}")
         serve(router, host=config.serving_host, port=config.serving_port)

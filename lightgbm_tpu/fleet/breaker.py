@@ -1,0 +1,321 @@
+"""Gray-failure primitives: circuit breaker, latency digest, retry budget.
+
+PR 7's router only understood binary failure — a replica is reachable or
+it is not.  The production killer is the *gray* replica: alive, passing
+health polls, answering every request at 20x latency.  Three primitives
+turn that into something the router can act on, each deliberately
+transport-free and clock-injectable so tier-1 tests drive every
+transition without a single wall-clock sleep:
+
+- ``LatencyDigest`` — a bounded ring of (timestamp, latency) samples with
+  quantile reads over a sliding time window.  The window is the point:
+  a drained replica stops producing samples, its digest goes stale
+  (``quantile`` returns None), and the router's latency weight decays
+  back to neutral — which is how a slow replica that got organically
+  drained gets *re-admitted* for a probe without any explicit reset.
+- ``CircuitBreaker`` — the classic closed -> open -> half-open machine
+  fed by data-path outcomes (transport errors, timeouts, 5xx/429).
+  ``failures`` consecutive failures open it; after ``cooldown_s`` it
+  admits ``probes`` trial requests (half-open); all probes succeeding
+  closes it, any probe failing re-opens it.  A bounded ``history`` of
+  transitions is kept so soaks can assert the full walk
+  closed -> open -> half_open -> closed actually happened.
+- ``RetryBudget`` — a token bucket refilled by *request volume*, not
+  time: every data-path request deposits ``ratio`` tokens (default 10%),
+  every retry/hedge withdraws one.  Under a fleet-wide brownout the
+  deposit rate and the failure rate scale together, so retries are
+  capped at ``ratio`` amplification no matter how hard the storm blows —
+  the fleet degrades to honest 503s instead of a retry storm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["CircuitBreaker", "LatencyDigest", "RetryBudget",
+           "CLOSED", "OPEN", "HALF_OPEN"]
+
+CLOSED = "closed"        # normal operation, failures counted
+OPEN = "open"            # no traffic until cooldown_s elapses
+HALF_OPEN = "half_open"  # limited probes decide: back to closed or open
+
+
+class LatencyDigest:
+    """Bounded ring of recent latencies with sliding-window quantiles.
+
+    ``observe`` records (now, seconds); ``quantile(q)`` reads over samples
+    younger than ``window_s`` and returns None when fewer than
+    ``min_samples`` are live — "no recent evidence" is an explicit state
+    (the router treats it as neutral weight), never a fabricated 0.
+    """
+
+    # quantile reads are cached this long: the routing hot path asks for
+    # the same quantiles on every request, and a per-request sort of the
+    # ring is pure recomputation of a value that moves at observation
+    # cadence (bounded staleness; observe() invalidates immediately)
+    _CACHE_TTL_S = 0.1
+
+    def __init__(self, capacity: int = 256, window_s: float = 30.0,
+                 min_samples: int = 5,
+                 clock: Callable[[], float] = time.monotonic):
+        self._cap = int(capacity)
+        self.window_s = float(window_s)
+        self.min_samples = int(min_samples)
+        self._clock = clock
+        self._buf: List[Tuple[float, float]] = []
+        self._n = 0
+        self._lock = threading.Lock()
+        self._cache: dict = {}
+        self._cache_t = -1e18
+
+    def observe(self, seconds: float) -> None:
+        entry = (self._clock(), float(seconds))
+        with self._lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(entry)
+            else:
+                self._buf[self._n % self._cap] = entry
+            self._n += 1
+            self._cache.clear()
+
+    def quantile(self, q: float) -> Optional[float]:
+        """q in [0, 1]; None when the window holds < min_samples."""
+        now = self._clock()
+        with self._lock:
+            if now - self._cache_t < self._CACHE_TTL_S and q in self._cache:
+                return self._cache[q]
+            horizon = now - self.window_s
+            live = [lat for (t, lat) in self._buf if t >= horizon]
+        if len(live) < self.min_samples:
+            out = None
+        else:
+            live.sort()
+            out = live[min(int(len(live) * q), len(live) - 1)]
+        with self._lock:
+            if now - self._cache_t >= self._CACHE_TTL_S:
+                self._cache.clear()
+                self._cache_t = now
+            self._cache[q] = out
+        return out
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+
+class CircuitBreaker:
+    """Per-replica data-path breaker (closed -> open -> half-open).
+
+    Not self-locking for state *reads* beyond the lock it takes on every
+    mutation — callers may read ``state`` racily for display; routing
+    decisions go through ``admits``/``try_acquire`` which are locked.
+    ``failures <= 0`` disables the breaker entirely (always closed).
+    """
+
+    _MAX_HISTORY = 64
+
+    def __init__(self, failures: int = 5, cooldown_s: float = 2.0,
+                 probes: int = 2,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failures = int(failures)
+        self.cooldown_s = float(cooldown_s)
+        self.probes = max(int(probes), 1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self._fail_streak = 0
+        self._opened_at = 0.0
+        self._probe_slots = 0      # half-open trial requests still grantable
+        self._probe_ok = 0
+        self.transitions = 0
+        self.history: List[Tuple[float, str, str]] = []  # (t, from, to)
+
+    @property
+    def enabled(self) -> bool:
+        return self.failures > 0
+
+    def _set_state(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.history.append((self._clock(), self.state, state))
+        del self.history[:-self._MAX_HISTORY]
+        self.state = state
+        self.transitions += 1
+
+    def _maybe_half_open(self) -> None:
+        """open -> half_open once the cooldown elapsed (lock held)."""
+        if (self.state == OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            self._set_state(HALF_OPEN)
+            self._probe_slots = self.probes
+            self._probe_ok = 0
+
+    def admits(self) -> bool:
+        """Non-consuming routability check (ranking).  True in closed, in
+        half-open while probe slots remain, and in open once the cooldown
+        elapsed (which transitions to half-open)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            self._maybe_half_open()
+            if self.state == CLOSED:
+                return True
+            return self.state == HALF_OPEN and self._probe_slots > 0
+
+    def wants_probe(self) -> bool:
+        """True when the breaker is half-open with grantable probe
+        slots.  The router gives such a replica PROBE PRIORITY in
+        ranking — a drained/slow replica never wins a cost comparison,
+        so without deliberate priority its half-open probes would wait
+        forever and the breaker could never close."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            self._maybe_half_open()
+            return self.state == HALF_OPEN and self._probe_slots > 0
+
+    # try_acquire grant kinds (both truthy; 0/False = denied)
+    GRANT_NORMAL = 1
+    GRANT_PROBE = 2
+
+    def try_acquire(self) -> int:
+        """Consume permission for ONE attempt.  Unlimited in closed
+        (returns GRANT_NORMAL); half-open grants at most ``probes``
+        concurrent trials (returns GRANT_PROBE — the caller passes
+        ``probe=True`` back with the outcome, so only REAL probes can
+        close the breaker); open grants nothing (returns 0)."""
+        if not self.enabled:
+            return self.GRANT_NORMAL
+        with self._lock:
+            self._maybe_half_open()
+            if self.state == CLOSED:
+                return self.GRANT_NORMAL
+            if self.state == HALF_OPEN and self._probe_slots > 0:
+                self._probe_slots -= 1
+                return self.GRANT_PROBE
+            return 0
+
+    def record_success(self, probe: bool = True) -> None:
+        """``probe`` is the flag threaded from try_acquire (GRANT_PROBE):
+        in half-open, only outcomes of attempts that actually consumed a
+        probe slot may count toward closing — a slow success ISSUED
+        BEFORE the breaker opened (the gray replica's in-flight backlog,
+        still completing through the cooldown) is pre-outage evidence
+        and must not re-admit a replica no probe ever re-tested."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self.state == HALF_OPEN:
+                if not probe:
+                    return   # stale (pre-open) evidence: ignore
+                self._probe_ok += 1
+                if self._probe_ok >= self.probes:
+                    self._set_state(CLOSED)
+                    self._fail_streak = 0
+                else:
+                    # slots are a CONCURRENCY throttle, not a lifetime
+                    # grant: a completed probe hands its slot back so
+                    # the machine can keep probing toward `probes`
+                    # successes instead of deadlocking half-open
+                    self._probe_slots = min(self._probe_slots + 1,
+                                            self.probes)
+            elif self.state == CLOSED:
+                self._fail_streak = 0
+
+    def record_failure(self, probe: bool = True) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if self.state == HALF_OPEN:
+                if not probe:
+                    return   # stale (pre-open) evidence: ignore
+                # one failed probe is proof enough: back to open
+                self._set_state(OPEN)
+                self._opened_at = self._clock()
+                self._fail_streak = 0
+                return
+            if self.state == CLOSED:
+                self._fail_streak += 1
+                if self._fail_streak >= self.failures:
+                    self._set_state(OPEN)
+                    self._opened_at = self._clock()
+
+    def record_neutral(self, probe: bool = True) -> None:
+        """An attempt whose outcome says nothing about the replica's
+        health (deadline-squeezed timeout, 429/504 admission verdicts):
+        in half-open it releases the probe slot the attempt consumed —
+        without this, neutral outcomes leak slots and the breaker can
+        deadlock half-open with no probes left to grant."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if self.state == HALF_OPEN and probe:
+                self._probe_slots = min(self._probe_slots + 1,
+                                        self.probes)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state, "transitions": self.transitions,
+                    "fail_streak": self._fail_streak}
+
+
+class RetryBudget:
+    """Volume-coupled token bucket shared by reroutes and hedges.
+
+    ``deposit()`` is called once per data-path request and adds ``ratio``
+    tokens (capped at ``cap``); ``try_spend()`` withdraws one token per
+    retry/hedge.  ``initial`` seeds the bucket so an isolated failure on
+    a quiet fleet can still reroute (a cold bucket would turn the very
+    first replica death into a failed request).  ``ratio <= 0`` disables
+    the budget (every spend granted) — the pre-hardening behavior.
+    """
+
+    def __init__(self, ratio: float = 0.1, cap: float = 100.0,
+                 initial: float = 10.0):
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._tokens = min(float(initial), self.cap)
+        self._lock = threading.Lock()
+        self.spent = 0
+        self.denied = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.ratio > 0
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def deposit(self, n: float = 1.0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._tokens = min(self._tokens + self.ratio * float(n),
+                               self.cap)
+
+    def try_spend(self) -> bool:
+        if not self.enabled:
+            return True
+        with self._lock:
+            # epsilon: ten 10% deposits must grant one retry — summing
+            # 0.1 ten times lands a hair under 1.0 in binary floats
+            if self._tokens >= 1.0 - 1e-9:
+                self._tokens -= 1.0
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+    def refund(self) -> None:
+        """Return one token (a spend whose action was never taken — e.g.
+        a hedge token granted but the shared retry budget then denied)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._tokens = min(self._tokens + 1.0, self.cap)
+            self.spent = max(self.spent - 1, 0)

@@ -1,0 +1,175 @@
+"""``chaosnet`` — a fault-injecting replica transport for gray-failure
+tests (the network analog of ``io/chaos.py``'s ``chaosio://``).
+
+``ChaosReplica`` wraps any replica endpoint (``HttpReplica`` or a test
+fake: anything with ``name``/``request``/``health``) and injects the
+request-path failures the fleet tier claims to survive:
+
+- **latency** (``add_latency``): every data-path request sleeps first —
+  the gray replica.  Health polls are untouched by default
+  (``affect_health=False``), which is exactly what makes the failure
+  gray: the replica keeps passing polls while its data path crawls.
+- **black holes** (``black_hole``): the next N data requests consume the
+  caller's full timeout and then die with a timeout-caused
+  ``ReplicaTransportError`` — packets leaving and never returning.
+- **slow drips** (``slow_drip``): the next N requests are delivered to
+  the replica and then the *response* stalls — the request LANDED, the
+  caller just can't know it did.  This is the publish UNKNOWN-outcome
+  case the idempotent publish token exists for.
+- **connection resets** (``reset_next``): the next N requests fail
+  immediately with a reset-flavored ``ReplicaTransportError``.
+
+All faults apply to ``request``; ``health`` delegates untouched unless
+``affect_health=True``.  Per-fault fired counters mirror ``ChaosScheme``
+so a chaos test can assert each fault actually fired instead of passing
+vacuously, and ``sleep_fn`` is injectable so unit tests pay no
+wall-clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..log import LightGBMError
+from .router import ReplicaTransportError
+
+__all__ = ["ChaosReplica"]
+
+
+class ChaosReplica:
+    """Armable fault wrapper around one replica endpoint."""
+
+    def __init__(self, endpoint, affect_health: bool = False,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        if not hasattr(endpoint, "request"):
+            raise LightGBMError(
+                "ChaosReplica wraps a replica endpoint (needs .request)")
+        self.endpoint = endpoint
+        self.name = getattr(endpoint, "name", "chaos")
+        self.affect_health = bool(affect_health)
+        self._sleep = sleep_fn
+        self._lock = threading.Lock()
+        self._latency_s = 0.0
+        self._black_holes = 0
+        self._black_hole_cap_s = 30.0
+        self._slow_drips = 0
+        self._drip_s = 0.0
+        self._resets = 0
+        self.counters: Dict[str, int] = {
+            "requests": 0, "latency_injections": 0, "latency_timeouts": 0,
+            "black_holes": 0, "slow_drips": 0, "resets": 0,
+        }
+
+    # -- arming -----------------------------------------------------------
+    def add_latency(self, seconds: float) -> None:
+        """Every data-path request sleeps this long first (0 disarms)."""
+        with self._lock:
+            self._latency_s = float(seconds)
+
+    def black_hole(self, n: int = 1, cap_s: float = 30.0) -> None:
+        """Next N data requests eat the caller's timeout, then die with a
+        timeout-caused transport error (the request never arrived)."""
+        with self._lock:
+            self._black_holes = int(n)
+            self._black_hole_cap_s = float(cap_s)
+
+    def slow_drip(self, n: int = 1, delay_s: float = 1.0) -> None:
+        """Next N requests REACH the replica, then the response stalls
+        delay_s — the caller may time out on an op that landed."""
+        with self._lock:
+            self._slow_drips = int(n)
+            self._drip_s = float(delay_s)
+
+    def reset_next(self, n: int = 1) -> None:
+        """Next N data requests fail instantly with a connection reset."""
+        with self._lock:
+            self._resets = int(n)
+
+    def calm(self) -> None:
+        """Disarm everything (tests' teardown / soak recovery phase)."""
+        with self._lock:
+            self._latency_s = 0.0
+            self._black_holes = self._slow_drips = self._resets = 0
+
+    # -- endpoint interface ----------------------------------------------
+    def invalidate_pool(self) -> None:
+        invalidate = getattr(self.endpoint, "invalidate_pool", None)
+        if invalidate is not None:
+            invalidate()
+
+    def health(self, timeout_s: float = 2.0) -> Optional[Dict]:
+        if self.affect_health:
+            try:
+                self._apply_pre_faults(timeout_s)
+            except ReplicaTransportError:
+                return None
+        return self.endpoint.health(timeout_s)
+
+    def _apply_pre_faults(self, timeout_s: Optional[float]) -> None:
+        """Faults that fire BEFORE the request reaches the replica."""
+        with self._lock:
+            self.counters["requests"] += 1
+            reset = self._resets > 0
+            if reset:
+                self._resets -= 1
+                self.counters["resets"] += 1
+            hole = (not reset) and self._black_holes > 0
+            if hole:
+                self._black_holes -= 1
+                self.counters["black_holes"] += 1
+                hole_s = min(timeout_s or self._black_hole_cap_s,
+                             self._black_hole_cap_s)
+            latency = self._latency_s
+        if reset:
+            raise ReplicaTransportError(
+                f"replica {self.name}: chaosnet connection reset"
+            ) from ConnectionResetError("chaosnet reset")
+        if hole:
+            self._sleep(hole_s)
+            raise ReplicaTransportError(
+                f"replica {self.name}: chaosnet black hole "
+                f"(timed out after {hole_s:g}s)") from TimeoutError(
+                    "chaosnet black hole")
+        if latency > 0:
+            with self._lock:
+                self.counters["latency_injections"] += 1
+            if timeout_s is not None and latency >= timeout_s:
+                # fidelity with a real slow network: the caller's read
+                # timeout fires at timeout_s — it does NOT wait out the
+                # injected latency and then get a late answer (which
+                # would hand deadline-squeezed requests 200s a real
+                # socket could never deliver)
+                self._sleep(timeout_s)
+                with self._lock:
+                    self.counters["latency_timeouts"] += 1
+                raise ReplicaTransportError(
+                    f"replica {self.name}: chaosnet latency "
+                    f"({latency:g}s) exceeded timeout {timeout_s:g}s"
+                ) from TimeoutError("chaosnet latency")
+            self._sleep(latency)
+
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                timeout_s: Optional[float] = None) -> Tuple[int, dict]:
+        self._apply_pre_faults(timeout_s)
+        out = self.endpoint.request(method, path, body, timeout_s=timeout_s)
+        with self._lock:
+            drip = self._slow_drips > 0
+            if drip:
+                self._slow_drips -= 1
+                self.counters["slow_drips"] += 1
+            drip_s = self._drip_s
+        if drip:
+            # the request LANDED; only the response is late.  When the
+            # drip outlives the caller's timeout, surface the same
+            # timeout-caused transport error a real stalled socket would
+            # — the op's outcome is genuinely unknown to the caller.
+            if timeout_s is not None and drip_s >= timeout_s:
+                self._sleep(timeout_s)
+                raise ReplicaTransportError(
+                    f"replica {self.name}: chaosnet slow drip "
+                    f"(response stalled past {timeout_s:g}s)"
+                ) from TimeoutError("chaosnet slow drip")
+            self._sleep(drip_s)
+        return out

@@ -4,27 +4,46 @@ The router is the fleet's only stateful coordination point, and it holds
 no model state at all — replicas own the models (each a full ServingApp
 warmed from the shared AOT bundle), the router owns *placement*:
 
-- **routing**: each predict goes to the routable replica with the fewest
-  queued+in-flight rows as of its last health poll (cheapest useful load
-  signal; ties break round-robin so equally-idle replicas share warmup
-  traffic);
-- **rerouting**: a forwarding failure (connection refused/reset — the
-  killed-replica case) marks the replica down IMMEDIATELY and retries the
-  request on the next-best peer, so one replica dying mid-soak loses zero
-  requests; a replica's own 429 (its bounded queue overflowed between
-  polls) is treated the same way — the load reroutes instead of
-  surfacing a retryable error to the client;
-- **shedding**: when no replica is routable (all breached/down per
-  fleet/slo.py) the router answers 503 at the front door — SLO-aware
-  backpressure instead of the old queue-full-only cliff;
-- **broadcast**: publish/rollback fan out to EVERY reachable replica so a
-  hot-swap lands fleet-wide in one call.
+- **routing**: each predict goes to the routable replica with the lowest
+  cost — queued+in-flight rows as of its last health poll, scaled by a
+  continuous latency weight from a per-replica windowed latency digest
+  (+ the replica's reported queue wait), so a slow-but-alive replica is
+  organically drained long before any binary verdict, and re-admitted
+  when its (time-windowed) evidence goes stale; ties break round-robin;
+- **deadlines**: a predict may carry ``deadline_ms``; the router refuses
+  expired requests with 504 before forwarding, derives each hop's HTTP
+  read timeout from the remaining budget, and forwards the *remaining*
+  budget so the replica's admission check can refuse work it cannot
+  finish (see serving/batcher.py);
+- **hedging**: when a forwarded predict outlives the target replica's
+  own latency quantile (``hedge_quantile`` over its digest), the router
+  duplicates it to the next-best replica and takes the first answer —
+  bounded by a hedge budget (≤``hedge_budget_pct`` of request volume)
+  so hedging can never become the overload;
+- **rerouting under a retry budget**: a forwarding failure (connection
+  refused/reset — the killed-replica case) marks the replica down
+  IMMEDIATELY and retries on the next-best peer; a replica's own
+  429/504/5xx reroutes the same way.  Every retry and hedge spends from
+  one volume-coupled token bucket (``retry_budget_pct`` of request
+  volume), so a fleet-wide brownout degrades to honest 503s instead of
+  a retry storm;
+- **circuit breakers**: per-replica data-path outcomes feed a
+  closed→open→half-open breaker (fleet/breaker.py) — a replica that
+  keeps timing out is cut off entirely, probed after a cooldown, and
+  re-admitted only when the probes succeed;
+- **shedding**: when no replica is routable (all breached/down/broken)
+  the router answers 503 at the front door;
+- **broadcast**: publish/rollback fan out to EVERY reachable replica so
+  a hot-swap lands fleet-wide in one call; publishes ride an idempotent
+  ``publish_token`` (minted here when the caller didn't) so stale-conn
+  retries, UNKNOWN-outcome re-sends, and rejoin replays can never
+  double-apply.
 
 ``FleetRouter.handle(method, path, body)`` keeps the same transport-free
 contract as ``ServingApp.handle`` — ``serving.server.make_server`` wraps
 either, tests drive the router without sockets by injecting fake replica
 endpoints, and the router's own gauges (per-replica state/load, forwards,
-reroutes, sheds, router-side latency) live in a telemetry
+reroutes, sheds, hedges, router-side latency) live in a telemetry
 ``MetricsRegistry`` rendered at ``GET /v1/metrics/prometheus``.
 """
 
@@ -33,14 +52,29 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
+from concurrent.futures import FIRST_COMPLETED
+from concurrent.futures import TimeoutError as FutureTimeout
+from concurrent.futures import wait as futures_wait
 from typing import Dict, List, Optional, Tuple
 
 from ..log import LightGBMError, log_info, log_warning
 from ..serving.metrics import LatencyWindow
 from ..telemetry.registry import MetricsRegistry
+from .breaker import CircuitBreaker, LatencyDigest, RetryBudget
 from .slo import ReplicaSLO, SLOPolicy
 
 __all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError"]
+
+# statuses the router treats as "load to place elsewhere", never as the
+# request's final answer while peers remain: 429 (queue overflow), 504
+# (deadline refused at THAT replica's admission — an idler peer may still
+# make it), 5xx (draining / transient)
+_RETRYABLE = frozenset({429, 504})
+
+
+def _retryable(status: int) -> bool:
+    return status in _RETRYABLE or status >= 500
 
 
 class ReplicaTransportError(LightGBMError):
@@ -126,11 +160,16 @@ class HttpReplica:
         # server closed between calls fails with a reset/EOF that says
         # nothing about the replica's health; a FRESH connect failing is
         # the replica genuinely unreachable — no retry.  Only requests
-        # that are safe to EXECUTE TWICE auto-retry: a publish/rollback
-        # the replica may have already processed before the socket died
-        # would double-apply (two version bumps — a later rollback then
-        # lands on the duplicate); predicts are pure per-row functions.
-        retry_safe = method == "GET" or path.endswith(":predict")
+        # that are safe to EXECUTE TWICE auto-retry: a bare publish/
+        # rollback the replica may have already processed before the
+        # socket died would double-apply (two version bumps — a later
+        # rollback then lands on the duplicate); predicts are pure
+        # per-row functions, and a publish carrying a ``publish_token``
+        # is idempotent by contract (the registry replays the same
+        # version for a token it already applied), so it retries too.
+        retry_safe = (method == "GET" or path.endswith(":predict")
+                      or (isinstance(body, dict)
+                          and bool(body.get("publish_token"))))
         for attempt in (0, 1):
             reused = getattr(self._local, "conn", None) is not None
             try:
@@ -147,7 +186,15 @@ class HttpReplica:
                     return resp.status, {"text": data.decode(errors="replace")}
             except (OSError, http.client.HTTPException) as exc:
                 self._drop_conn()
-                if not reused or attempt == 1 or not retry_safe:
+                # a READ TIMEOUT is not stale-connection evidence: the
+                # request reached a live (if slow) replica and re-sending
+                # it with a fresh full timeout would both duplicate load
+                # outside the router's retry/hedge budgets and double the
+                # caller's wait past its deadline — surface it and let
+                # the budgeted layers decide (socket.timeout is a
+                # TimeoutError subclass since py3.10)
+                if (not reused or attempt == 1 or not retry_safe
+                        or isinstance(exc, TimeoutError)):
                     raise ReplicaTransportError(
                         f"replica {self.name}: {type(exc).__name__}: "
                         f"{exc}") from exc
@@ -167,9 +214,13 @@ class HttpReplica:
 class _Replica:
     """Router-side record: endpoint + SLO state + last-known load."""
 
-    def __init__(self, endpoint, slo: ReplicaSLO):
+    def __init__(self, endpoint, slo: ReplicaSLO, breaker: CircuitBreaker,
+                 digest: LatencyDigest):
         self.endpoint = endpoint
         self.slo = slo
+        self.breaker = breaker          # data-path closed/open/half-open
+        self.digest = digest            # windowed data-path latencies
+        self.queue_wait_ms = 0.0        # replica-reported, at last poll
         self.load_rows = 0        # queued + in-flight rows at last poll
         # rows forwarded by THIS router and not yet answered: the live
         # complement to load_rows, which refreshes only at poll time —
@@ -195,17 +246,45 @@ class FleetRouter:
                  poll_interval_ms: float = 100.0,
                  request_timeout_s: float = 30.0,
                  health_timeout_s: float = 2.0,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 hedge_quantile: float = 0.95,
+                 hedge_min_ms: float = 20.0,
+                 hedge_budget_pct: float = 5.0,
+                 retry_budget_pct: float = 10.0,
+                 breaker_failures: int = 5,
+                 breaker_cooldown_s: float = 2.0,
+                 breaker_probes: int = 2,
+                 latency_routing: bool = True,
+                 default_deadline_ms: float = 0.0,
+                 supervisor=None):
         if not replicas:
             raise LightGBMError("FleetRouter needs at least one replica")
         policy = policy or SLOPolicy()
-        self._replicas = [_Replica(ep, ReplicaSLO(policy))
-                          for ep in replicas]
+        self._replicas = [
+            _Replica(ep, ReplicaSLO(policy),
+                     CircuitBreaker(failures=breaker_failures,
+                                    cooldown_s=breaker_cooldown_s,
+                                    probes=breaker_probes),
+                     LatencyDigest())
+            for ep in replicas]
         self.policy = policy
         self.registry = registry or MetricsRegistry()
         self.poll_interval_s = float(poll_interval_ms) / 1e3
         self.request_timeout_s = float(request_timeout_s)
         self.health_timeout_s = float(health_timeout_s)
+        # gray-failure knobs (fleet/breaker.py has the semantics):
+        # hedge_quantile=0 disables hedging, retry_budget_pct=0 restores
+        # unbounded reroutes, breaker_failures=0 disables the breakers,
+        # latency_routing=False restores pure least-loaded ranking —
+        # together these knobs are the bench's "un-hardened" contrast
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.latency_routing = bool(latency_routing)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.retry_budget = RetryBudget(ratio=retry_budget_pct / 100.0)
+        self.hedge_budget = RetryBudget(ratio=hedge_budget_pct / 100.0,
+                                        cap=50.0, initial=5.0)
+        self.supervisor = supervisor   # abandoned-slot visibility only
         self._lock = threading.Lock()
         self._rr = 0                      # round-robin tie-breaker
         self._next_demand_poll_s = 0.0    # rate limit for pollless mode
@@ -229,6 +308,17 @@ class FleetRouter:
         self._bcast_pool = ThreadPoolExecutor(
             max_workers=max(len(replicas), 2),
             thread_name_prefix="lgbm-tpu-fleet-bcast")
+        # hedged forwards need the primary on a worker thread (the caller
+        # waits out the hedge delay, then maybe races a duplicate); only
+        # the hedgeable path pays it — un-hedgeable forwards stay
+        # inline, and a SATURATED pool also falls back to inline (see
+        # _attempt_maybe_hedged) so the pool size caps hedging, never
+        # the router's total concurrency
+        self._hedge_capacity = max(8 * len(replicas), 32)
+        self._hedge_inflight = 0          # guarded by self._lock
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=self._hedge_capacity,
+            thread_name_prefix="lgbm-tpu-fleet-hedge")
         self._poll_thread: Optional[threading.Thread] = None
         self._poll_stop = threading.Event()
         self.latency = LatencyWindow()
@@ -252,6 +342,24 @@ class FleetRouter:
         self._m_latency = reg.histogram(
             "lgbm_fleet_request_latency_seconds",
             "router-side end-to-end predict latency")
+        self._m_hedges = reg.counter(
+            "lgbm_fleet_hedges_total",
+            "predicts duplicated to a second replica after the primary "
+            "outlived its latency-quantile hedge delay")
+        self._m_hedge_wins = reg.counter(
+            "lgbm_fleet_hedge_wins_total",
+            "hedged predicts where the duplicate answered first")
+        self._m_hedge_denied = reg.counter(
+            "lgbm_fleet_hedge_denied_total",
+            "hedges skipped because the hedge/retry budget was spent")
+        self._m_retry_denied = reg.counter(
+            "lgbm_fleet_retry_budget_exhausted_total",
+            "requests answered 503 because the shared retry budget had "
+            "no token for another attempt (brownout backpressure)")
+        self._m_deadline = reg.counter(
+            "lgbm_fleet_deadline_refused_total",
+            "predicts refused 504 at the router because their deadline "
+            "budget was already spent")
         self._m_forwarded = [reg.counter(
             "lgbm_fleet_forwarded_total", "predicts forwarded",
             replica=r.endpoint.name) for r in self._replicas]
@@ -269,6 +377,10 @@ class FleetRouter:
         self._m_fill = [reg.gauge(
             "lgbm_fleet_replica_batch_fill",
             "replica in-flight batch fill at last poll",
+            replica=r.endpoint.name) for r in self._replicas]
+        self._m_breaker = [reg.gauge(
+            "lgbm_fleet_replica_breaker_state",
+            "data-path circuit breaker: 0 closed / 1 half-open / 2 open",
             replica=r.endpoint.name) for r in self._replicas]
         for g in self._m_up:
             g.set(1)                       # optimistic, like ReplicaSLO
@@ -293,6 +405,7 @@ class FleetRouter:
             self._poll_thread = None
         self._health_pool.shutdown(wait=False)
         self._bcast_pool.shutdown(wait=False)
+        self._hedge_pool.shutdown(wait=False)
 
     def __enter__(self) -> "FleetRouter":
         return self.start()
@@ -353,10 +466,15 @@ class FleetRouter:
                                                 requests)
                     rep.load_rows = (int(gauges.get("queue_rows", 0))
                                      + int(gauges.get("inflight_rows", 0)))
+                    rep.queue_wait_ms = float(
+                        gauges.get("queue_wait_ms", 0.0))
                     self._m_load[i].set(rep.load_rows)
                     self._m_p99[i].set(float(gauges.get("p99_ms", 0.0)))
                     self._m_fill[i].set(float(gauges.get("batch_fill", 0.0)))
                 self._m_up[i].set(1 if rep.slo.routable else 0)
+                self._m_breaker[i].set(
+                    {"closed": 0, "half_open": 1, "open": 2}.get(
+                        rep.breaker.state, 0))
             if replay:
                 # back from the dead: a supervised restart reloaded the
                 # replica's ORIGINAL models, so hot-swaps it missed must
@@ -430,19 +548,79 @@ class FleetRouter:
             self._next_demand_poll_s = now + self._DEMAND_POLL_MIN_INTERVAL_S
         self.poll_once()
 
+    # a gray replica's latency weight is capped: 100x the fleet-best is
+    # already "drained"; unbounded weights would just overflow the sort
+    _LATENCY_WEIGHT_CAP = 100.0
+    # a timeout only counts as breaker evidence when the replica had at
+    # least this much allowance (see _attempt)
+    _BREAKER_TIMEOUT_FLOOR_S = 1.0
+    # a timed-out attempt's latency sample is censored ("at least this
+    # slow"); it enters the digest scaled by this factor (see _attempt)
+    _TIMEOUT_LATENCY_PENALTY = 4.0
+    # cost floor in row units — roughly one batch's worth of work.  The
+    # latency weight multiplies (load + floor), so a 20x-slower replica
+    # is NOT re-picked just because the fast replica has a normal
+    # batch's worth of rows queued (load and weight live in different
+    # units; without the floor ~20 queued rows outvoted a 20x latency
+    # ratio).  Among equal-latency replicas the floor shifts every cost
+    # equally, so least-loaded ordering is unchanged
+    _LOAD_FLOOR_ROWS = 64.0
+
+    def _latency_weights(self, indices: List[int]) -> Dict[int, float]:
+        """Continuous routing weight per replica: observed data-path p50
+        (windowed digest) plus the replica's own reported queue wait,
+        relative to the fleet's best.  A replica with no RECENT evidence
+        (drained, or never probed) weighs 1.0 — neutral, so it gets
+        probed again instead of being exiled on stale history."""
+        if not self.latency_routing:
+            return {i: 1.0 for i in indices}
+        cost: Dict[int, Optional[float]] = {}
+        for i in indices:
+            rep = self._replicas[i]
+            p50 = rep.digest.quantile(0.5)
+            # max, not sum: the router-observed p50 is a full round trip
+            # and already CONTAINS the replica's queue wait — summing
+            # would double-count congestion (and the load term counts it
+            # a third time).  The replica-reported figure still matters
+            # as the fresher signal when the router's own observations
+            # lag the replica's true state
+            cost[i] = (None if p50 is None
+                       else max(p50 * 1e3, rep.queue_wait_ms))
+        known = [c for c in cost.values() if c is not None and c > 0]
+        if not known:
+            return {i: 1.0 for i in indices}
+        best = min(known)
+        return {i: (1.0 if c is None
+                    else min(max(c / best, 1.0), self._LATENCY_WEIGHT_CAP))
+                for i, c in cost.items()}
+
     def _ranked(self) -> List[int]:
-        """Routable replica indices, least-loaded first (round-robin among
-        equals so idle replicas share traffic).  Load is the replica's
+        """Routable replica indices, cheapest first (round-robin among
+        equals so idle replicas share traffic).  Cost is the replica's
         last-polled queue+in-flight rows PLUS rows this router has
         forwarded since and not yet heard back about — the live term is
-        what spreads concurrent requests between polls."""
+        what spreads concurrent requests between polls — scaled by the
+        continuous latency weight, so a slow-but-alive replica needs to
+        be proportionally idler before it wins a request.  Replicas whose
+        circuit breaker is open (and not yet due a half-open probe) are
+        excluded outright."""
         self._maybe_poll_inline()
         with self._lock:
             self._rr += 1
-            order = [(rep.load_rows + rep.router_inflight_rows,
-                      (i + self._rr) % len(self._replicas), i)
-                     for i, rep in enumerate(self._replicas)
-                     if rep.slo.routable]
+            candidates = [(i, rep.load_rows + rep.router_inflight_rows,
+                           rep.breaker.wants_probe())
+                          for i, rep in enumerate(self._replicas)
+                          if rep.slo.routable and rep.breaker.admits()]
+        weights = self._latency_weights([i for i, _, _ in candidates])
+        # probe priority: a half-open replica with free probe slots must
+        # actually RECEIVE a request to prove itself, and a slow/drained
+        # replica never wins the cost comparison on its own — rank it
+        # first (bounded: try_acquire grants at most `probes` concurrent
+        # trials, everything else reroutes normally)
+        order = [(-1.0 if probe
+                  else (load + self._LOAD_FLOOR_ROWS) * weights[i],
+                  (i + self._rr) % len(self._replicas), i)
+                 for i, load, probe in candidates]
         return [i for _, _, i in sorted(order)]
 
     def _mark_down(self, idx: int, reason: str) -> None:
@@ -456,8 +634,259 @@ class FleetRouter:
         log_warning(f"fleet: replica {rep.endpoint.name} marked down "
                     f"({reason})")
 
+    def _attempt(self, idx: int, name: str, body: dict, nrows: int,
+                 timeout_s: float,
+                 started: Optional[threading.Event] = None
+                 ) -> Tuple[Optional[int], dict]:
+        """One forward to one replica with full gray-failure accounting:
+        breaker admission, live in-flight rows, latency digest feed, and
+        the transport-error split — a TIMEOUT feeds the breaker/digest
+        but does NOT mark the replica down (it is alive; its health polls
+        keep passing — that is the gray failure), while a refused/reset
+        connection is the killed-replica case and demotes immediately.
+        Returns (status, payload); status None = transport failure."""
+        if started is not None:
+            started.set()   # hedge-delay clock starts at real execution
+        rep = self._replicas[idx]
+        grant = rep.breaker.try_acquire()
+        probe = grant == CircuitBreaker.GRANT_PROBE
+        if not grant:
+            # lost a race for the last half-open probe slot: the request
+            # was never sent anywhere — flagged so the forward loop can
+            # move on WITHOUT charging the retry budget or counting an
+            # attempt (under a brownout that charge would 503 a request
+            # no replica ever even received)
+            return None, {"error": f"replica {rep.endpoint.name}: "
+                                   "circuit breaker open",
+                          "breaker_race": True}
+        with self._lock:
+            rep.router_inflight_rows += nrows
+        t0 = time.perf_counter()
+        try:
+            status, payload = rep.endpoint.request(
+                "POST", f"/v1/models/{name}:predict", body,
+                timeout_s=timeout_s)
+        except ReplicaTransportError as exc:
+            if isinstance(exc.__cause__, TimeoutError):
+                # count the wait as a latency sample: "at least this
+                # slow" is exactly the evidence that drains a gray
+                # replica even when nothing ever hard-fails.  The sample
+                # is CENSORED (the truth is >= the timeout, usually much
+                # more), so it goes in with a penalty factor — under
+                # uniformly tight deadlines the raw squeezed timeout
+                # would cap the digest near the healthy replicas' p50
+                # and collapse the drain weight exactly when it matters.
+                # Breaker evidence only when the replica had a
+                # REASONABLE allowance — a timeout under a deadline-
+                # squeezed sub-second budget is the deadline's verdict
+                # on the request, not the replica's health (an overload
+                # storm of impatient clients must not breaker-open the
+                # whole fleet into a full outage)
+                rep.digest.observe((time.perf_counter() - t0)
+                                   * self._TIMEOUT_LATENCY_PENALTY)
+                if timeout_s >= self._BREAKER_TIMEOUT_FLOOR_S:
+                    rep.breaker.record_failure(probe)
+                else:
+                    rep.breaker.record_neutral(probe)
+            else:
+                rep.breaker.record_failure(probe)
+                self._mark_down(idx, str(exc))
+            return None, {"error": str(exc)}
+        finally:
+            with self._lock:
+                rep.router_inflight_rows -= nrows
+        elapsed = time.perf_counter() - t0
+        if status == 200:
+            rep.digest.observe(elapsed)
+            rep.breaker.record_success(probe)
+        elif status >= 500 and status != 504:
+            # 5xx = the replica itself is failing.  NOT 504 (that is the
+            # DEADLINE's verdict on the request's budget, not the
+            # replica's health — under a storm of impatient clients every
+            # replica would "fail" and the breakers would turn partial
+            # degradation into a full outage) and NOT 429 (queue-full is
+            # congestion the SLO shed machine already handles from the
+            # polled gauges); both still reroute, they just aren't
+            # breaker evidence
+            rep.breaker.record_failure(probe)
+        else:
+            # neutral outcome (429/504/4xx): in half-open this releases
+            # the probe slot the attempt consumed
+            rep.breaker.record_neutral(probe)
+        return status, payload
+
+    def _hedge_delay_s(self, idx: int) -> Optional[float]:
+        """How long to let a forward to `idx` run before duplicating it,
+        from the replica's OWN latency quantile — None disables hedging
+        for this attempt (knob off, single replica, or a digest without
+        enough recent samples to name a quantile: hedging on no evidence
+        would duplicate every request)."""
+        if self.hedge_quantile <= 0 or len(self._replicas) < 2:
+            return None
+        q = self._replicas[idx].digest.quantile(self.hedge_quantile)
+        if q is None:
+            return None
+        return max(q, self.hedge_min_ms / 1e3)
+
+    def _hedge_submit(self, *attempt_args):
+        """Submit one _attempt to the hedge pool, maintaining the
+        router's own in-flight count (the saturation signal for the
+        inline fallback)."""
+        with self._lock:
+            self._hedge_inflight += 1
+        try:
+            fut = self._hedge_pool.submit(self._attempt, *attempt_args)
+        except BaseException:
+            with self._lock:
+                self._hedge_inflight -= 1
+            raise
+
+        def _done(_f):
+            with self._lock:
+                self._hedge_inflight -= 1
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def _attempt_maybe_hedged(self, idx: int, name: str, body: dict,
+                              nrows: int, timeout_s: float, tried: set,
+                              deadline_t: Optional[float] = None
+                              ) -> List[Tuple[int, Optional[int], dict]]:
+        """Forward to `idx`, duplicating to the next-best peer if the
+        primary outlives its hedge delay and the hedge + retry budgets
+        both grant a token.  Returns the observed outcomes as
+        (replica_idx, status, payload) — the FIRST decisive (non-
+        retryable) answer short-circuits; a hedged request's loser is
+        abandoned to finish on its own (its accounting resolves in
+        _attempt).  Adds any hedged replica to `tried`."""
+        delay = self._hedge_delay_s(idx)
+        saturated = False
+        if delay is not None:
+            with self._lock:
+                saturated = self._hedge_inflight >= self._hedge_capacity
+        if delay is None or delay >= timeout_s or saturated:
+            # pool saturated = more hedgeable forwards than workers: run
+            # inline (forfeit hedging for THIS request) rather than
+            # queue — a queued primary stalls behind strangers' HTTP
+            # calls with its deadline already stamped, and the pool
+            # would otherwise cap the router's total concurrency.
+            # Tracked with the router's own in-flight counter, not the
+            # executor's private internals
+            return [(idx, *self._attempt(idx, name, body, nrows,
+                                         timeout_s))]
+        started = threading.Event()
+        primary = self._hedge_submit(idx, name, body, nrows, timeout_s,
+                                     started)
+        # an attempt can legitimately run ~2x its HTTP timeout (the
+        # stale-conn retry inside HttpReplica) — the hard waits below
+        # must outlast that, and a primary that never answers within
+        # them is reported as a stalled-attempt failure, NOT an escaped
+        # FutureTimeout turning a retryable situation into a 500
+        hard_wait = 2.0 * timeout_s + 5.0
+        try:
+            st, pl = primary.result(timeout=delay)
+            return [(idx, st, pl)]
+        except FutureTimeout:
+            pass
+        def _await_primary():
+            """Wait out the primary (bounded by hard_wait); a primary
+            that never answers becomes a retryable stalled-attempt
+            failure, not an escaped FutureTimeout 500."""
+            try:
+                st, pl = primary.result(timeout=hard_wait)
+            except FutureTimeout:
+                return [(idx, None, {"error": "attempt stalled past its "
+                                              "transport timeout"})]
+            return [(idx, st, pl)]
+
+        alt = None
+        if started.is_set():
+            # only hedge against a primary that actually STARTED — a
+            # saturated hedge pool makes queued primaries "outlive" any
+            # delay, and duplicating load precisely when the system is
+            # saturated would amplify the overload, not relieve it
+            alt = next((i for i in self._ranked() if i not in tried),
+                       None)
+        if alt is not None:
+            alt_p50 = self._replicas[alt].digest.quantile(0.5)
+            if alt_p50 is not None and alt_p50 > delay:
+                # the only peer left is EXPECTED to be slower than the
+                # delay we already waited — a duplicate there cannot
+                # plausibly win, so spending hedge budget (and loading
+                # the slow replica) buys nothing
+                alt = None
+        granted = alt is not None and self.hedge_budget.try_spend()
+        if granted and not self.retry_budget.try_spend():
+            self.hedge_budget.refund()
+            granted = False
+        if not granted:
+            if alt is not None:
+                self._m_hedge_denied.inc()
+            return _await_primary()
+        hbody, h_timeout = body, timeout_s
+        if deadline_t is not None:
+            # the budget in `body` was stamped BEFORE the hedge delay
+            # elapsed — forwarding it verbatim would overstate what is
+            # left and let the alt replica admit (and compute) work
+            # whose real deadline has already passed
+            rem = deadline_t - time.perf_counter()
+            if rem <= 0:
+                self.hedge_budget.refund()
+                self.retry_budget.refund()
+                return _await_primary()
+            hbody = dict(body)
+            hbody["deadline_ms"] = rem * 1e3
+            h_timeout = min(timeout_s, rem)
+        tried.add(alt)
+        self._m_hedges.inc()
+        hedge = self._hedge_submit(alt, name, hbody, nrows, h_timeout)
+        futs = {primary: idx, hedge: alt}
+        outcomes: List[Tuple[int, Optional[int], dict]] = []
+        pending = set(futs)
+        deadline = time.perf_counter() + hard_wait
+        while pending:
+            done, pending = futures_wait(
+                pending, timeout=max(deadline - time.perf_counter(), 0.1),
+                return_when=FIRST_COMPLETED)
+            if not done:
+                break   # both wedged past their own HTTP timeouts
+            # both may land in one wait round: prefer the PRIMARY so the
+            # served answer and the hedge-win credit don't depend on set
+            # iteration order.  Bookkeeping (breaker_race refunds) runs
+            # for the WHOLE completed batch first — a decisive primary
+            # in the same round must not early-return past the alt's
+            # refund
+            round_outcomes = [(futs[f], *f.result())
+                              for f in sorted(done,
+                                              key=lambda f: futs[f] != idx)]
+            for i, st, pl in round_outcomes:
+                if (i == alt and isinstance(pl, dict)
+                        and pl.get("breaker_race")):
+                    # the duplicate was never actually sent (lost a
+                    # half-open probe-slot race): hand both tokens back,
+                    # or brownout hedging toward a half-open peer would
+                    # drain the shared budget on no-ops — and give the
+                    # replica back to this request's candidate set (it
+                    # was never attempted; leaving it in `tried` could
+                    # 503 a request whose only live peer it was)
+                    self.hedge_budget.refund()
+                    self.retry_budget.refund()
+                    tried.discard(alt)
+            for i, st, pl in round_outcomes:
+                outcomes.append((i, st, pl))
+                if st is not None and not _retryable(st):
+                    if i == alt:
+                        self._m_hedge_wins.inc()
+                    return outcomes
+        if not outcomes:
+            outcomes.append((idx, None, {"error": "attempt stalled past "
+                                                  "its transport timeout"}))
+        return outcomes
+
     def _forward_predict(self, name: str, body: dict) -> Tuple[int, dict]:
         self._m_requests.inc()
+        self.retry_budget.deposit()
+        self.hedge_budget.deposit()
         t0 = time.perf_counter()
         rows = body.get("rows")
         # a flat 1-D body is ONE row of n_features (ServingApp reshapes
@@ -465,47 +894,96 @@ class FleetRouter:
         # serving replica look features-times busier than it is
         nrows = (len(rows) if isinstance(rows, list) and rows
                  and isinstance(rows[0], (list, tuple)) else 1)
+        # deadline budget: the client's deadline_ms (or the router's
+        # default) pins an ABSOLUTE deadline at entry; every hop below
+        # works with what remains of it
+        deadline_ms = body.get("deadline_ms", None)
+        if deadline_ms is None and self.default_deadline_ms > 0:
+            deadline_ms = self.default_deadline_ms
+        deadline_t = (None if deadline_ms is None
+                      else t0 + float(deadline_ms) / 1e3)
         attempts = 0
         candidates = self._ranked()
-        tried = set()
+        tried: set = set()
+        race_retried: set = set()
         last_err: Optional[str] = None
         while candidates:
+            remaining = (None if deadline_t is None
+                         else deadline_t - time.perf_counter())
+            if remaining is not None and remaining <= 0:
+                # refuse at the router: forwarding an already-dead
+                # request would spend replica admission + device time on
+                # an answer nobody is waiting for
+                self._m_deadline.inc()
+                return 504, {"error": "deadline exceeded at router "
+                                      f"(budget {float(deadline_ms):g}ms, "
+                                      f"attempts {attempts})"}
             idx = candidates[0]
             tried.add(idx)
-            rep = self._replicas[idx]
+            token_spent = False
+            if attempts > 0:
+                if not self.retry_budget.try_spend():
+                    # brownout backpressure: no token for another attempt
+                    # — an honest 503 now beats amplifying the overload
+                    self._m_retry_denied.inc()
+                    return 503, {"error": "retry budget exhausted; last: "
+                                          f"{last_err}"}
+                token_spent = True
             attempts += 1
-            with self._lock:
-                rep.router_inflight_rows += nrows
-            try:
-                status, payload = rep.endpoint.request(
-                    "POST", f"/v1/models/{name}:predict", body,
-                    timeout_s=self.request_timeout_s)
-            except ReplicaTransportError as exc:
-                self._mark_down(idx, str(exc))
-                last_err = str(exc)
+            timeout_s = (self.request_timeout_s if remaining is None
+                         else min(self.request_timeout_s, remaining))
+            fwd_body = body
+            if remaining is not None:
+                # each hop forwards the REMAINING budget, so the
+                # replica's admission check (serving/batcher.py) and its
+                # HTTP read timeout both derive from what is actually
+                # left, not the client's original figure
+                fwd_body = dict(body)
+                fwd_body["deadline_ms"] = remaining * 1e3
+            outcomes = self._attempt_maybe_hedged(
+                idx, name, fwd_body, nrows, timeout_s, tried, deadline_t)
+            decisive = next(
+                (o for o in outcomes
+                 if o[1] is not None and not _retryable(o[1])), None)
+            if decisive is not None:
+                served_idx, status, payload = decisive
+                elapsed = time.perf_counter() - t0
+                self.latency.observe(elapsed)
+                self._m_latency.observe(elapsed)
+                self._m_forwarded[served_idx].inc()
+                if isinstance(payload, dict):
+                    payload.setdefault(
+                        "replica", self._replicas[served_idx].endpoint.name)
+                    if attempts > 1:
+                        payload.setdefault("rerouted", attempts - 1)
+                    if served_idx != idx:
+                        # served by the hedge duplicate, not a reroute —
+                        # "rerouted: 0" here would be misleading noise
+                        payload.setdefault("hedged", True)
+                return status, payload
+            for _, st, pl in outcomes:
+                last_err = (pl.get("error", f"replica status {st}")
+                            if isinstance(pl, dict)
+                            else f"replica status {st}")
+            if all(isinstance(pl, dict) and pl.get("breaker_race")
+                   for _, _, pl in outcomes):
+                # nothing was actually attempted (lost half-open probe
+                # races): moving to the next candidate is not a retry —
+                # hand the token back, don't count a reroute, and give
+                # each race-lost replica ONE second chance in this
+                # request's candidate set (a freed probe slot moments
+                # later may be its only live peer; the once-only cap
+                # keeps the loop terminating)
+                if token_spent:
+                    self.retry_budget.refund()
+                attempts -= 1
+                for i, _, pl in outcomes:
+                    if pl.get("breaker_race") and i not in race_retried:
+                        race_retried.add(i)
+                        tried.discard(i)
+            else:
                 self._m_reroutes.inc()
-                candidates = [i for i in self._ranked() if i not in tried]
-                continue
-            finally:
-                with self._lock:
-                    rep.router_inflight_rows -= nrows
-            if status == 429 or status >= 500:
-                # 429: the replica's own bounded queue overflowed between
-                # polls; 5xx: it is draining for shutdown/restart — both
-                # are load to reroute, not errors to forward
-                last_err = payload.get("error", f"replica status {status}")
-                self._m_reroutes.inc()
-                candidates = [i for i in self._ranked() if i not in tried]
-                continue
-            elapsed = time.perf_counter() - t0
-            self.latency.observe(elapsed)
-            self._m_latency.observe(elapsed)
-            self._m_forwarded[idx].inc()
-            if isinstance(payload, dict):
-                payload.setdefault("replica", rep.endpoint.name)
-                if attempts > 1:
-                    payload.setdefault("rerouted", attempts - 1)
-            return status, payload
+            candidates = [i for i in self._ranked() if i not in tried]
         if last_err is None:
             # nothing was routable to begin with: SLO shedding
             self._m_shed.inc()
@@ -526,7 +1004,22 @@ class FleetRouter:
         REACHABLE replica succeeded.  A PARTIAL publish (some 200s, some
         refusals) rolls the successes back — the fleet must never
         silently serve mixed versions — and bumps
-        ``lgbm_fleet_publish_partial_total``."""
+        ``lgbm_fleet_publish_partial_total``.
+
+        Publishes ride an idempotent ``publish_token`` (minted here when
+        the caller didn't supply one): a replica's registry remembers the
+        token it applied and replays the same version for a duplicate, so
+        (a) ``HttpReplica``'s stale-conn retry is safe for publishes,
+        (b) an UNKNOWN outcome (socket timeout on a live replica — the
+        publish may or may not have landed) can be RESOLVED by re-sending
+        the identical request instead of being stuck unknowable, and
+        (c) the rejoin replay can never double-apply to a replica that
+        already has the version."""
+        if verb == "publish":
+            body = dict(body or {})
+            if not body.get("publish_token"):
+                body["publish_token"] = uuid.uuid4().hex
+
         def _one(rep):
             try:
                 status, payload = rep.endpoint.request(
@@ -566,6 +1059,46 @@ class FleetRouter:
                 results[rep.endpoint.name] = {
                     "status": -1,
                     "error": "publish still in flight (timed out)"}
+        if verb == "publish":
+            # UNKNOWN-outcome resolution: a timed-out publish on a live
+            # replica may or may not have landed.  The token makes the
+            # identical re-send safe either way (already landed → the
+            # registry replays the same version; never landed → it
+            # applies now), so one resolution round turns most UNKNOWNs
+            # into a definite success/refusal; a replica that times out
+            # AGAIN stays -1 and fails the broadcast as before.
+            unknown = [rep for rep in self._replicas
+                       if results[rep.endpoint.name]["status"] == -1]
+            if unknown:
+                log_warning(
+                    f"fleet: publish of {name!r} has {len(unknown)} "
+                    f"unknown outcome(s); re-sending idempotently to "
+                    f"resolve")
+                # fresh threads, NOT the broadcast pool: round one's
+                # workers may still be wedged on the very sends being
+                # resolved (a slow-dripping replica holds its worker up
+                # to ~2x request_timeout_s), and a resolution queued
+                # behind them would time out without ever starting.
+                # Rare path (partial publishes), so ad-hoc threads over
+                # pooled connections are fine
+                resolved_map: Dict[str, Dict] = {}
+
+                def _resolve(rep):
+                    resolved_map[rep.endpoint.name] = _one(rep)
+
+                threads = [threading.Thread(target=_resolve, args=(rep,),
+                                            daemon=True,
+                                            name="lgbm-tpu-fleet-resolve")
+                           for rep in unknown]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(2.0 * self.request_timeout_s + 10.0)
+                for rep in unknown:
+                    resolved = resolved_map.get(rep.endpoint.name)
+                    if resolved is not None and resolved["status"] != -1:
+                        resolved["resolved_by_token_resend"] = True
+                        results[rep.endpoint.name] = resolved
         ok = sum(r["status"] == 200 for r in results.values())
         reachable = [r for r in results.values() if r["status"] != 0]
         all_ok = bool(reachable) and all(r["status"] == 200
@@ -639,16 +1172,30 @@ class FleetRouter:
 
     # ------------------------------------------------------------------
     def replica_states(self) -> Dict[str, Dict]:
+        sup = self.supervisor
         with self._lock:
-            return {
-                rep.endpoint.name: {
+            out = {}
+            for i, rep in enumerate(self._replicas):
+                p50 = rep.digest.quantile(0.5)
+                entry = {
                     "state": rep.slo.state,
                     "load_rows": rep.load_rows,
                     "reasons": list(rep.slo.last_reasons),
                     "transitions": rep.slo.transitions,
+                    "breaker": rep.breaker.snapshot(),
+                    "latency_p50_ms": (None if p50 is None
+                                       else round(p50 * 1e3, 3)),
+                    "queue_wait_ms": round(rep.queue_wait_ms, 3),
                 }
-                for rep in self._replicas
-            }
+                if sup is not None and i < len(sup.replicas):
+                    # supervision visibility: an abandoned slot (restart
+                    # budget spent) looks identical to plain "down" from
+                    # the routing side, but an operator must see the
+                    # difference — down heals itself, abandoned never
+                    entry["abandoned"] = bool(sup.replicas[i].gave_up)
+                    entry["restarts"] = int(sup.replicas[i].restarts)
+                out[rep.endpoint.name] = entry
+            return out
 
     def handle(self, method: str, path: str,
                body: Optional[dict] = None) -> Tuple[int, dict]:

@@ -69,9 +69,16 @@ class FleetSupervisor:
                  env: Optional[Dict[str, str]] = None,
                  fault_env: Optional[Dict[int, Dict[str, str]]] = None,
                  log_dir: Optional[str] = None,
-                 max_restarts: int = 2, restart_backoff_s: float = 0.5):
+                 max_restarts: int = 2, restart_backoff_s: float = 0.5,
+                 metrics_registry=None):
         self.make_argv = make_argv
         self.host = host
+        # abandoned slots were previously ONLY a log line — invisible to
+        # anything that doesn't tail logs.  They land in a counter on the
+        # given registry (the router's, when serve_fleet wires it) or the
+        # process-global telemetry REGISTRY, and the router additionally
+        # surfaces per-slot abandoned state on GET /v1/fleet/replicas
+        self.metrics_registry = metrics_registry
         self.env = dict(env or os.environ)
         self.fault_env = dict(fault_env or {})   # idx -> env overlay
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="lgbm_tpu_fleet_")
@@ -171,6 +178,7 @@ class FleetSupervisor:
                 # first sight of this corpse: schedule the respawn
                 if rep.restarts >= self.max_restarts:
                     rep.gave_up = True
+                    self._count_abandoned(rep)
                     log_warning(
                         f"fleet: replica {rep.idx} died (rc={rc}) and its "
                         f"restart budget ({self.max_restarts}) is spent; "
@@ -187,6 +195,23 @@ class FleetSupervisor:
                 rep.restarts += 1
                 rep.next_spawn_at = 0.0
                 self._spawn(rep)
+
+    def _count_abandoned(self, rep: ReplicaProc) -> None:
+        try:
+            from ..telemetry.registry import REGISTRY
+            reg = (self.metrics_registry if self.metrics_registry is not None
+                   else REGISTRY)
+            reg.counter(
+                "lgbm_fleet_replica_abandoned_total",
+                "replica slots abandoned after their restart budget",
+                replica=f"{self.host}:{rep.port}").inc()
+        except Exception as exc:   # metrics must never break supervision
+            log_warning(f"fleet: abandoned-slot counter failed: {exc!r}")
+
+    @property
+    def abandoned(self) -> List[int]:
+        """Indices of slots whose restart budget is spent."""
+        return [rep.idx for rep in self.replicas if rep.gave_up]
 
     def start_watching(self, interval_s: float = 0.2):
         """Run watch() on a daemon thread until stop_all()."""

@@ -20,12 +20,13 @@ a production inference service:
   ``python -m lightgbm_tpu.serving model=path`` runs it end to end.
 """
 
-from .batcher import MicroBatcher, QueueFullError, ServingClosedError
+from .batcher import (DeadlineExceededError, MicroBatcher, QueueFullError,
+                      ServingClosedError)
 from .compiled import CompiledPredictor
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 from .server import ServingApp, make_server, serve
 
 __all__ = ["CompiledPredictor", "MicroBatcher", "QueueFullError",
-           "ServingClosedError", "ModelRegistry", "ServingMetrics",
-           "ServingApp", "make_server", "serve"]
+           "ServingClosedError", "DeadlineExceededError", "ModelRegistry",
+           "ServingMetrics", "ServingApp", "make_server", "serve"]

@@ -29,7 +29,19 @@ The batcher is a bounded queue plus one flush worker:
 - admission control is a hard row bound: when ``max_queue_rows`` worth of
   requests are already waiting, ``submit`` raises ``QueueFullError``
   immediately instead of growing the queue without bound (backpressure the
-  caller can act on, rather than a latency collapse or OOM later).
+  caller can act on, rather than a latency collapse or OOM later);
+- **deadline admission**: a request may carry an absolute deadline
+  (``deadline_t``, perf_counter seconds — the HTTP layer converts the
+  remaining ``deadline_ms`` budget a router forwarded).  Admission
+  refuses with ``DeadlineExceededError`` (HTTP 504) when the deadline is
+  already spent OR when the recent queue-wait evidence says the request
+  cannot clear the queue in time, and a queued request whose deadline
+  expires before its batch launches is dropped at take-time — device
+  time is never spent computing an answer nobody is waiting for.  Every
+  admitted request's actual queue wait feeds the
+  ``lgbm_serving_queue_wait_ms`` histogram, which is both the admission
+  estimate's source and a replica gauge the fleet router's routing score
+  reads.
 
 Because all requests in a flush go through ONE ``CompiledPredictor.predict``
 call and tree traversal is row-independent, coalescing is invisible in the
@@ -49,7 +61,8 @@ import numpy as np
 from ..log import LightGBMError
 from ..timer import timed
 
-__all__ = ["MicroBatcher", "QueueFullError", "ServingClosedError"]
+__all__ = ["DeadlineExceededError", "MicroBatcher", "QueueFullError",
+           "ServingClosedError"]
 
 _NO_META = object()  # sentinel: predictor returned a bare array (no meta)
 
@@ -64,13 +77,22 @@ class ServingClosedError(LightGBMError):
     client-error 4xx."""
 
 
-class _Request:
-    __slots__ = ("rows", "future", "t_enqueue")
+class DeadlineExceededError(LightGBMError):
+    """The request's deadline budget ran out before (or while) it was
+    queued — mapped to HTTP 504.  Raised at ADMISSION when the remaining
+    budget cannot plausibly cover the current queue wait, and set on a
+    queued request's future when its deadline expires before its batch
+    launches; either way the device never runs for it."""
 
-    def __init__(self, rows: np.ndarray):
+
+class _Request:
+    __slots__ = ("rows", "future", "t_enqueue", "deadline_t")
+
+    def __init__(self, rows: np.ndarray, deadline_t: Optional[float] = None):
         self.rows = rows
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        self.deadline_t = deadline_t
 
 
 class MicroBatcher:
@@ -119,7 +141,7 @@ class MicroBatcher:
                 self._thread.start()
         return self
 
-    def submit(self, rows) -> Future:
+    def submit(self, rows, deadline_t: Optional[float] = None) -> Future:
         """Enqueue one request; the Future resolves to its predictions.
 
         Raises QueueFullError when the request won't fit behind what's
@@ -127,9 +149,26 @@ class MicroBatcher:
         larger than max_queue_rows — otherwise an oversized request would
         be rejected forever no matter how often the caller retries; this
         way it degrades to a solo flush instead (the bound still caps
-        growth: at most one oversized request is ever queued)."""
+        growth: at most one oversized request is ever queued).
+
+        ``deadline_t`` (absolute perf_counter seconds) is the request's
+        deadline: admission raises DeadlineExceededError when the budget
+        is already spent, or when the remaining budget is under the
+        recent queue-wait median — a request that (on current evidence)
+        cannot clear the queue in time is refused NOW, at zero device
+        cost, instead of timing out after occupying a batch slot."""
         rows = np.atleast_2d(np.asarray(rows))
         n = rows.shape[0]
+        if deadline_t is not None:
+            remaining = deadline_t - time.perf_counter()
+            wait_est = (self.metrics.queue_wait_estimate_s()
+                        if self.metrics is not None else 0.0)
+            if remaining <= 0 or remaining < wait_est:
+                if self.metrics is not None:
+                    self.metrics.record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline refused at admission: {remaining * 1e3:.1f}"
+                    f"ms remaining vs ~{wait_est * 1e3:.1f}ms queue wait")
         with self._lock:
             if self._closed:
                 raise ServingClosedError("MicroBatcher is closed")
@@ -140,7 +179,7 @@ class MicroBatcher:
                     f"serving queue full: {self._queued_rows} rows waiting, "
                     f"request of {n} exceeds max_queue_rows="
                     f"{self.max_queue_rows}")
-            req = _Request(rows)
+            req = _Request(rows, deadline_t)
             self._q.append(req)
             self._queued_rows += n
             if self.metrics is not None:
@@ -148,9 +187,10 @@ class MicroBatcher:
             self._wake.notify()
         return req.future
 
-    def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, rows, timeout: Optional[float] = None,
+                deadline_t: Optional[float] = None) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
-        return self.submit(rows).result(timeout)
+        return self.submit(rows, deadline_t=deadline_t).result(timeout)
 
     @property
     def queue_depth(self) -> int:
@@ -196,17 +236,46 @@ class MicroBatcher:
                 # close(drain=False) landed while waiting out max_wait_ms:
                 # the backlog belongs to close()'s cancel loop, not us
                 return None
-            batch, rows = [], 0
-            while self._q and (not batch
-                               or rows + self._q[0].rows.shape[0]
-                               <= self.max_batch):
-                req = self._q.popleft()
+            batch, expired, rows = [], [], 0
+            now = time.perf_counter()
+            while self._q:
+                req = self._q[0]
+                dead = (req.deadline_t is not None
+                        and now >= req.deadline_t)
+                # expiry checked BEFORE capacity: dropping an expired
+                # request consumes no batch space, so an oversized
+                # expired head must not stall the live requests behind it
+                if (not dead and batch
+                        and rows + req.rows.shape[0] > self.max_batch):
+                    break
+                self._q.popleft()
+                self._queued_rows -= req.rows.shape[0]
+                if dead:
+                    # expired while queued: dropped HERE, before the
+                    # device sees the batch — its waiter gets 504, the
+                    # device never runs for it
+                    expired.append(req)
+                    continue
                 rows += req.rows.shape[0]
                 batch.append(req)
-            self._queued_rows -= rows
             if self.metrics is not None:
                 self.metrics.record_queue(self._queued_rows)
-            return batch
+        for req in expired:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(DeadlineExceededError(
+                    "deadline expired while queued "
+                    f"({(now - req.t_enqueue) * 1e3:.1f}ms in queue)"))
+            if self.metrics is not None:
+                self.metrics.record_deadline_refusal()
+                self.metrics.record_request(req.rows.shape[0], error=True)
+        if self.metrics is not None:
+            # expired requests' waits count too — they are the LONGEST
+            # waits, and an estimate built only from survivors would
+            # read low exactly when deadlines are being missed, keeping
+            # admission open for more doomed work
+            for req in batch + expired:
+                self.metrics.record_queue_wait(now - req.t_enqueue)
+        return batch
 
     def _flush(self, batch) -> None:
         t0 = time.perf_counter()
@@ -281,6 +350,8 @@ class MicroBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
+            if not batch:     # every popped request had expired: no flush
+                continue
             self._flush(batch)
             with self._lock:
                 self._last_flush_end = time.perf_counter()
@@ -308,7 +379,21 @@ class MicroBatcher:
                     break
                 req = self._q.popleft()
                 self._queued_rows -= req.rows.shape[0]
-            if drain:
+            if (req.deadline_t is not None
+                    and time.perf_counter() >= req.deadline_t):
+                # the drain must honor deadlines too: flushing an
+                # expired request at shutdown would spend device time on
+                # an answer nobody is waiting for and hand the waiter a
+                # late 200 instead of its 504
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(DeadlineExceededError(
+                        "deadline expired while queued (drained at "
+                        "close)"))
+                if self.metrics is not None:
+                    self.metrics.record_deadline_refusal()
+                    self.metrics.record_request(req.rows.shape[0],
+                                                error=True)
+            elif drain:
                 self._flush([req])
             else:
                 req.future.cancel()

@@ -46,22 +46,49 @@ class LatencyWindow:
 
     A fixed window keeps memory constant under sustained traffic while
     still tracking the current latency distribution; serving dashboards
-    care about "now", not the all-time distribution."""
+    care about "now", not the all-time distribution.
 
-    def __init__(self, capacity: int = 4096):
+    ``window_s`` additionally bounds the evidence in TIME: percentile
+    reads ignore samples older than that.  Count-bounded alone is wrong
+    for anything that gates admission — a burst's congestion evidence
+    would otherwise sit in the ring forever once traffic stops (nothing
+    new displaces it) and an idle replica would keep refusing
+    deadline-carrying work on stale history.
+
+    fleet/breaker.py's ``LatencyDigest`` is the router-side sibling —
+    same sliding-window idea, different contract: it answers a single
+    quantile with an explicit None below min_samples (routing treats
+    "no evidence" as neutral weight), while this window answers the
+    dashboard percentile dict with honest zeros.  Folding them into one
+    primitive is possible (fleet already imports serving.metrics) and
+    is the move if either grows again."""
+
+    def __init__(self, capacity: int = 4096,
+                 window_s: Optional[float] = None):
         self._cap = int(capacity)
         self._buf = [0.0] * self._cap
+        self._t = [0.0] * self._cap
+        self.window_s = window_s
         self._n = 0          # total observations ever
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
         with self._lock:
-            self._buf[self._n % self._cap] = float(seconds)
+            idx = self._n % self._cap
+            self._buf[idx] = float(seconds)
+            self._t[idx] = time.monotonic()
             self._n += 1
 
     def percentiles(self) -> Dict[str, float]:
         with self._lock:
-            live = sorted(self._buf[:min(self._n, self._cap)])
+            k = min(self._n, self._cap)
+            if self.window_s is None:
+                live = sorted(self._buf[:k])
+            else:
+                horizon = time.monotonic() - self.window_s
+                live = sorted(v for v, t in zip(self._buf[:k],
+                                                self._t[:k])
+                              if t >= horizon)
         if not live:
             return {f"p{int(p)}_ms": 0.0 for p in _PCTS}
         out = {}
@@ -119,6 +146,17 @@ class ModelMetrics:
         self._queue_rejections = reg.counter(
             "lgbm_serving_queue_rejections_total",
             "requests rejected by queue backpressure", **lab)
+        self._deadline_refused = reg.counter(
+            "lgbm_serving_deadline_refused_total",
+            "requests refused 504 because their deadline budget could "
+            "not cover the queue (at admission or while queued) — "
+            "refused BEFORE any device dispatch", **lab)
+        self._queue_wait_hist = reg.histogram(
+            "lgbm_serving_queue_wait_ms",
+            "milliseconds a request spent in the micro-batch queue "
+            "before its batch launched",
+            buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000,
+                     2000, 5000), **lab)
         self._latency_hist = reg.histogram(
             "lgbm_serving_request_latency_seconds",
             "user-facing request latency", **lab)
@@ -126,6 +164,14 @@ class ModelMetrics:
             "lgbm_serving_compile_count", "XLA programs compiled for this "
             "model (all versions)", **lab)
         self.latency = LatencyWindow()
+        # recent queue waits (seconds): the admission check's evidence —
+        # bounded in COUNT and TIME (not the all-time histogram), because
+        # "can this request clear the queue in time" is a question about
+        # NOW: a drained burst's 300ms waits must age out rather than
+        # make an idle replica 504 sub-300ms budgets forever (refusals
+        # record no new waits, so the window would never refresh itself)
+        self.queue_wait = LatencyWindow(512, window_s=30.0)
+        self._queue_wait_cache = (-1e18, 0.0)   # (monotonic t, estimate)
         self.last_active_s = 0.0   # wall time of the last user request
         # keeps the batch triple (batches, batched_requests, batched_rows)
         # mutually consistent between record_batch and the ratio reads in
@@ -204,6 +250,32 @@ class ModelMetrics:
     def record_queue(self, depth: int) -> None:
         self._queue_depth.set(int(depth))
 
+    def record_queue_wait(self, seconds: float) -> None:
+        """One admitted request's time-in-queue, at batch take."""
+        self.queue_wait.observe(seconds)
+        self._queue_wait_hist.observe(float(seconds) * 1e3)
+
+    def queue_wait_estimate_s(self) -> float:
+        """Median of the recent queue waits (0.0 with no evidence): what
+        the deadline admission check compares a remaining budget to.
+        Cached briefly — admission runs per submit, and sorting the
+        window each time recomputes a value that moves at flush cadence
+        (a 50ms-stale estimate is well inside its own noise)."""
+        now = time.monotonic()
+        t, v = self._queue_wait_cache
+        if now - t < 0.05:
+            return v
+        v = self.queue_wait.percentiles()["p50_ms"] / 1e3
+        self._queue_wait_cache = (now, v)
+        return v
+
+    def record_deadline_refusal(self) -> None:
+        self._deadline_refused.inc()
+
+    @property
+    def deadline_refused(self) -> int:
+        return int(self._deadline_refused.value)
+
     def record_inflight(self, rows: int) -> None:
         self._inflight_rows.set(int(rows))
 
@@ -224,6 +296,9 @@ class ModelMetrics:
             "device_rows": self.device_rows,
             "queue_depth": self.queue_depth,
             "queue_rejections": self.queue_rejections,
+            "deadline_refused": self.deadline_refused,
+            "queue_wait_p50_ms": round(
+                self.queue_wait.percentiles()["p50_ms"], 3),
             "inflight_rows": int(self._inflight_rows.value),
             "batch_fill": round(float(self._batch_fill.value), 4),
             # >1 means the micro-batcher is actually coalescing:
@@ -285,8 +360,8 @@ class ServingMetrics:
         with self._lock:
             models = list(self._models.items())
         out = {"queue_rows": 0, "inflight_rows": 0, "p99_ms": 0.0,
-               "batch_fill": 0.0, "requests": 0, "errors": 0,
-               "queue_rejections": 0, "boot_s": self.boot_s}
+               "batch_fill": 0.0, "queue_wait_ms": 0.0, "requests": 0,
+               "errors": 0, "queue_rejections": 0, "boot_s": self.boot_s}
         now = time.time()
         for name, m in models:
             out["queue_rows"] += m.queue_depth
@@ -299,6 +374,12 @@ class ServingMetrics:
                                     m.latency.percentiles()["p99_ms"])
                 out["batch_fill"] = max(out["batch_fill"],
                                         float(m._batch_fill.value))
+                # recent median queue wait (worst recently-active model):
+                # the router folds it into its routing score, alongside
+                # its own observed data-path latency digest
+                out["queue_wait_ms"] = max(
+                    out["queue_wait_ms"],
+                    m.queue_wait.percentiles()["p50_ms"])
             out["requests"] += m.requests
             out["errors"] += m.errors
             out["queue_rejections"] += m.queue_rejections

@@ -46,7 +46,7 @@ class _Entry:
 
 class _Model:
     __slots__ = ("versions", "current", "previous", "next_version",
-                 "history")
+                 "history", "tokens")
 
     def __init__(self):
         self.versions: Dict[int, _Entry] = {}
@@ -57,6 +57,16 @@ class _Model:
         # gate (or an operator) uses to prove which version served when,
         # and that a bad push really was rolled back
         self.history: List[Dict] = []
+        # publish idempotency: token -> version already minted for it.
+        # A re-sent publish carrying a seen token replays that version
+        # instead of double-applying — what makes a router's stale-conn
+        # retry and UNKNOWN-outcome (timed-out) re-send safe.  Bounded
+        # (insertion order, oldest evicted): a token only needs to
+        # survive the retry window of its own broadcast
+        self.tokens: Dict[str, int] = {}
+
+
+_MAX_PUBLISH_TOKENS = 16
 
 
 class ModelRegistry:
@@ -72,7 +82,8 @@ class ModelRegistry:
                 model_str: Optional[str] = None,
                 model_file: Optional[str] = None,
                 warmup: bool = True,
-                aot_bundle_dir: Optional[str] = None) -> int:
+                aot_bundle_dir: Optional[str] = None,
+                token: Optional[str] = None) -> int:
         """Install a new version of `name` and make it current.
 
         Exactly one model source must be given.  With warmup=True (the
@@ -82,7 +93,26 @@ class ModelRegistry:
         AOT bundle FIRST (lightgbm_tpu/aot/, task=precompile), so a cold
         replica warms by deserializing instead of compiling; warmup then
         only compiles whatever the bundle didn't cover.
+
+        ``token`` makes the publish idempotent: a token this registry
+        already applied returns the version it minted then — nothing is
+        rebuilt, republished, or retired — so a caller whose first send
+        had an UNKNOWN outcome (socket timeout) can safely re-send.
         Returns the published version number."""
+        if token:
+            with self._lock:
+                model = self._models.get(name)
+                # a known token replays the version it minted, even when
+                # a NEWER publish has since superseded it — the re-send's
+                # publish genuinely was applied (as that version), and
+                # re-installing it now would resurrect the old model OVER
+                # the newer one on this replica alone.  Tokens whose
+                # version was WITHDRAWN (rollback/unpublish — the
+                # partial-publish undo) are deleted there, so their
+                # re-send falls through to a real re-publish instead of
+                # answering "success" while serving something else.
+                if model is not None and token in model.tokens:
+                    return model.tokens[token]
         sources = [s for s in (booster, predictor, model_str, model_file)
                    if s is not None]
         if len(sources) != 1:
@@ -105,8 +135,16 @@ class ModelRegistry:
             model = self._models.get(name)
             if model is None:
                 model = self._models[name] = _Model()
+            if token and token in model.tokens:
+                # a concurrent duplicate won the race while we were
+                # building the predictor: replay its version, discard ours
+                return model.tokens[token]
             version = model.next_version
             model.next_version += 1
+            if token:
+                model.tokens[token] = version
+                while len(model.tokens) > _MAX_PUBLISH_TOKENS:
+                    model.tokens.pop(next(iter(model.tokens)))
             model.versions[version] = _Entry(predictor, version)
             # retire the old "previous"; keep the old "current" for rollback
             if model.previous is not None:
@@ -126,6 +164,12 @@ class ModelRegistry:
             if model.previous is None:
                 raise LightGBMError(
                     f"model {name!r} has no previous version to roll back to")
+            # the rolled-back version's publish tokens are WITHDRAWN: a
+            # token re-send after this must re-install for real (peers
+            # applying the same retry expect it to land), not replay a
+            # "success" for a version deliberately taken out of service
+            model.tokens = {t: v for t, v in model.tokens.items()
+                            if v != model.current}
             model.current, model.previous = model.previous, model.current
             model.history.append({"action": "rollback",
                                   "version": model.current,

@@ -46,7 +46,8 @@ import numpy as np
 
 from ..checkpoint.fault import RequestFaultLatch
 from ..log import LightGBMError
-from .batcher import MicroBatcher, QueueFullError, ServingClosedError
+from .batcher import (DeadlineExceededError, MicroBatcher, QueueFullError,
+                      ServingClosedError)
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 
@@ -87,10 +88,16 @@ class ServingApp:
                  metrics: Optional[ServingMetrics] = None,
                  max_batch: int = 1024, max_wait_ms: float = 2.0,
                  max_queue_rows: int = 16384, batching: bool = True,
-                 continuous: bool = True):
+                 continuous: bool = True,
+                 default_deadline_ms: float = 0.0):
         self.metrics = metrics or ServingMetrics()
         self.registry = registry or ModelRegistry(metrics=self.metrics)
         self.batching = batching
+        # deadline a predict gets when its body carries none (0 = no
+        # default: such requests wait as long as they must).  A router
+        # in front always forwards an explicit remaining budget, so this
+        # only governs direct traffic
+        self.default_deadline_ms = float(default_deadline_ms)
         self._batch_cfg = dict(max_batch=max_batch, max_wait_ms=max_wait_ms,
                                max_queue_rows=max_queue_rows,
                                continuous=continuous)
@@ -149,6 +156,11 @@ class ServingApp:
                                body or {})
         except QueueFullError as exc:
             return 429, {"error": str(exc)}
+        except DeadlineExceededError as exc:
+            # deadline budget spent before the device ran: 504, which the
+            # fleet router may retry on an idler peer while the CLIENT's
+            # budget still has time left
+            return 504, {"error": str(exc)}
         except ServingClosedError as exc:
             # a request that raced past the closed check into a closing
             # batcher is still a shutdown refusal, not a 4xx
@@ -243,7 +255,12 @@ class ServingApp:
             warmup=bool(body.get("warmup", True)),
             # hot-swaps can ship their AOT bundle too, so a fleet-wide
             # publish warms every replica by deserializing, not compiling
-            aot_bundle_dir=body.get("aot_bundle_dir"))
+            aot_bundle_dir=body.get("aot_bundle_dir"),
+            # idempotency: a token the registry has already applied
+            # replays the SAME version instead of minting a new one, so
+            # the router's stale-conn retries and unknown-outcome
+            # re-sends can never double-publish
+            token=body.get("publish_token"))
         return 200, {"name": name, "version": version}
 
     def _predict(self, name: str, body: dict) -> Tuple[int, dict]:
@@ -257,6 +274,28 @@ class ServingApp:
         if rows.ndim != 2:
             raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
         t0 = time.perf_counter()
+        # deadline budget: the remaining milliseconds this request may
+        # spend here (a fleet router forwards what's left of the client's
+        # budget).  Converted to an ABSOLUTE perf_counter deadline at
+        # entry so queue time counts against it; the batcher refuses at
+        # admission / drops at take when it cannot be met (504)
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None and self.default_deadline_ms > 0:
+            # two-step (same as the router): an explicit JSON null must
+            # not bypass the operator's default
+            deadline_ms = self.default_deadline_ms
+        deadline_t = None
+        if deadline_ms is not None:
+            deadline_t = t0 + float(deadline_ms) / 1e3   # non-numeric: 400
+            if float(deadline_ms) <= 0:
+                # unknown names still 404 BEFORE any metrics allocation
+                # (same invariant as _batcher: sustained typo'd traffic
+                # must not mint an unbounded ModelMetrics per name)
+                self.registry.current_version(name)
+                self.metrics.model(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget already spent "
+                    f"({float(deadline_ms):g}ms)")
         kwargs = {}
         for key in ("start_iteration", "num_iteration"):
             if key in body:
@@ -279,8 +318,19 @@ class ServingApp:
                 raise LightGBMError(
                     f"predict called with {rows.shape[1]} features; model "
                     f"{name!r} expects {nfeat}")
-            out, served_version = batcher.predict(rows)
+            out, served_version = batcher.predict(rows,
+                                                  deadline_t=deadline_t)
         else:
+            # the non-batched path has no queue, but the deadline still
+            # gates DISPATCH: a pinned-version/sliced predict whose
+            # budget is already spent must not get device time either
+            if (deadline_t is not None
+                    and time.perf_counter() >= deadline_t):
+                self.registry.current_version(name)   # 404 before metrics
+                self.metrics.model(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget ({float(deadline_ms):g}ms) spent "
+                    "before dispatch")
             with self.registry.acquire(name, version) as (pred, v):
                 out = pred.predict(rows, **kwargs)
                 served_version = v

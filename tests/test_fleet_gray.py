@@ -1,0 +1,872 @@
+"""Gray-failure hardening tests (fleet/breaker.py, fleet/chaosnet.py,
+deadline propagation, hedged requests, retry budgets, publish tokens).
+
+Everything here is tier-1 and wall-clock-free by construction: the
+breaker/digest state machines run on injected clocks, chaosnet faults run
+on an injected sleep, hedge/budget decisions are observed through events
+and counters — no test sleeps its way to an assertion.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import ChaosReplica, FleetRouter, FleetSupervisor
+from lightgbm_tpu.fleet.breaker import (CircuitBreaker, LatencyDigest,
+                                        RetryBudget)
+from lightgbm_tpu.fleet.router import ReplicaTransportError
+from lightgbm_tpu.fleet.slo import SLOPolicy
+from lightgbm_tpu.serving import DeadlineExceededError, ServingApp
+from lightgbm_tpu.serving.batcher import MicroBatcher
+from lightgbm_tpu.serving.metrics import ModelMetrics
+from lightgbm_tpu.serving.registry import ModelRegistry
+from lightgbm_tpu.telemetry.registry import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker (injected clock, no sleeps)
+# ---------------------------------------------------------------------------
+def test_breaker_opens_after_consecutive_failures():
+    clk = FakeClock()
+    b = CircuitBreaker(failures=3, cooldown_s=5.0, probes=2, clock=clk)
+    assert b.state == "closed" and b.admits() and b.try_acquire()
+    b.record_failure()
+    b.record_failure()
+    b.record_success()          # success resets the streak
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()          # third consecutive: open
+    assert b.state == "open" and not b.admits() and not b.try_acquire()
+
+
+def test_breaker_walks_closed_open_half_open_closed():
+    clk = FakeClock()
+    b = CircuitBreaker(failures=2, cooldown_s=5.0, probes=2, clock=clk)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    clk.advance(4.9)
+    assert not b.admits()           # cooldown not elapsed
+    clk.advance(0.2)
+    assert b.admits()               # -> half_open, probes grantable
+    assert b.state == "half_open"
+    # exactly `probes` trial acquisitions, then nothing
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    b.record_success()
+    assert b.state == "half_open"   # one probe is not proof
+    b.record_success()
+    assert b.state == "closed" and b.try_acquire()
+    # the soak's bar, checkable on the history log:
+    walked = [(f, t) for (_, f, t) in b.history]
+    assert walked == [("closed", "open"), ("open", "half_open"),
+                      ("half_open", "closed")]
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clk = FakeClock()
+    b = CircuitBreaker(failures=1, cooldown_s=2.0, probes=2, clock=clk)
+    b.record_failure()
+    clk.advance(2.1)
+    assert b.try_acquire()          # half-open probe
+    b.record_failure()              # probe failed: back to open
+    assert b.state == "open" and not b.admits()
+    clk.advance(2.1)                # a fresh cooldown applies
+    assert b.admits() and b.state == "half_open"
+
+
+def test_breaker_half_open_slots_replenish_on_outcomes():
+    """Probe slots are a CONCURRENCY throttle: a recorded outcome hands
+    its slot back (success counts toward closing; a NEUTRAL outcome —
+    deadline-squeezed timeout, 429/504 — counts toward nothing), so
+    outcome-less-looking attempts can't deadlock the machine half-open
+    with zero grantable probes."""
+    clk = FakeClock()
+    b = CircuitBreaker(failures=1, cooldown_s=1.0, probes=2, clock=clk)
+    b.record_failure()
+    clk.advance(1.1)
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()          # both slots out
+    b.record_neutral()                  # a 504 came back: slot released
+    assert b.try_acquire()              # probing continues
+    b.record_success()
+    assert b.state == "half_open"       # neutral never counted as probe
+    assert b.try_acquire()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_half_open_ignores_stale_pre_open_outcomes():
+    """Review regression: successes from attempts ISSUED BEFORE the
+    breaker opened (a gray replica's slow in-flight backlog, completing
+    through the cooldown) are pre-outage evidence — they must not close
+    a half-open breaker no probe ever re-tested.  Only outcomes carrying
+    the probe grant count."""
+    clk = FakeClock()
+    b = CircuitBreaker(failures=2, cooldown_s=1.0, probes=1, clock=clk)
+    grants = [b.try_acquire(), b.try_acquire()]   # issued while closed
+    assert all(g == CircuitBreaker.GRANT_NORMAL for g in grants)
+    b.record_failure(probe=False)
+    b.record_failure(probe=False)
+    assert b.state == "open"
+    clk.advance(1.1)
+    assert b.admits() and b.state == "half_open"
+    # the old in-flight (non-probe) successes now complete: ignored
+    b.record_success(probe=False)
+    b.record_success(probe=False)
+    assert b.state == "half_open"
+    # a stale failure can't re-open either (it predates the cooldown)
+    b.record_failure(probe=False)
+    assert b.state == "half_open"
+    # only a REAL probe closes it
+    assert b.try_acquire() == CircuitBreaker.GRANT_PROBE
+    b.record_success(probe=True)
+    assert b.state == "closed"
+
+
+def test_breaker_disabled_with_zero_failures():
+    b = CircuitBreaker(failures=0)
+    for _ in range(50):
+        b.record_failure()
+    assert b.state == "closed" and b.admits() and b.try_acquire()
+
+
+# ---------------------------------------------------------------------------
+# LatencyDigest (injected clock)
+# ---------------------------------------------------------------------------
+def test_latency_digest_quantiles_and_staleness():
+    clk = FakeClock()
+    d = LatencyDigest(window_s=10.0, min_samples=5, clock=clk)
+    assert d.quantile(0.5) is None        # no evidence != 0.0
+    for v in (0.01, 0.02, 0.03, 0.04, 0.05, 1.0):
+        d.observe(v)
+    assert d.quantile(0.5) == pytest.approx(0.04)
+    assert d.quantile(0.95) == pytest.approx(1.0)
+    # the window slides: stale samples stop counting, and a drained
+    # replica's digest decays to "no evidence" (router weight -> neutral)
+    clk.advance(11.0)
+    assert d.quantile(0.5) is None
+    d.observe(0.5)
+    assert d.quantile(0.5) is None        # below min_samples again
+
+
+def test_latency_digest_ring_overwrites_oldest():
+    clk = FakeClock()
+    d = LatencyDigest(capacity=4, window_s=100.0, min_samples=2, clock=clk)
+    for v in (1.0, 1.0, 1.0, 1.0, 0.1, 0.1, 0.1, 0.1):
+        d.observe(v)
+    assert d.quantile(0.95) == pytest.approx(0.1)
+    assert d.count == 8
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget
+# ---------------------------------------------------------------------------
+def test_retry_budget_volume_coupling():
+    rb = RetryBudget(ratio=0.1, cap=100.0, initial=2.0)
+    assert rb.try_spend() and rb.try_spend()
+    assert not rb.try_spend()             # initial tokens gone
+    assert rb.denied == 1
+    for _ in range(10):
+        rb.deposit()                      # 10 requests -> 1.0 token
+    assert rb.try_spend()
+    assert not rb.try_spend()             # 10% means 10%
+
+
+def test_retry_budget_refund_and_disabled():
+    rb = RetryBudget(ratio=0.5, initial=1.0)
+    assert rb.try_spend() and rb.tokens == 0.0
+    rb.refund()
+    assert rb.tokens == 1.0 and rb.spent == 0
+    off = RetryBudget(ratio=0.0, initial=0.0)
+    for _ in range(100):
+        assert off.try_spend()            # 0 = unlimited (pre-hardening)
+    assert off.denied == 0
+
+
+# ---------------------------------------------------------------------------
+# Router integration: fakes, no sockets
+# ---------------------------------------------------------------------------
+OK = {"p99_ms": 1.0, "queue_rows": 0, "inflight_rows": 0, "batch_fill": 0.5}
+
+
+def _gauges(**kw):
+    g = dict(OK)
+    g.update(kw)
+    return g
+
+
+class FakeReplica:
+    def __init__(self, name, gauges=None, version=1):
+        self.name = name
+        self.gauges = dict(gauges or OK)
+        self.version = version
+        self.boot = 1.0
+        self.dead = False
+        self.served = 0
+        self.published = []
+        self.bodies = []
+
+    def health(self, timeout_s=2.0):
+        if self.dead:
+            return None
+        g = dict(self.gauges)
+        g.setdefault("boot_s", self.boot)
+        return g
+
+    def request(self, method, path, body=None, timeout_s=None):
+        if self.dead:
+            raise ReplicaTransportError(f"replica {self.name}: dead")
+        if path.endswith(":predict"):
+            self.served += 1
+            self.bodies.append(dict(body or {}))
+            n = len(body["rows"])
+            return 200, {"name": "m", "version": self.version,
+                         "predictions": [float(self.version)] * n}
+        if path.endswith(":publish"):
+            self.version += 1
+            self.published.append(dict(body or {}))
+            return 200, {"name": "m", "version": self.version}
+        return 404, {"error": "no route"}
+
+
+def _router(replicas, **kw):
+    kw.setdefault("policy", SLOPolicy())
+    kw.setdefault("hedge_min_ms", 1.0)
+    return FleetRouter(replicas, poll_interval_ms=0, autostart=False, **kw)
+
+
+def _seed_digest(router, idx, value_s, n=8):
+    for _ in range(n):
+        router._replicas[idx].digest.observe(value_s)
+
+
+def test_router_hedges_slow_primary_and_takes_first_answer():
+    release, entered = threading.Event(), threading.Event()
+
+    class Slow(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                entered.set()
+                assert release.wait(10.0)
+            return super().request(method, path, body, timeout_s)
+
+    a, b = Slow("a"), FakeReplica("b", _gauges(queue_rows=1))
+    r = _router([a, b])
+    r.poll_once()
+    # a has FAST history (hedge delay ~1ms) and ranks first (lower load);
+    # its next request stalls -> the router duplicates to b and answers
+    # from whichever returns first
+    _seed_digest(r, 0, 0.001)
+    try:
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "b"
+        snap = r.registry.snapshot()
+        assert snap["lgbm_fleet_hedges_total"]["_"] == 1
+        assert snap["lgbm_fleet_hedge_wins_total"]["_"] == 1
+        assert snap["lgbm_fleet_errors_total"]["_"] == 0
+        assert entered.is_set() and b.served == 1
+    finally:
+        release.set()
+        r.close()
+
+
+def test_router_hedge_denied_when_budget_spent():
+    release, entered, denied = (threading.Event(), threading.Event(),
+                                threading.Event())
+
+    class NoBudget(RetryBudget):
+        def try_spend(self):
+            denied.set()
+            return False
+
+    class Slow(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                entered.set()
+                assert release.wait(10.0)
+            return super().request(method, path, body, timeout_s)
+
+    a, b = Slow("a"), FakeReplica("b", _gauges(queue_rows=1))
+    r = _router([a, b])
+    r.poll_once()
+    _seed_digest(r, 0, 0.001)
+    r.hedge_budget = NoBudget(ratio=0.01, initial=0.0)
+    out = {}
+
+    def drive():
+        out["resp"] = r.handle("POST", "/v1/models/m:predict",
+                               {"rows": [[0.0]]})
+
+    t = threading.Thread(target=drive)
+    t.start()
+    try:
+        assert entered.wait(10.0)
+        assert denied.wait(10.0)   # hedge decision reached, budget said no
+        release.set()              # primary answers; no duplicate was sent
+        t.join(10.0)
+        status, body = out["resp"]
+        assert status == 200 and body["replica"] == "a"
+        snap = r.registry.snapshot()
+        assert snap["lgbm_fleet_hedges_total"]["_"] == 0
+        assert snap["lgbm_fleet_hedge_denied_total"]["_"] == 1
+        assert b.served == 0       # the budget really suppressed the hedge
+    finally:
+        release.set()
+        r.close()
+
+
+def test_router_retry_budget_exhaustion_is_an_honest_503():
+    class Failing(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                return 500, {"error": "boom"}
+            return super().request(method, path, body, timeout_s)
+
+    a, b = Failing("a"), Failing("b")
+    r = _router([a, b], breaker_failures=0)    # isolate the budget
+    r.poll_once()
+    r.retry_budget = RetryBudget(ratio=0.01, initial=1.0)
+    # request 1: first attempt free, retry spends the only token, both
+    # replicas fail -> plain 503 (errors counter)
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    assert status == 503 and "retry budget" not in body["error"]
+    # request 2: no token for a second attempt -> budget-refusal 503
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    assert status == 503 and "retry budget exhausted" in body["error"]
+    snap = r.registry.snapshot()
+    assert snap["lgbm_fleet_retry_budget_exhausted_total"]["_"] == 1
+    r.close()
+
+
+def test_router_breaker_opens_on_repeated_5xx_and_is_surfaced():
+    class Failing(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                return 500, {"error": "boom"}
+            return super().request(method, path, body, timeout_s)
+
+    bad = Failing("bad")
+    ok = FakeReplica("ok", _gauges(queue_rows=50))   # ranks after bad
+    r = _router([bad, ok], breaker_failures=2, breaker_cooldown_s=3600.0)
+    r.poll_once()
+    for _ in range(2):       # two failures walk the breaker open
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "ok"
+    states = r.replica_states()
+    assert states["bad"]["breaker"]["state"] == "open"
+    # open breaker = out of the ranking: no more attempts land on bad
+    served_before = bad.served
+    for _ in range(4):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "ok"
+    assert bad.served == served_before
+    status, js = r.handle("GET", "/v1/fleet/replicas")
+    assert js["replicas"]["bad"]["breaker"]["state"] == "open"
+    r.close()
+
+
+def test_router_probes_half_open_replica_and_recloses():
+    """A breaker can only close if its half-open probes actually get
+    traffic — and a broken/slow replica never wins the cost ranking on
+    its own, so the router must give probe-needing replicas priority.
+    End to end: failures open the breaker, a probe on the still-broken
+    replica re-opens it (client unharmed — the probe reroutes), and once
+    the replica heals its probe closes the breaker for good."""
+    class Flaky(FakeReplica):
+        healed = False
+
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict") and not self.healed:
+                return 500, {"error": "boom"}
+            return super().request(method, path, body, timeout_s)
+
+    bad, ok = Flaky("bad"), FakeReplica("ok", _gauges(queue_rows=50))
+    r = _router([bad, ok], breaker_failures=2, breaker_cooldown_s=0.0,
+                breaker_probes=1, hedge_quantile=0.0)
+    r.poll_once()
+    for _ in range(2):   # open the breaker
+        assert r.handle("POST", "/v1/models/m:predict",
+                        {"rows": [[0.0]]})[0] == 200
+    # cooldown 0: every subsequent request is offered to bad as a probe
+    # first, fails, re-opens, and reroutes to ok — clients never fail
+    for _ in range(3):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "ok"
+    walked = [(f, t) for (_, f, t) in r._replicas[0].breaker.history]
+    assert ("open", "half_open") in walked
+    bad.healed = True
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]]})
+    assert status == 200 and body["replica"] == "bad"   # the probe
+    assert r.replica_states()["bad"]["breaker"]["state"] == "closed"
+    walked = [(f, t) for (_, f, t) in r._replicas[0].breaker.history]
+    assert walked[-1] == ("half_open", "closed")
+    r.close()
+
+
+def test_router_timeout_breaker_evidence_needs_a_real_allowance():
+    """A timeout under a deadline-squeezed sub-second budget is the
+    DEADLINE's verdict, not the replica's health — it must feed the
+    latency digest (drain) but not the breaker, or an overload storm of
+    impatient clients breaker-opens the whole fleet into a full outage.
+    The same timeout with a generous allowance IS breaker evidence."""
+    class TimingOut(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if path.endswith(":predict"):
+                raise ReplicaTransportError(
+                    f"replica {self.name}: timed out"
+                ) from TimeoutError("read timed out")
+            return super().request(method, path, body, timeout_s)
+
+    a, b = TimingOut("a"), FakeReplica("b", _gauges(queue_rows=50))
+    r = _router([a, b], breaker_failures=2, breaker_cooldown_s=3600.0,
+                hedge_quantile=0.0)
+    r.poll_once()
+    # squeezed budget: timeouts, but no breaker evidence (6 rounds so
+    # the digest crosses its min_samples bar)
+    for _ in range(6):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]], "deadline_ms": 100})
+        assert status == 200 and body["replica"] == "b"
+    assert r.replica_states()["a"]["breaker"]["state"] == "closed"
+    assert r.replica_states()["a"]["state"] == "healthy"  # not marked down
+    # the timeouts DID become latency evidence (the drain signal)
+    assert r.replica_states()["a"]["latency_p50_ms"] is not None
+    r.close()
+    # generous allowance: the same failures open the breaker
+    a2, b2 = TimingOut("a2"), FakeReplica("b2", _gauges(queue_rows=50))
+    r2 = _router([a2, b2], breaker_failures=2, breaker_cooldown_s=3600.0,
+                 hedge_quantile=0.0, latency_routing=False)
+    r2.poll_once()
+    for _ in range(2):
+        assert r2.handle("POST", "/v1/models/m:predict",
+                         {"rows": [[0.0]]})[0] == 200
+    assert r2.replica_states()["a2"]["breaker"]["state"] == "open"
+    r2.close()
+
+
+def test_router_latency_weight_drains_slow_replica():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b], hedge_quantile=0.0)    # isolate the weighting
+    r.poll_once()
+    _seed_digest(r, 0, 0.5)      # a: 500ms data path (gray)
+    _seed_digest(r, 1, 0.01)     # b: 10ms
+    for _ in range(6):
+        status, body = r.handle("POST", "/v1/models/m:predict",
+                                {"rows": [[0.0]]})
+        assert status == 200 and body["replica"] == "b"
+    assert a.served == 0         # organically drained, no binary verdict
+    states = r.replica_states()
+    assert states["a"]["state"] == "healthy"   # SLO never fired
+    assert states["a"]["latency_p50_ms"] == pytest.approx(500.0)
+    r.close()
+
+
+def test_router_latency_routing_off_restores_least_loaded():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = _router([a, b], hedge_quantile=0.0, latency_routing=False)
+    r.poll_once()
+    _seed_digest(r, 0, 0.5)
+    _seed_digest(r, 1, 0.01)
+    for _ in range(6):
+        assert r.handle("POST", "/v1/models/m:predict",
+                        {"rows": [[0.0]]})[0] == 200
+    assert a.served > 0          # un-hardened: the gray replica keeps load
+    r.close()
+
+
+def test_router_refuses_expired_deadline_before_forwarding():
+    a = FakeReplica("a")
+    r = _router([a])
+    r.poll_once()
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]], "deadline_ms": 0})
+    assert status == 504 and "deadline" in body["error"]
+    assert a.served == 0         # refused BEFORE any forward
+    snap = r.registry.snapshot()
+    assert snap["lgbm_fleet_deadline_refused_total"]["_"] == 1
+    # a healthy budget flows through, decremented, to the replica
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]], "deadline_ms": 5000})
+    assert status == 200
+    fwd = a.bodies[-1]
+    assert 0 < fwd["deadline_ms"] <= 5000
+    # and a non-numeric budget is the client's 400, not a crash
+    assert r.handle("POST", "/v1/models/m:predict",
+                    {"rows": [[0.0]], "deadline_ms": "soon"})[0] == 400
+    r.close()
+
+
+def test_router_default_deadline_applies_when_body_has_none():
+    a = FakeReplica("a")
+    r = _router([a], default_deadline_ms=5000.0)
+    r.poll_once()
+    status, _ = r.handle("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    assert status == 200
+    assert 0 < a.bodies[-1]["deadline_ms"] <= 5000
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# Idempotent publish tokens
+# ---------------------------------------------------------------------------
+def test_registry_publish_token_is_idempotent(binary_model):
+    reg = ModelRegistry()
+    s = binary_model.model_to_string()
+    v1 = reg.publish("m", model_str=s, warmup=False, token="tok-1")
+    assert reg.publish("m", model_str=s, warmup=False, token="tok-1") == v1
+    assert reg.current_version("m") == v1
+    assert len(reg.history("m")) == 1          # nothing double-applied
+    v2 = reg.publish("m", model_str=s, warmup=False, token="tok-2")
+    assert v2 == v1 + 1
+
+
+def test_registry_publish_token_not_replayed_after_rollback(binary_model):
+    """Regression (review-found): a token must replay its version ONLY
+    while that version is still current.  After a rollback withdrew it
+    (the partial-publish undo), answering "success" without
+    re-installing would leave this replica on the old version while
+    peers apply the retry — the silent mixed-version fleet the undo
+    exists to prevent."""
+    reg = ModelRegistry()
+    s = binary_model.model_to_string()
+    reg.publish("m", model_str=s, warmup=False)                  # v1
+    v2 = reg.publish("m", model_str=s, warmup=False, token="T")  # v2
+    reg.rollback("m")                                            # back to v1
+    assert reg.current_version("m") == v2 - 1
+    v3 = reg.publish("m", model_str=s, warmup=False, token="T")
+    assert v3 == reg.current_version("m")        # genuinely re-installed
+    assert v3 != v2                              # not a stale replay
+
+
+def test_registry_superseded_token_replays_without_reinstalling(
+        binary_model):
+    """Review regression: a token re-send racing a NEWER publish must
+    replay the version it originally minted — re-installing it would
+    resurrect the old model over the newer one on this replica alone.
+    (Contrast with rollback, which deletes the token so a re-send
+    re-installs for real — see the rollback test above.)"""
+    reg = ModelRegistry()
+    s = binary_model.model_to_string()
+    vA = reg.publish("m", model_str=s, warmup=False, token="tA")
+    vB = reg.publish("m", model_str=s, warmup=False)    # newer publish
+    assert reg.current_version("m") == vB
+    # the stalled broadcast's resolution re-send arrives late:
+    assert reg.publish("m", model_str=s, warmup=False, token="tA") == vA
+    assert reg.current_version("m") == vB               # B stays current
+
+
+def test_serving_app_publish_token_roundtrip(binary_model, tmp_path):
+    path = str(tmp_path / "m.txt")
+    binary_model.save_model(path)
+    app = ServingApp(max_wait_ms=1)
+    try:
+        body = {"model_file": path, "warmup": False,
+                "publish_token": "tok-9"}
+        st1, r1 = app.handle("POST", "/v1/models/m:publish", body)
+        st2, r2 = app.handle("POST", "/v1/models/m:publish", body)
+        assert st1 == st2 == 200 and r1["version"] == r2["version"] == 1
+    finally:
+        app.close()
+
+
+class TokenAwareReplica(FakeReplica):
+    """Mimics the registry's token semantics."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.tokens = {}
+
+    def request(self, method, path, body=None, timeout_s=None):
+        if path.endswith(":publish"):
+            tok = (body or {}).get("publish_token")
+            if tok in self.tokens:
+                return 200, {"name": "m", "version": self.tokens[tok]}
+            self.version += 1
+            if tok:
+                self.tokens[tok] = self.version
+            self.published.append(dict(body or {}))
+            return 200, {"name": "m", "version": self.version}
+        return super().request(method, path, body, timeout_s)
+
+
+def test_router_resolves_unknown_publish_outcome_via_token_resend():
+    """The satellite's point: a publish that LANDED but whose response
+    timed out (slow drip) used to be stuck UNKNOWN — failing the
+    broadcast and rolling nothing back.  With the token, the router
+    re-sends the identical publish; the replica replays the version it
+    already minted, the outcome resolves, and nothing double-applies."""
+    class UnknownOnce(TokenAwareReplica):
+        def __init__(self, name):
+            super().__init__(name)
+            self.timeouts = 0
+
+        def request(self, method, path, body=None, timeout_s=None):
+            st, payload = super().request(method, path, body, timeout_s)
+            if path.endswith(":publish") and self.timeouts == 0:
+                self.timeouts += 1         # applied, but the caller
+                raise ReplicaTransportError(  # never hears back
+                    f"replica {self.name}: timed out"
+                ) from TimeoutError("read timed out")
+            return st, payload
+
+    a, flaky = TokenAwareReplica("a"), UnknownOnce("flaky")
+    r = _router([a, flaky])
+    status, body = r.handle("POST", "/v1/models/m:publish",
+                            {"model_file": "m.txt"})
+    assert status == 200 and body["succeeded"] == 2
+    assert body["replicas"]["flaky"]["resolved_by_token_resend"] is True
+    # idempotency held: the re-send did NOT mint another version
+    assert flaky.version == 2 and a.version == 2
+    # the router minted one token and every send carried it
+    toks = {p["publish_token"] for p in a.published}
+    assert len(toks) == 1 and len(a.published) == 1
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation through the serving tier
+# ---------------------------------------------------------------------------
+class _ListPredictor:
+    num_feature = 3
+    buckets = None
+
+    def __init__(self):
+        self.calls = []
+
+    def predict(self, X):
+        self.calls.append(X.shape[0])
+        return np.zeros(X.shape[0])
+
+
+def test_batcher_refuses_expired_deadline_at_admission():
+    pred = _ListPredictor()
+    b = MicroBatcher(pred, autostart=False, max_wait_ms=0)
+    with pytest.raises(DeadlineExceededError, match="admission"):
+        b.submit(np.zeros((2, 3)), deadline_t=time.perf_counter() - 1.0)
+    assert pred.calls == [] and b.queue_depth == 0
+    b.close()
+
+
+def test_batcher_drops_queued_request_whose_deadline_expired():
+    """A request admitted alive but expired by take-time is dropped AT
+    THE TAKE — the predictor never sees its rows (no device time), the
+    waiter gets DeadlineExceededError, and live requests in the same
+    queue still flush."""
+    pred = _ListPredictor()
+    m = ModelMetrics("m")
+    b = MicroBatcher(pred, autostart=False, max_wait_ms=0, metrics=m)
+    doom_t = time.perf_counter() + 1e-4
+    doomed = b.submit(np.zeros((2, 3)), deadline_t=doom_t)
+    alive = b.submit(np.zeros((3, 3)),
+                     deadline_t=time.perf_counter() + 3600.0)
+    # spin (no sleep): the doomed deadline is 0.1ms out — wait it past
+    # on the same clock the batcher reads before starting the worker
+    while time.perf_counter() < doom_t:
+        pass
+    b.start()
+    assert alive.result(10.0).shape == (3,)
+    with pytest.raises(DeadlineExceededError, match="expired while queued"):
+        doomed.result(10.0)
+    assert pred.calls and sum(pred.calls) == 3   # doomed rows never ran
+    assert m.deadline_refused == 1
+    assert m.queue_wait.count >= 1               # admitted wait recorded
+    b.close()
+
+
+def test_serving_app_deadline_504_and_queue_wait_metrics(binary_model):
+    app = ServingApp(max_wait_ms=1)
+    app.registry.publish("m", booster=binary_model, warmup=False)
+    nfeat = binary_model.num_feature()
+    rows = {"rows": [[0.0] * nfeat]}
+    try:
+        st, body = app.handle("POST", "/v1/models/m:predict",
+                              {**rows, "deadline_ms": 0})
+        assert st == 504 and "deadline" in body["error"]
+        st, body = app.handle("POST", "/v1/models/m:predict",
+                              {**rows, "deadline_ms": 60000})
+        assert st == 200
+        snap = app.metrics.snapshot()["m"]
+        assert snap["deadline_refused"] == 1
+        assert "queue_wait_p50_ms" in snap
+        gauges = app.metrics.fleet_gauges()
+        assert "queue_wait_ms" in gauges
+        # the queue-wait histogram is a first-class registry instrument
+        # (Prometheus-visible), not just a snapshot field
+        st, text = app.handle("GET", "/v1/metrics/prometheus")
+        assert "lgbm_serving_queue_wait_ms" in text
+        assert "lgbm_serving_deadline_refused_total" in text
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# chaosnet fault transport (mirrors test_chaosio: every fault proves it
+# FIRED via its counter; sleeps are injected, not slept)
+# ---------------------------------------------------------------------------
+class _Sleeps:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, s):
+        self.calls.append(s)
+
+
+def test_chaosnet_reset_fires_and_counts():
+    inner = FakeReplica("a")
+    sl = _Sleeps()
+    c = ChaosReplica(inner, sleep_fn=sl)
+    c.reset_next(2)
+    for _ in range(2):
+        with pytest.raises(ReplicaTransportError, match="reset"):
+            c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    # disarmed after N: the next request flows through
+    st, _ = c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    assert st == 200
+    assert c.counters["resets"] == 2 and inner.served == 1
+    assert sl.calls == []          # resets are instant
+
+
+def test_chaosnet_black_hole_eats_the_timeout():
+    inner = FakeReplica("a")
+    sl = _Sleeps()
+    c = ChaosReplica(inner, sleep_fn=sl)
+    c.black_hole(1)
+    with pytest.raises(ReplicaTransportError, match="black hole") as ei:
+        c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]},
+                  timeout_s=7.0)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    assert sl.calls == [7.0]       # the caller's own timeout was consumed
+    assert inner.served == 0       # the request never arrived
+    assert c.counters["black_holes"] == 1
+
+
+def test_chaosnet_latency_is_gray_health_stays_clean():
+    inner = FakeReplica("a")
+    sl = _Sleeps()
+    c = ChaosReplica(inner, sleep_fn=sl)
+    c.add_latency(0.25)
+    st, _ = c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    assert st == 200 and sl.calls == [0.25]
+    assert c.counters["latency_injections"] == 1
+    # THE gray property: the data path crawls, the health poll does not
+    assert c.health() is not None and sl.calls == [0.25]
+    c.calm()
+    c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]})
+    assert sl.calls == [0.25]      # calm() disarmed the latency
+
+
+def test_chaosnet_latency_respects_caller_timeout():
+    """Fidelity: a real slow network trips the caller's read timeout at
+    timeout_s — it never waits out the full latency and hands back a
+    late 200.  Injected latency beyond the timeout must do the same."""
+    inner = FakeReplica("a")
+    sl = _Sleeps()
+    c = ChaosReplica(inner, sleep_fn=sl)
+    c.add_latency(2.0)
+    with pytest.raises(ReplicaTransportError, match="latency") as ei:
+        c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]},
+                  timeout_s=0.06)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    assert sl.calls == [0.06]      # only the caller's timeout was paid
+    assert inner.served == 0
+    assert c.counters["latency_timeouts"] == 1
+    # a generous timeout still gets the slow answer through
+    st, _ = c.request("POST", "/v1/models/m:predict", {"rows": [[0.0]]},
+                      timeout_s=30.0)
+    assert st == 200 and sl.calls == [0.06, 2.0]
+
+
+def test_chaosnet_slow_drip_lands_then_stalls():
+    inner = TokenAwareReplica("a")
+    sl = _Sleeps()
+    c = ChaosReplica(inner, sleep_fn=sl)
+    c.slow_drip(1, delay_s=9.0)
+    with pytest.raises(ReplicaTransportError, match="slow drip") as ei:
+        c.request("POST", "/v1/models/m:publish",
+                  {"model_file": "m.txt", "publish_token": "t1"},
+                  timeout_s=2.0)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    assert inner.version == 2      # the publish LANDED — outcome unknown
+    assert c.counters["slow_drips"] == 1
+    # a drip shorter than the timeout just delays the response
+    c.slow_drip(1, delay_s=0.5)
+    st, body = c.request("POST", "/v1/models/m:publish",
+                         {"model_file": "m.txt", "publish_token": "t1"},
+                         timeout_s=2.0)
+    assert st == 200 and body["version"] == 2   # token replay, no re-apply
+
+
+# ---------------------------------------------------------------------------
+# Supervisor abandoned-slot visibility
+# ---------------------------------------------------------------------------
+def test_supervisor_abandoned_slot_counts_and_surfaces():
+    class DeadProc:
+        def poll(self):
+            return 137
+
+    reg = MetricsRegistry()
+    sup = FleetSupervisor(lambda i, p: ["true"], [18123],
+                          max_restarts=0, metrics_registry=reg)
+    rep = sup.replicas[0]
+    rep.proc = DeadProc()
+    rep.log_paths = ["replica_0_a0.log"]
+    sup.watch()
+    assert rep.gave_up and sup.abandoned == [0]
+    snap = reg.snapshot()
+    assert snap["lgbm_fleet_replica_abandoned_total"][
+        "replica=127.0.0.1:18123"] == 1
+    sup.watch()                    # idempotent: no double count
+    assert snap == reg.snapshot()
+    # the router surfaces it per replica on /v1/fleet/replicas
+    a = FakeReplica("a")
+    r = _router([a], supervisor=sup)
+    states = r.replica_states()
+    assert states["a"]["abandoned"] is True and states["a"]["restarts"] == 0
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# Static guard (satellite): every fleet_*/serving_* config param carries a
+# non-empty desc and appears in the README — undocumented knobs rot.
+# ---------------------------------------------------------------------------
+def test_fleet_and_serving_params_documented():
+    import os
+
+    from lightgbm_tpu.config import _PARAMS
+    readme = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "README.md")
+    with open(readme, encoding="utf-8") as fh:
+        text = fh.read()
+    scoped = [p for p in _PARAMS
+              if p.name.startswith(("fleet_", "serving_"))]
+    assert len(scoped) >= 20      # the guard guards something real
+    missing_desc = [p.name for p in scoped if not (p.desc or "").strip()]
+    assert not missing_desc, (
+        f"fleet_*/serving_* params without a desc: {missing_desc}")
+    missing_doc = [p.name for p in scoped if p.name not in text]
+    assert not missing_doc, (
+        f"fleet_*/serving_* params not mentioned in README.md: "
+        f"{missing_doc}")
