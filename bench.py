@@ -947,7 +947,15 @@ def run_fleet_gray():
     black-hole burst walks the gray replica's circuit breaker through
     its full closed -> open -> half_open -> closed cycle, and an
     overload storm proves the retry budget caps amplification at
-    honest, budgeted 503s/504s."""
+    honest, budgeted 503s/504s.
+
+    ISSUE 14 additions: the no-fault baseline runs twice — router
+    tracing off vs on at default sampling — and the delta lands in the
+    JSON (`tracing`, bar <= 5% throughput); the gray phase runs fully
+    traced and must yield an ASSEMBLED multi-process trace for a hedged
+    request (router pick -> hedge -> both replica attempts with
+    queue-wait + device spans -> winning hop, `trace_chain`) plus a
+    flight-recorder dump carrying the router-side causal chain."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
@@ -969,6 +977,7 @@ def run_fleet_gray():
                                     FleetSupervisor, HttpReplica, SLOPolicy,
                                     default_replica_argv)
     from lightgbm_tpu.fleet.breaker import RetryBudget
+    from lightgbm_tpu.telemetry.trace import Tracer
 
     # 3 concurrent clients: enough to exercise routing/hedging, low
     # enough that this 2-CPU box keeps queueing headroom — the p99 bars
@@ -994,6 +1003,11 @@ def run_fleet_gray():
     bundle = os.path.join(tmp, "bundle")
     pred.save_bundle(bundle)
 
+    # distributed tracing (ISSUE 14): replicas trace for the whole soak
+    # (sample 0 — only tail-kept traces persist) so the hedged-request
+    # causal chain is assembled end to end; the ROUTER-side tracer is
+    # the on/off toggle the overhead phases measure
+    trace_dir = os.path.join(tmp, "trace")
     ports = find_open_ports(2)
     sup = FleetSupervisor(
         lambda idx, port: default_replica_argv(
@@ -1002,9 +1016,15 @@ def run_fleet_gray():
              # small enough that the storm's offered load genuinely
              # backs the queue up (429s + deadline admission refusals)
              "serving_max_queue_rows": "1024",
-             "serving_max_batch": "256"}, port),
+             "serving_max_batch": "256",
+             "trace_requests": "1", "trace_sample_rate": "0",
+             "trace_ring": "4096",
+             "trace_dir": os.path.join(trace_dir, f"replica{idx}")},
+            port),
         ports, log_dir=os.path.join(tmp, "logs"),
         max_restarts=2, restart_backoff_s=0.5)
+    tracer_on = Tracer(enabled=True, sample_rate=0.01, ring=4096,
+                       trace_dir=os.path.join(trace_dir, "router"))
 
     pool = np.random.RandomState(1).randn(4096, N_FEATURES).astype(np.float64)
 
@@ -1071,13 +1091,66 @@ def run_fleet_gray():
             gray = ChaosReplica(HttpReplica(urls[0]))
             return gray, [gray, HttpReplica(urls[1])]
 
-        # --- phase A: no-fault baseline on the hardened router -------
-        gray, eps = endpoints()
-        with FleetRouter(eps, **hardened) as r:
-            drive(r, 1.5, 90, n_threads)    # warm conns/paths, discard
-            stat_a, lat_a, _ = drive(r, phase_s, 100, n_threads)
+        # --- phase A: no-fault baseline, router tracing OFF vs ON -----
+        # the tracing-overhead measurement the acceptance bar reads:
+        # default sampling (1%), every request minting a span tree and
+        # propagating its wire context through the replica hop.
+        # Measured as the MEDIAN of per-round paired ratios over three
+        # alternating off/on rounds: this 2-CPU box's run-to-run drift
+        # (replica warmup, OS caches, frequency) is ±8-11% — bigger than
+        # the ~2% true cost (35.6us/request micro-measured for both
+        # hops) — so a single sequential A-then-A2 comparison measured
+        # anything from +8.6% to -11.2% across dev runs.  Pairing
+        # adjacent sub-phases and taking the median bounds the drift a
+        # single bad window can inject.  The measured config is the
+        # DEFAULT one the acceptance bar names (sample 1%, ring 256, no
+        # sink) — tracer_on's forensic settings (ring 4096 + span sink)
+        # belong to the chain phases, and their extra ~3% (bigger GC
+        # population + sink writes) must not be billed to the default
+        lat_a, lat_a2 = [], []
+        rounds = []
+        sub = phase_s / 3.0
+        for k in range(3):
+            pair = {}
+            order = (False, True) if k % 2 == 0 else (True, False)
+            for traced in order:
+                gray, eps = endpoints()
+                kw = dict(hardened)
+                if traced:
+                    kw["tracer"] = Tracer(enabled=True, sample_rate=0.01,
+                                          ring=256)
+                with FleetRouter(eps, **kw) as r:
+                    drive(r, 0.75, 90 + 10 * k + (5 if traced else 0),
+                          n_threads)          # warm conns/paths, discard
+                    _, lat, rows = drive(
+                        r, sub, 100 + 10 * k + (5 if traced else 0),
+                        n_threads)
+                pair[traced] = rows
+                (lat_a2 if traced else lat_a).extend(lat)
+            rounds.append(pair)
+        lat_a.sort()
+        lat_a2.sort()
         base_p50_ms = (lat_a[len(lat_a) // 2] * 1e3) if lat_a else 25.0
         base_p99 = p99_ms(lat_a)
+        thr_off = sum(p[False] for p in rounds) / phase_s
+        thr_on = sum(p[True] for p in rounds) / phase_s
+        ratios = sorted(p[True] / p[False] for p in rounds if p[False])
+        on_over_off = ratios[len(ratios) // 2] if ratios else 1.0
+        # phase C1 runs fully traced, so its 2x bound compares against
+        # the TRACED no-fault baseline — same config on both sides of
+        # the ratio (the untraced baseline stays in the JSON as the
+        # tracing-overhead reference)
+        base_p99_traced = p99_ms(lat_a2) or base_p99
+        tracing_overhead = {
+            "rows_per_s_off": round(thr_off, 1),
+            "rows_per_s_on": round(thr_on, 1),
+            "round_ratios_on_over_off": [round(x, 4) for x in ratios],
+            "throughput_overhead_pct": round((1.0 - on_over_off) * 100.0,
+                                             2),
+            "p99_off_ms": round(base_p99, 1),
+            "p99_on_ms": round(p99_ms(lat_a2), 1),
+            "within_5pct": bool(on_over_off >= 0.95),
+        }
         # 20x the healthy median is the injected gray latency, bounded
         # so one request never outlives a phase
         gray_latency_s = min(max(gray_factor * base_p50_ms / 1e3, 0.15),
@@ -1097,7 +1170,7 @@ def run_fleet_gray():
         # latency-weight drain + hedging
         gray, eps = endpoints()
         gray.add_latency(gray_latency_s)
-        with FleetRouter(eps, **hardened) as r:
+        with FleetRouter(eps, tracer=tracer_on, **hardened) as r:
             # unmeasured discovery: the router's first picks of the gray
             # replica pay full gray latency until its digest crosses
             # min_samples — that is the (bounded, one-off) cost of
@@ -1115,6 +1188,91 @@ def run_fleet_gray():
             c_reroutes = int(csnap["lgbm_fleet_reroutes_total"]["_"])
             gray_counters = dict(gray.counters)
 
+        # --- phase C1b: hedged-request trace chain (ISSUE 14) ---------
+        # the steady-state drain is SO effective the gray replica is
+        # barely ever picked (the committed soak recorded 6 picks and 0
+        # hedges across ~2000 requests), so the causal-chain bar gets a
+        # deterministic fire: seed the gray replica's digest with fast
+        # history — it ranks first AND hedges after ~hedge_min_ms — then
+        # verify the assembled multi-process trace shows router pick,
+        # hedge fire, BOTH replica attempts (queue-wait + device spans),
+        # and the winning hop
+        gray, eps = endpoints()
+        gray.add_latency(gray_latency_s)
+        with FleetRouter(eps, tracer=tracer_on, **hardened) as r:
+            hedged_ids = []
+            for _ in range(20):
+                for _ in range(8):
+                    r._replicas[0].digest.observe(0.001)
+                status, body = r.handle(
+                    "POST", "/v1/models/default:predict",
+                    {"rows": pool[:4].tolist(), "deadline_ms": 8000.0})
+                if (status == 200 and body.get("hedged")
+                        and body.get("trace_id")):
+                    hedged_ids.append(body["trace_id"])
+                if len(hedged_ids) >= 3:
+                    break
+            assert hedged_ids, "gray soak produced no hedged trace"
+            # disarm the injected latency BEFORE assembling: the
+            # /v1/trace/<id> fan-out goes through the same ChaosReplica
+            # wrapper, and an injected latency >= the fan-out timeout
+            # would drop the gray replica's spans from the merge
+            gray.calm()
+            # abandoned primaries are still crawling through the gray
+            # latency: give them one injected-latency's grace to finish
+            time.sleep(min(2.0 * gray_latency_s, 3.0))
+            chain = None
+            for tid in hedged_ids:
+                status, merged = r.handle("GET", f"/v1/trace/{tid}")
+                if status != 200:
+                    continue
+                names = [s["name"] for s in merged["spans"]]
+                root = next((s for s in merged["spans"]
+                             if s["name"] == "router.predict"), None)
+                ok = ("router.pick" in names
+                      and "router.hedge" in names
+                      and names.count("router.attempt") >= 2
+                      and names.count("replica.predict") >= 2
+                      and "serving.queue_wait" in names
+                      and "serving.device_flush" in names
+                      and merged.get("processes", 0) >= 3
+                      and root is not None
+                      and root["attrs"].get("replica"))
+                if ok:
+                    chain = {
+                        "trace_id": tid,
+                        "processes": merged["processes"],
+                        "spans": len(merged["spans"]),
+                        "span_names": sorted(set(names)),
+                        "winner": root["attrs"]["replica"],
+                        "hedged_fired": len(hedged_ids),
+                    }
+                    break
+            assert chain is not None, (
+                "no hedged trace assembled into the full multi-process "
+                f"causal chain ({len(hedged_ids)} hedged candidates)")
+            # the flight-recorder dump must carry the router-side causal
+            # chain (pick -> hedge -> winner) for a hedged request
+            dump_path = r.tracer.dump(reason="gray_soak")
+            with open(dump_path) as fh:
+                dump = json.load(fh)
+            dump_ok = False
+            for t in dump["traces"]:
+                if "hedged" not in (t.get("keep") or []):
+                    continue
+                dnames = [s["name"] for s in t["spans"]]
+                droot = next((s for s in t["spans"]
+                              if s["name"] == "router.predict"), None)
+                if ("router.pick" in dnames and "router.hedge" in dnames
+                        and droot is not None
+                        and droot["attrs"].get("replica")):
+                    dump_ok = True
+                    break
+            assert dump_ok, ("flight-recorder dump lacks a hedged "
+                             "request's pick -> hedge -> winner chain")
+            chain["flight_dump"] = dump_path
+            chain["flight_dump_traces"] = len(dump["traces"])
+
         # --- phase C2: breaker walk (fresh router, black-hole burst) --
         # a burst of holes on a FRESH router (neutral weights, so the
         # gray replica still takes traffic): consecutive timeout-
@@ -1127,7 +1285,7 @@ def run_fleet_gray():
         gray, eps = endpoints()
         gray.add_latency(gray_latency_s)
         gray.black_hole(12, cap_s=0.3)
-        with FleetRouter(eps, **hardened) as r:
+        with FleetRouter(eps, tracer=tracer_on, **hardened) as r:
             stat_w1, _, _ = drive(r, 6.0, 350, n_threads,
                                   deadline_ms=8000.0)
             gray.calm()
@@ -1202,9 +1360,10 @@ def run_fleet_gray():
             "unit": "ms_p99_under_gray_fault",
             # the headline bar: hardened p99 under a 20x-latency gray
             # replica over the no-fault fleet p99 (<= 2.0 passes)
-            "vs_baseline": (round(hard_p99 / base_p99, 3)
-                            if base_p99 else None),
+            "vs_baseline": (round(hard_p99 / base_p99_traced, 3)
+                            if base_p99_traced else None),
             "p99_nofault_ms": round(base_p99, 1),
+            "p99_nofault_traced_ms": round(base_p99_traced, 1),
             "p50_nofault_ms": round(base_p50_ms, 1),
             "gray_latency_injected_ms": round(gray_latency_s * 1e3, 1),
             "unhardened": {
@@ -1217,8 +1376,9 @@ def run_fleet_gray():
             },
             "hardened": {
                 "p99_ms": round(hard_p99, 1),
-                "within_2x_bound": bool(base_p99
-                                        and hard_p99 <= 2.0 * base_p99),
+                "within_2x_bound": bool(base_p99_traced
+                                        and hard_p99
+                                        <= 2.0 * base_p99_traced),
                 "failed_requests": hard_failed,
                 "requests": c_requests,
                 "rows_served": rows_c,
@@ -1250,6 +1410,11 @@ def run_fleet_gray():
             },
             "replica_admission_refusals": admission_refused,
             "replica_queue_wait_p50_ms": round(queue_wait_p50, 2),
+            # ISSUE 14: tracing overhead (on vs off, default sampling)
+            # and the assembled hedged-request causal chain
+            "tracing": tracing_overhead,
+            "trace_chain": chain,
+            "flight_dumps": list(tracer_on.dumps),
             "setup_s": round(setup_s, 1),
             "backend": backend,
         }

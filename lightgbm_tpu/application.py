@@ -64,6 +64,11 @@ class Application:
 
     # ------------------------------------------------------------------
     def run(self) -> None:
+        # distributed tracing + log correlation: wire the process-default
+        # tracer (and JSON log mode) from trace_* once, before any role
+        # (router / replica / continuous rank) starts handling requests
+        from .telemetry import trace as _trace
+        _trace.configure_from_config(self.config)
         if self.config.num_machines > 1 and self.config.machines:
             # reference Application::InitTrain -> Network::Init
             # (application.cpp:170): join the cluster before any device work

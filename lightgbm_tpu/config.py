@@ -148,6 +148,44 @@ _PARAMS: List[ParamSpec] = [
     _p("profile_iterations", list, None,
        desc="iteration indices to device-trace into profile_dir "
             "(default: [1] — the first post-compile iteration)"),
+    # ---- Distributed tracing (lightgbm_tpu/telemetry/trace.py) ----
+    _p("trace_requests", bool, True, (),
+       desc="distributed request tracing: every predict through the fleet "
+            "router / serving replica (and every continuous-training "
+            "cycle) records a span tree — routing decisions, hedges, "
+            "per-attempt forwards, replica queue wait, device flush — "
+            "propagated across HTTP hops by a trace context in the "
+            "request body.  Persisted traces are head-sampled at "
+            "trace_sample_rate plus tail-kept on SLO breach / hedge / "
+            "reroute / breaker / 503 / 504; a bounded flight-recorder "
+            "ring of recent traces always serves GET /v1/trace/recent "
+            "and /v1/trace/<id>.  false = a no-op on the hot path"),
+    _p("trace_sample_rate", float, 0.01, (), ">=0",
+       "head-sampling fraction of traced requests persisted to the "
+       "trace_dir span sink even when no tail keep rule fires (the "
+       "steady-state baseline sample; interesting traces are always "
+       "kept).  0 = tail-kept traces only"),
+    _p("trace_ring", int, 256, (), ">0",
+       "flight recorder capacity: how many recently completed traces "
+       "(kept or not) each process retains in memory for the "
+       "/v1/trace/* routes and failure-burst dumps"),
+    _p("trace_dir", str, "",
+       desc="directory for trace persistence: kept traces append one "
+            "JSON line per span to trace_spans_rank<R>-<pid>.jsonl "
+            "(telemetry.assemble_traces merges rank files by trace_id "
+            "into a Chrome-trace/Perfetto timeline) and flight-recorder "
+            "dumps land here on router failure bursts (breaker open, "
+            "shed, partial publish; rate-limited).  Empty = in-memory "
+            "ring + trace routes only, nothing written"),
+    _p("trace_keep_slo_ms", float, 0.0, (), ">=0",
+       "tail keep rule: a trace whose end-to-end duration exceeds this "
+       "many milliseconds is always persisted (SLO breach).  0 = derive "
+       "from fleet_slo_p99_ms at the router, no latency rule elsewhere"),
+    _p("trace_log_json", bool, False, (),
+       desc="emit log lines as structured JSON objects ({level, msg, "
+            "trace_id?}) instead of the bracketed text prefix; warnings "
+            "raised while a trace is active carry the trace_id in either "
+            "mode (LIGHTGBM_TPU_LOG_JSON=1 is the env default)"),
     _p("input_model", str, "", ("model_input", "model_in")),
     _p("output_model", str, "LightGBM_model.txt", ("model_output", "model_out")),
     _p("convert_model", str, "gbdt_prediction.cpp",
@@ -658,6 +696,10 @@ class Config:
             self.label_gain = [float((1 << min(i, 30)) - 1) for i in range(31)]
         if self.is_unbalance and self.scale_pos_weight != 1.0:
             raise ValueError("cannot set both is_unbalance and scale_pos_weight")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate={self.trace_sample_rate} must be in "
+                "[0, 1] (a fraction of requests, e.g. 0.01)")
         if not 0.0 <= self.fleet_hedge_quantile <= 1.0:
             # 95 almost certainly meant the 95th percentile; silently
             # clamping would disable hedging (delay = slowest sample)

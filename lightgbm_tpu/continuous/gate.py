@@ -121,11 +121,23 @@ class PublishGate:
                              "auc": auc, "version": version})
 
     def _publish(self, candidate_str: str) -> int:
-        if self._publish_fn is not None:
-            return self._publish_fn(candidate_str, self.aot_bundle_dir)
-        return self.registry.publish(self.model_name,
-                                     model_str=candidate_str,
-                                     aot_bundle_dir=self.aot_bundle_dir)
+        # the cycle trace's publish span carries the minted version —
+        # the link a served prediction's trace (which reports the version
+        # that answered it) follows back to the training cycle that
+        # produced its model
+        from ..telemetry import trace as _trace
+        with _trace.child_span("cycle.publish",
+                               model=self.model_name) as ps:
+            if self._publish_fn is not None:
+                version = self._publish_fn(candidate_str,
+                                           self.aot_bundle_dir)
+            else:
+                version = self.registry.publish(
+                    self.model_name, model_str=candidate_str,
+                    aot_bundle_dir=self.aot_bundle_dir)
+            if ps is not None:
+                ps.set(version=version)
+        return version
 
     # ------------------------------------------------------------------
     def watch(self, X: np.ndarray, y: np.ndarray) -> Optional[Dict]:

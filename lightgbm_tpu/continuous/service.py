@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 from ..log import LightGBMError, log_info, log_warning
 from ..telemetry import get_counter
+from ..telemetry import trace as _trace
 from .gate import PublishGate
 from .tail import DataTail
 from .trainer import ContinuousTrainer
@@ -45,13 +46,19 @@ class ContinuousService:
                  poll_s: float = 1.0,
                  max_cycle_retries: int = 2,
                  retry_backoff_s: float = 0.2,
-                 metrics_registry=None):
+                 metrics_registry=None,
+                 tracer=None):
         self.tail = tail
         self.trainer = trainer
         self.gate = gate
         self.poll_s = float(poll_s)
         self.max_cycle_retries = int(max_cycle_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+        # cycle-scoped tracing: every real cycle gets a trace (poll ->
+        # extend -> train -> gate -> publish) whose publish span carries
+        # the minted version — the link a served prediction's trace
+        # follows back to the training cycle that produced its model
+        self.tracer = tracer if tracer is not None else _trace.TRACER
         self.m_cycles = get_counter(
             metrics_registry, "lgbm_continuous_cycles_total",
             "training cycles completed (published or rejected)")
@@ -63,9 +70,37 @@ class ContinuousService:
 
     # ------------------------------------------------------------------
     def step(self) -> Dict:
-        """One poll → watch → train → gate pass.  Returns a summary dict
+        """One poll → watch → train → gate pass (traced as one cycle
+        trace when tracing is on).  Returns a summary dict
         (``new_rows``, ``trained``, ``decision``, ``rollback``)."""
-        batches = self.tail.poll()
+        ts = self.tracer.start_cycle("cycle", cycle=self.trainer.cycle,
+                                     model=self.gate.model_name)
+        if ts is None:
+            return self._step_inner()
+        try:
+            with _trace.activate(ts):
+                summary = self._step_inner()
+        except Exception:
+            ts.finish_request(status=500)
+            raise
+        if not summary["trained"] and not summary["new_rows"]:
+            # an idle poll is not a cycle: keep the flight recorder and
+            # the sink for cycles that did something
+            ts.discard()
+            return summary
+        decision = summary.get("decision") or {}
+        ts.set(decision=decision.get("action"),
+               version=decision.get("version"),
+               new_rows=summary["new_rows"])
+        ts.finish_request(status=200)
+        summary["trace_id"] = ts.trace_id
+        return summary
+
+    def _step_inner(self) -> Dict:
+        with _trace.child_span("cycle.poll") as ps:
+            batches = self.tail.poll()
+            if ps is not None:
+                ps.set(segments=len(batches))
         new_rows = int(sum(len(b.y) for b in batches))
         summary: Dict = {"new_rows": new_rows, "trained": False,
                          "decision": None, "rollback": None}
@@ -82,14 +117,22 @@ class ContinuousService:
         # new candidate's comparison base
         if fresh_hy:
             import numpy as np
-            rb = self.gate.watch(np.concatenate(fresh_hX),
-                                 np.concatenate(fresh_hy))
+            with _trace.child_span("cycle.watch") as ws:
+                rb = self.gate.watch(np.concatenate(fresh_hX),
+                                     np.concatenate(fresh_hy))
+                if ws is not None and rb is not None:
+                    ws.set(rollback=True)
             if rb is not None:
                 summary["rollback"] = rb
                 self.trainer.revert()
         if self.trainer.num_train_rows == 0:
             return summary
-        result = self._train_cycle_supervised()
+        with _trace.child_span("cycle.train") as trs:
+            result = self._train_cycle_supervised()
+            if trs is not None:
+                trs.set(cycle=result["cycle"],
+                        resumed_from=result["resumed_from"],
+                        compiles=result.get("compiles"))
         summary["trained"] = True
         summary["resumed_from"] = result["resumed_from"]
         # incremental-pipeline accounting (trainer.train_cycle): per-cycle
@@ -99,8 +142,10 @@ class ContinuousService:
                     "rebin", "row_bucket", "pad_fraction", "drift_max_psi"):
             if key in result:
                 summary[key] = result[key]
-        decision = self.gate.consider(result["candidate_str"],
-                                      result["auc"], cycle=result["cycle"])
+        with _trace.child_span("cycle.gate", auc=result["auc"]):
+            decision = self.gate.consider(result["candidate_str"],
+                                          result["auc"],
+                                          cycle=result["cycle"])
         if decision["action"] == "publish":
             self.trainer.commit(result["candidate_str"])
         else:

@@ -787,7 +787,10 @@ class ShardedContinuousService(ContinuousService):
                  f"segments replayed, {len(pending)} in-flight)")
 
     # -- the coordinated step ------------------------------------------
-    def step(self) -> Dict:
+    def _step_inner(self) -> Dict:
+        # overriding _step_inner (not step) keeps the base class's
+        # cycle-trace wrapper: sharded cycles get the same poll -> train
+        # -> gate -> publish trace as the single-process service
         from ..checkpoint.fault import maybe_inject_cycle_fault
         tr = self.trainer
         replaying = bool(self._pending_replay)

@@ -408,42 +408,50 @@ class ContinuousTrainer:
         fresh_rows = 0
         init = None
         init_score_s = 0.0
-        if self.incremental:
-            if self._store is None:
-                fresh_rows = self.num_train_rows
-                self._build_store()
+        from ..telemetry import trace as _trace
+        with _trace.child_span("cycle.extend") as es:
+            if self.incremental:
+                if self._store is None:
+                    fresh_rows = self.num_train_rows
+                    self._build_store()
+                else:
+                    fresh_rows = self._sync_store()
+                    rebin_event = self._maybe_rebin()
+                setup_s = time.perf_counter() - t_setup
+                # init-score maintenance, reported separately from dataset
+                # setup: O(fresh rows x trees) host prediction of the
+                # committed model over JUST the fresh segment (the legacy
+                # path re-predicted the full model over ALL history)
+                t_init = time.perf_counter()
+                self._ensure_raw_base()
+                self._store.set_init_score(self._raw_base)
+                init_score_s = time.perf_counter() - t_init
+                ds = self._training_handle()
             else:
-                fresh_rows = self._sync_store()
-                rebin_event = self._maybe_rebin()
-            setup_s = time.perf_counter() - t_setup
-            # init-score maintenance, reported separately from dataset
-            # setup: O(fresh rows x trees) host prediction of the
-            # committed model over JUST the fresh segment (the legacy
-            # path re-predicted the full model over ALL history)
-            t_init = time.perf_counter()
-            self._ensure_raw_base()
-            self._store.set_init_score(self._raw_base)
-            init_score_s = time.perf_counter() - t_init
-            ds = self._training_handle()
-        else:
-            X = np.concatenate(self._train_X)
-            y = np.concatenate(self._train_y)
-            if self.model_str is not None:
-                from ..basic import Booster
-                init = Booster(model_str=self.model_str)
-            ds = lgb.Dataset(X, y, free_raw_data=False)
-            if init is None:
-                # with init_model, engine.train rebuilds the handle after
-                # folding in the init score — constructing here would pay
-                # the full O(total) build twice; measure it only when the
-                # build we trigger is the one training uses
-                ds.construct()
-            setup_s = time.perf_counter() - t_setup
-        booster = lgb.train(
-            self._engine_params(), ds, num_boost_round=self.rounds,
-            init_model=init, callbacks=list(callbacks or []),
-            checkpoint_dir=cycle_dir, checkpoint_freq=self.checkpoint_freq,
-            keep_checkpoints=self.keep_checkpoints, resume="auto")
+                X = np.concatenate(self._train_X)
+                y = np.concatenate(self._train_y)
+                if self.model_str is not None:
+                    from ..basic import Booster
+                    init = Booster(model_str=self.model_str)
+                ds = lgb.Dataset(X, y, free_raw_data=False)
+                if init is None:
+                    # with init_model, engine.train rebuilds the handle
+                    # after folding in the init score — constructing here
+                    # would pay the full O(total) build twice; measure it
+                    # only when the build we trigger is the one training
+                    # uses
+                    ds.construct()
+                setup_s = time.perf_counter() - t_setup
+            if es is not None:
+                es.set(fresh_rows=fresh_rows,
+                       rebin=rebin_event is not None)
+        with _trace.child_span("cycle.boost", rounds=self.rounds):
+            booster = lgb.train(
+                self._engine_params(), ds, num_boost_round=self.rounds,
+                init_model=init, callbacks=list(callbacks or []),
+                checkpoint_dir=cycle_dir,
+                checkpoint_freq=self.checkpoint_freq,
+                keep_checkpoints=self.keep_checkpoints, resume="auto")
         delta_str = booster.model_to_string()
         candidate = (delta_str if self.model_str is None
                      else combine_model_strings(self.model_str, delta_str))

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..log import LightGBMError, log_info, log_warning
 from ..serving.metrics import LatencyWindow
+from ..telemetry import trace as _trace
 from ..telemetry.registry import MetricsRegistry
 from .breaker import CircuitBreaker, LatencyDigest, RetryBudget
 from .slo import ReplicaSLO, SLOPolicy
@@ -211,6 +212,70 @@ class HttpReplica:
         return body.get("gauges", {})
 
 
+class _ModelStats:
+    """Router-side per-MODEL observables: the fleet counters labeled
+    ``model=<name>`` (the unlabeled totals stay for compat) plus the
+    windows the derived per-model SLO gauges (p99, deadline-miss ratio,
+    goodput) are computed from — the data feed the ROADMAP's
+    router-driven placement item needs."""
+
+    __slots__ = ("requests", "reroutes", "shed", "errors", "missed",
+                 "outcomes", "latency_hist", "window", "rows", "p99_g",
+                 "miss_g", "goodput_g")
+
+    def __init__(self, reg: MetricsRegistry, name: str):
+        lab = {"model": name}
+        self.requests = reg.counter(
+            "lgbm_fleet_requests_total", "predict requests at the router",
+            **lab)
+        self.reroutes = reg.counter(
+            "lgbm_fleet_reroutes_total",
+            "forwards retried on another replica after a failure", **lab)
+        self.shed = reg.counter(
+            "lgbm_fleet_shed_total",
+            "requests shed because no replica was within SLO", **lab)
+        self.errors = reg.counter(
+            "lgbm_fleet_errors_total",
+            "requests that failed on every routable replica", **lab)
+        self.missed = reg.counter(
+            "lgbm_fleet_model_deadline_missed_total",
+            "requests for this model that ended 504 (deadline verdict "
+            "anywhere along the chain)", **lab)
+        self.latency_hist = reg.histogram(
+            "lgbm_fleet_request_latency_seconds",
+            "router-side end-to-end predict latency", **lab)
+        # recent-evidence windows behind the derived gauges: time-bounded
+        # so an idle model's gauges decay instead of freezing on history
+        # (an all-time miss ratio would pin one early 504 burst on the
+        # placement feed for the process's whole lifetime).  The miss
+        # ratio reads ONE outcome ring (1.0 = 504, 0.0 = anything else):
+        # numerator and denominator come from the same samples, so ring
+        # saturation cannot skew the ratio — it just shortens the
+        # effective window above ~cap/window_s requests per second
+        self.window = LatencyWindow(2048, window_s=60.0)
+        self.rows = LatencyWindow(8192, window_s=30.0)
+        self.outcomes = LatencyWindow(8192, window_s=60.0)
+        self.p99_g = reg.gauge(
+            "lgbm_fleet_model_p99_ms",
+            "per-model SLO gauge: p99 of recent router-side latencies "
+            "(ms), failures included", **lab)
+        self.miss_g = reg.gauge(
+            "lgbm_fleet_model_deadline_miss_ratio",
+            "per-model SLO gauge: fraction of recent-window requests "
+            "that ended 504", **lab)
+        self.goodput_g = reg.gauge(
+            "lgbm_fleet_model_goodput_rows_per_s",
+            "per-model SLO gauge: rows answered 200 per second over the "
+            "recent window", **lab)
+
+    def refresh(self) -> None:
+        self.p99_g.set(self.window.percentiles()["p99_ms"])
+        n = self.outcomes.window_count()
+        self.miss_g.set(self.outcomes.window_sum() / n if n else 0.0)
+        self.goodput_g.set(self.rows.window_sum()
+                           / (self.rows.window_s or 1.0))
+
+
 class _Replica:
     """Router-side record: endpoint + SLO state + last-known load."""
 
@@ -256,7 +321,8 @@ class FleetRouter:
                  breaker_probes: int = 2,
                  latency_routing: bool = True,
                  default_deadline_ms: float = 0.0,
-                 supervisor=None):
+                 supervisor=None,
+                 tracer=None):
         if not replicas:
             raise LightGBMError("FleetRouter needs at least one replica")
         policy = policy or SLOPolicy()
@@ -285,6 +351,11 @@ class FleetRouter:
         self.hedge_budget = RetryBudget(ratio=hedge_budget_pct / 100.0,
                                         cap=50.0, initial=5.0)
         self.supervisor = supervisor   # abandoned-slot visibility only
+        # distributed tracing: the router MINTS each predict's trace and
+        # stamps every routing decision on it (telemetry/trace.py);
+        # replicas adopt the context forwarded in the request body
+        self.tracer = tracer if tracer is not None else _trace.TRACER
+        self._per_model: Dict[str, _ModelStats] = {}
         self._lock = threading.Lock()
         self._rr = 0                      # round-robin tie-breaker
         self._next_demand_poll_s = 0.0    # rate limit for pollless mode
@@ -623,6 +694,36 @@ class FleetRouter:
                  for i, load, probe in candidates]
         return [i for _, _, i in sorted(order)]
 
+    # label-cardinality bound: the router counts BEFORE any replica can
+    # 404 an unknown name, so sustained typo'd traffic must not mint an
+    # unbounded registry family per distinct name — past the cap, new
+    # names share one "_other" row
+    _MAX_MODEL_LABELS = 256
+
+    def _model_stats(self, name: str) -> _ModelStats:
+        """Per-model fleet metrics, created on first touch.  Lock-free
+        read on the hot path (CPython dict get); creation double-checks
+        under the router lock."""
+        m = self._per_model.get(name)
+        if m is not None:
+            return m
+        with self._lock:
+            m = self._per_model.get(name)
+            if m is None:
+                if len(self._per_model) >= self._MAX_MODEL_LABELS:
+                    name = "_other"
+                    m = self._per_model.get(name)
+                if m is None:
+                    m = self._per_model[name] = _ModelStats(self.registry,
+                                                            name)
+            return m
+
+    def refresh_model_gauges(self) -> None:
+        """Recompute the derived per-model SLO gauges from the live
+        windows — called at metrics render, not per request."""
+        for m in list(self._per_model.values()):
+            m.refresh()
+
     def _mark_down(self, idx: int, reason: str) -> None:
         rep = self._replicas[idx]
         with self._lock:
@@ -636,15 +737,20 @@ class FleetRouter:
 
     def _attempt(self, idx: int, name: str, body: dict, nrows: int,
                  timeout_s: float,
-                 started: Optional[threading.Event] = None
-                 ) -> Tuple[Optional[int], dict]:
+                 started: Optional[threading.Event] = None,
+                 tspan=None) -> Tuple[Optional[int], dict]:
         """One forward to one replica with full gray-failure accounting:
         breaker admission, live in-flight rows, latency digest feed, and
         the transport-error split — a TIMEOUT feeds the breaker/digest
         but does NOT mark the replica down (it is alive; its health polls
         keep passing — that is the gray failure), while a refused/reset
         connection is the killed-replica case and demotes immediately.
-        Returns (status, payload); status None = transport failure."""
+        Returns (status, payload); status None = transport failure.
+
+        With a trace span (``tspan``, the request's root), the attempt
+        gets its own child span and the forwarded body carries its wire
+        context, so the replica's spans nest under THIS attempt — a
+        hedged request's two attempts stay distinguishable."""
         if started is not None:
             started.set()   # hedge-delay clock starts at real execution
         rep = self._replicas[idx]
@@ -659,6 +765,15 @@ class FleetRouter:
             return None, {"error": f"replica {rep.endpoint.name}: "
                                    "circuit breaker open",
                           "breaker_race": True}
+        aspan = None
+        if tspan is not None:
+            aspan = tspan.child("router.attempt",
+                                replica=rep.endpoint.name, probe=probe,
+                                timeout_ms=round(timeout_s * 1e3, 1))
+            body = dict(body)
+            body[_trace.BODY_KEY] = aspan.wire()
+            if probe or rep.breaker.state != "closed":
+                tspan.mark("breaker")
         with self._lock:
             rep.router_inflight_rows += nrows
         t0 = time.perf_counter()
@@ -667,6 +782,9 @@ class FleetRouter:
                 "POST", f"/v1/models/{name}:predict", body,
                 timeout_s=timeout_s)
         except ReplicaTransportError as exc:
+            if aspan is not None:
+                aspan.set(error=str(exc))
+                aspan.finish()
             if isinstance(exc.__cause__, TimeoutError):
                 # count the wait as a latency sample: "at least this
                 # slow" is exactly the evidence that drains a gray
@@ -691,6 +809,11 @@ class FleetRouter:
             else:
                 rep.breaker.record_failure(probe)
                 self._mark_down(idx, str(exc))
+            if rep.breaker.state == "open":
+                # a breaker just opened (or re-opened): failure burst —
+                # snapshot the flight recorder while the evidence is
+                # still in the ring (rate-limited, needs trace_dir)
+                self.tracer.maybe_dump("breaker_open")
             return None, {"error": str(exc)}
         finally:
             with self._lock:
@@ -709,10 +832,15 @@ class FleetRouter:
             # polled gauges); both still reroute, they just aren't
             # breaker evidence
             rep.breaker.record_failure(probe)
+            if rep.breaker.state == "open":
+                self.tracer.maybe_dump("breaker_open")
         else:
             # neutral outcome (429/504/4xx): in half-open this releases
             # the probe slot the attempt consumed
             rep.breaker.record_neutral(probe)
+        if aspan is not None:
+            aspan.set(status=status)
+            aspan.finish()
         return status, payload
 
     def _hedge_delay_s(self, idx: int) -> Optional[float]:
@@ -750,7 +878,8 @@ class FleetRouter:
 
     def _attempt_maybe_hedged(self, idx: int, name: str, body: dict,
                               nrows: int, timeout_s: float, tried: set,
-                              deadline_t: Optional[float] = None
+                              deadline_t: Optional[float] = None,
+                              tspan=None
                               ) -> List[Tuple[int, Optional[int], dict]]:
         """Forward to `idx`, duplicating to the next-best peer if the
         primary outlives its hedge delay and the hedge + retry budgets
@@ -773,10 +902,10 @@ class FleetRouter:
             # Tracked with the router's own in-flight counter, not the
             # executor's private internals
             return [(idx, *self._attempt(idx, name, body, nrows,
-                                         timeout_s))]
+                                         timeout_s, None, tspan))]
         started = threading.Event()
         primary = self._hedge_submit(idx, name, body, nrows, timeout_s,
-                                     started)
+                                     started, tspan)
         # an attempt can legitimately run ~2x its HTTP timeout (the
         # stale-conn retry inside HttpReplica) — the hard waits below
         # must outlast that, and a primary that never answers within
@@ -822,6 +951,9 @@ class FleetRouter:
         if not granted:
             if alt is not None:
                 self._m_hedge_denied.inc()
+                if tspan is not None:
+                    tspan.event("router.hedge_denied",
+                                replica=self._replicas[alt].endpoint.name)
             return _await_primary()
         hbody, h_timeout = body, timeout_s
         if deadline_t is not None:
@@ -839,7 +971,16 @@ class FleetRouter:
             h_timeout = min(timeout_s, rem)
         tried.add(alt)
         self._m_hedges.inc()
-        hedge = self._hedge_submit(alt, name, hbody, nrows, h_timeout)
+        if tspan is not None:
+            # mark BEFORE the duplicate is sent: its wire context then
+            # carries the keep hint, so the hedge target persists its
+            # half of a trace this router already decided matters
+            tspan.mark("hedged")
+            tspan.event("router.hedge",
+                        replica=self._replicas[alt].endpoint.name,
+                        delay_ms=round(delay * 1e3, 2))
+        hedge = self._hedge_submit(alt, name, hbody, nrows, h_timeout,
+                                   None, tspan)
         futs = {primary: idx, hedge: alt}
         outcomes: List[Tuple[int, Optional[int], dict]] = []
         pending = set(futs)
@@ -877,6 +1018,11 @@ class FleetRouter:
                 if st is not None and not _retryable(st):
                     if i == alt:
                         self._m_hedge_wins.inc()
+                        if tspan is not None:
+                            tspan.mark("hedge_win")
+                            tspan.event(
+                                "router.hedge_win",
+                                replica=self._replicas[alt].endpoint.name)
                     return outcomes
         if not outcomes:
             outcomes.append((idx, None, {"error": "attempt stalled past "
@@ -885,6 +1031,8 @@ class FleetRouter:
 
     def _forward_predict(self, name: str, body: dict) -> Tuple[int, dict]:
         self._m_requests.inc()
+        mm = self._model_stats(name)
+        mm.requests.inc()
         self.retry_budget.deposit()
         self.hedge_budget.deposit()
         t0 = time.perf_counter()
@@ -902,6 +1050,51 @@ class FleetRouter:
             deadline_ms = self.default_deadline_ms
         deadline_t = (None if deadline_ms is None
                       else t0 + float(deadline_ms) / 1e3)
+        # trace root: minted here (or adopted from an upstream client's
+        # context) and stamped with every routing decision below
+        ctx = body.get(_trace.BODY_KEY)
+        tspan = self.tracer.start_request(
+            "router.predict", ctx=ctx if isinstance(ctx, dict) else None,
+            model=name, rows=nrows)
+        if tspan is None:
+            status, payload = self._forward_attempts(
+                name, body, nrows, deadline_ms, deadline_t, t0, mm, None)
+        else:
+            if deadline_ms is not None:
+                tspan.set(deadline_ms=round(float(deadline_ms), 1))
+            if self.policy.p99_ms and not self.tracer.keep_slo_ms:
+                # without an explicit trace_keep_slo_ms, the router's own
+                # SLO target is the breach line for the tail keep rule
+                tspan.set(slo_ms=self.policy.p99_ms)
+            try:
+                with _trace.activate(tspan):
+                    status, payload = self._forward_attempts(
+                        name, body, nrows, deadline_ms, deadline_t, t0,
+                        mm, tspan)
+            except BaseException as exc:
+                # a request that died mid-route is exactly what tail
+                # sampling exists to capture — complete its trace as the
+                # 500 handle() is about to answer, then let it propagate
+                tspan.finish_request(status=500, error=repr(exc))
+                raise
+        elapsed = time.perf_counter() - t0
+        mm.window.observe(elapsed)
+        mm.outcomes.observe(1.0 if status == 504 else 0.0)
+        if status == 200:
+            mm.latency_hist.observe(elapsed)
+            mm.rows.observe(float(nrows))
+        elif status == 504:
+            mm.missed.inc()
+        if tspan is not None:
+            if isinstance(payload, dict):
+                payload.setdefault("trace_id", tspan.trace_id)
+            tspan.finish_request(status=status)
+        return status, payload
+
+    def _forward_attempts(self, name: str, body: dict, nrows: int,
+                          deadline_ms, deadline_t: Optional[float],
+                          t0: float, mm: _ModelStats,
+                          tspan) -> Tuple[int, dict]:
         attempts = 0
         candidates = self._ranked()
         tried: set = set()
@@ -915,6 +1108,9 @@ class FleetRouter:
                 # request would spend replica admission + device time on
                 # an answer nobody is waiting for
                 self._m_deadline.inc()
+                if tspan is not None:
+                    tspan.event("router.deadline_refused",
+                                attempts=attempts)
                 return 504, {"error": "deadline exceeded at router "
                                       f"(budget {float(deadline_ms):g}ms, "
                                       f"attempts {attempts})"}
@@ -926,10 +1122,21 @@ class FleetRouter:
                     # brownout backpressure: no token for another attempt
                     # — an honest 503 now beats amplifying the overload
                     self._m_retry_denied.inc()
+                    if tspan is not None:
+                        tspan.event("router.retry_budget_exhausted",
+                                    attempts=attempts)
                     return 503, {"error": "retry budget exhausted; last: "
                                           f"{last_err}"}
                 token_spent = True
             attempts += 1
+            if tspan is not None:
+                # the routing decision, with the evidence it was made on
+                rep = self._replicas[idx]
+                tspan.event("router.pick", replica=rep.endpoint.name,
+                            attempt=attempts, breaker=rep.breaker.state,
+                            load_rows=(rep.load_rows
+                                       + rep.router_inflight_rows),
+                            retry_token=token_spent)
             timeout_s = (self.request_timeout_s if remaining is None
                          else min(self.request_timeout_s, remaining))
             fwd_body = body
@@ -941,7 +1148,8 @@ class FleetRouter:
                 fwd_body = dict(body)
                 fwd_body["deadline_ms"] = remaining * 1e3
             outcomes = self._attempt_maybe_hedged(
-                idx, name, fwd_body, nrows, timeout_s, tried, deadline_t)
+                idx, name, fwd_body, nrows, timeout_s, tried, deadline_t,
+                tspan)
             decisive = next(
                 (o for o in outcomes
                  if o[1] is not None and not _retryable(o[1])), None)
@@ -951,6 +1159,10 @@ class FleetRouter:
                 self.latency.observe(elapsed)
                 self._m_latency.observe(elapsed)
                 self._m_forwarded[served_idx].inc()
+                if tspan is not None:
+                    tspan.set(
+                        replica=self._replicas[served_idx].endpoint.name,
+                        attempts=attempts)
                 if isinstance(payload, dict):
                     payload.setdefault(
                         "replica", self._replicas[served_idx].endpoint.name)
@@ -983,14 +1195,24 @@ class FleetRouter:
                         tried.discard(i)
             else:
                 self._m_reroutes.inc()
+                mm.reroutes.inc()
+                if tspan is not None:
+                    tspan.mark("rerouted")
+                    tspan.event("router.reroute", attempt=attempts,
+                                last_error=last_err)
             candidates = [i for i in self._ranked() if i not in tried]
         if last_err is None:
             # nothing was routable to begin with: SLO shedding
             self._m_shed.inc()
+            mm.shed.inc()
+            if tspan is not None:
+                tspan.event("router.shed")
+            self.tracer.maybe_dump("shed")
             states = self.replica_states()
             return 503, {"error": "fleet shedding load: no replica within "
                                   "SLO", "replicas": states}
         self._m_errors.inc()
+        mm.errors.inc()
         return 503, {"error": f"no replica could serve the request; "
                               f"last: {last_err}"}
 
@@ -1114,6 +1336,7 @@ class FleetRouter:
             # back — a rollback on a replica whose publish never landed
             # would withdraw its previous GOOD version instead.
             self._m_publish_partial.inc()
+            self.tracer.maybe_dump("publish_partial")
             base_path = path[:path.rfind(":")]
             to_undo = [rep for rep in self._replicas
                        if results[rep.endpoint.name]["status"] == 200]
@@ -1171,6 +1394,55 @@ class FleetRouter:
                                           "succeeded": ok}
 
     # ------------------------------------------------------------------
+    def _trace_detail(self, trace_id: str) -> Tuple[int, dict]:
+        """Cross-process trace assembly on demand: this router's own
+        spans for ``trace_id`` merged with every replica's
+        (``GET /v1/trace/<id>`` fan-out against their flight-recorder
+        rings) — the full causal chain of one request, hop by hop.
+        Unreachable replicas are skipped; a trace nobody remembers is a
+        404."""
+        own = self.tracer.recorder.get(trace_id)
+        spans: List[dict] = list(own.get("spans", [])) if own else []
+        processes = 1 if own else 0
+        timeout_s = max(self.health_timeout_s, 1.0)
+
+        def _one(rep):
+            # best-effort: a down/faked replica contributes nothing
+            try:
+                return rep.endpoint.request(
+                    "GET", f"/v1/trace/{trace_id}", None,
+                    timeout_s=timeout_s)
+            except Exception:
+                return None, None
+
+        # parallel fan-out on the broadcast pool (same rationale as
+        # _broadcast): several unreachable replicas queried serially
+        # would stall this debug route by N x timeout exactly during the
+        # incident it exists for
+        futures = [self._bcast_pool.submit(_one, rep)
+                   for rep in self._replicas]
+        for fut in futures:
+            try:
+                status, payload = fut.result(timeout_s + 5.0)
+            except Exception:
+                continue
+            if status == 200 and isinstance(payload, dict):
+                spans.extend(payload.get("spans") or [])
+                processes += 1
+        if not spans:
+            return 404, {"error": f"no trace {trace_id!r} in any flight "
+                                  "recorder"}
+        spans.sort(key=lambda s: (float(s.get("start_unix_s", 0.0)),
+                                  str(s.get("span_id", ""))))
+        out = {"trace_id": trace_id, "processes": processes,
+               "spans": spans}
+        if own is not None:
+            out["status"] = own.get("status")
+            out["kept"] = own.get("kept")
+            out["keep"] = own.get("keep")
+            out["dur_ms"] = own.get("dur_ms")
+        return 200, out
+
     def replica_states(self) -> Dict[str, Dict]:
         sup = self.supervisor
         with self._lock:
@@ -1229,13 +1501,19 @@ class FleetRouter:
         if method == "GET" and path == "/v1/fleet/replicas":
             return 200, {"replicas": self.replica_states()}
         if method == "GET" and path == "/v1/metrics":
+            self.refresh_model_gauges()
             out = {"router": self.registry.snapshot(),
                    "replicas": self.replica_states()}
             out["router"]["p_ms"] = self.latency.percentiles()
             return 200, out
         if method == "GET" and path == "/v1/metrics/prometheus":
             from ..telemetry import prometheus_text
+            self.refresh_model_gauges()
             return 200, prometheus_text(self.registry)
+        if method == "GET" and path == "/v1/trace/recent":
+            return 200, {"traces": self.tracer.recorder.recent()}
+        if method == "GET" and path.startswith("/v1/trace/"):
+            return self._trace_detail(path[len("/v1/trace/"):])
         if method == "GET" and path == "/v1/models":
             for idx in self._ranked():
                 try:

@@ -2,15 +2,29 @@
 
 Reference: utils/log.h:71-170 (Log::Debug/Info/Warning/Fatal with thread-local
 callback redirection installed by bindings via LGBM_RegisterLogCallback).
+
+Two additions for fleet observability (telemetry/trace.py):
+
+- **trace correlation** — a registered trace provider supplies the
+  thread's active distributed-trace id, and every WARNING emitted while a
+  trace is active carries it (``[trace_id=...]`` suffix in plain mode, a
+  ``trace_id`` field in JSON mode), so a replica's warning lines join up
+  with the router-side trace of the request that caused them.
+- **structured JSON line mode** — ``set_json_lines(True)`` (config
+  ``trace_log_json``, env ``LIGHTGBM_TPU_LOG_JSON=1``) emits one JSON
+  object per line (``{"level", "msg", "trace_id"?}``) instead of the
+  bracketed prefix, for log pipelines that ingest structured events.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Callable, Optional
 
 __all__ = ["log_debug", "log_info", "log_warning", "log_fatal",
            "register_log_callback", "set_verbosity", "apply_verbosity",
+           "set_json_lines", "json_lines_enabled", "set_trace_provider",
            "LightGBMError"]
 
 
@@ -20,6 +34,9 @@ class LightGBMError(Exception):
 
 _VERBOSITY = 1
 _CALLBACK: Optional[Callable[[str], None]] = None
+# exact historical truthiness (any non-empty value except "0" enables)
+_JSON_LINES = os.environ.get("LIGHTGBM_TPU_LOG_JSON", "") not in ("", "0")
+_TRACE_PROVIDER: Optional[Callable[[], Optional[str]]] = None
 
 
 def set_verbosity(v: int) -> None:
@@ -45,26 +62,67 @@ def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
     _CALLBACK = cb
 
 
-def _emit(msg: str) -> None:
-    if _CALLBACK is not None:
-        _CALLBACK(msg + "\n")
+def set_json_lines(value: bool) -> None:
+    """Runtime switch for structured one-JSON-object-per-line output
+    (``LIGHTGBM_TPU_LOG_JSON`` sets the import-time default)."""
+    global _JSON_LINES
+    _JSON_LINES = bool(value)
+
+
+def json_lines_enabled() -> bool:
+    return _JSON_LINES
+
+
+def set_trace_provider(fn: Optional[Callable[[], Optional[str]]]) -> None:
+    """Register a zero-arg callable returning the thread's active
+    distributed-trace id (or None).  telemetry/trace.py installs it on
+    import; log.py stays import-light and never imports telemetry."""
+    global _TRACE_PROVIDER
+    _TRACE_PROVIDER = fn
+
+
+def _active_trace_id() -> Optional[str]:
+    if _TRACE_PROVIDER is None:
+        return None
+    try:
+        return _TRACE_PROVIDER()
+    except Exception:
+        return None   # a broken provider must never break logging
+
+
+def _emit(level: str, msg: str, with_trace: bool = False) -> None:
+    trace_id = _active_trace_id() if (with_trace or _JSON_LINES) else None
+    if _JSON_LINES:
+        import json
+        rec = {"level": level, "msg": msg}
+        if trace_id:
+            rec["trace_id"] = trace_id
+        line = json.dumps(rec)
     else:
-        print(msg, file=sys.stderr)
+        line = f"[LightGBM-TPU] [{level.capitalize()}] {msg}"
+        if with_trace and trace_id:
+            line += f" [trace_id={trace_id}]"
+    if _CALLBACK is not None:
+        _CALLBACK(line + "\n")
+    else:
+        print(line, file=sys.stderr)
 
 
 def log_debug(msg: str) -> None:
     if _VERBOSITY >= 2:
-        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+        _emit("debug", msg)
 
 
 def log_info(msg: str) -> None:
     if _VERBOSITY >= 1:
-        _emit(f"[LightGBM-TPU] [Info] {msg}")
+        _emit("info", msg)
 
 
 def log_warning(msg: str) -> None:
     if _VERBOSITY >= 0:
-        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+        # warnings emitted inside a traced request carry its trace_id —
+        # the router/replica log-correlation contract
+        _emit("warning", msg, with_trace=True)
 
 
 def log_fatal(msg: str) -> None:

@@ -86,13 +86,19 @@ class DeadlineExceededError(LightGBMError):
 
 
 class _Request:
-    __slots__ = ("rows", "future", "t_enqueue", "deadline_t")
+    __slots__ = ("rows", "future", "t_enqueue", "deadline_t", "trace")
 
-    def __init__(self, rows: np.ndarray, deadline_t: Optional[float] = None):
+    def __init__(self, rows: np.ndarray, deadline_t: Optional[float] = None,
+                 trace_span=None):
         self.rows = rows
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline_t = deadline_t
+        # distributed-trace span of the enclosing request (telemetry/
+        # trace.py TraceSpan or None): the worker stamps queue-wait and
+        # device-flush child spans onto it so a trace shows exactly where
+        # a request's budget went inside the batcher
+        self.trace = trace_span
 
 
 class MicroBatcher:
@@ -141,7 +147,8 @@ class MicroBatcher:
                 self._thread.start()
         return self
 
-    def submit(self, rows, deadline_t: Optional[float] = None) -> Future:
+    def submit(self, rows, deadline_t: Optional[float] = None,
+               trace_span=None) -> Future:
         """Enqueue one request; the Future resolves to its predictions.
 
         Raises QueueFullError when the request won't fit behind what's
@@ -179,7 +186,7 @@ class MicroBatcher:
                     f"serving queue full: {self._queued_rows} rows waiting, "
                     f"request of {n} exceeds max_queue_rows="
                     f"{self.max_queue_rows}")
-            req = _Request(rows, deadline_t)
+            req = _Request(rows, deadline_t, trace_span)
             self._q.append(req)
             self._queued_rows += n
             if self.metrics is not None:
@@ -188,9 +195,11 @@ class MicroBatcher:
         return req.future
 
     def predict(self, rows, timeout: Optional[float] = None,
-                deadline_t: Optional[float] = None) -> np.ndarray:
+                deadline_t: Optional[float] = None,
+                trace_span=None) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
-        return self.submit(rows, deadline_t=deadline_t).result(timeout)
+        return self.submit(rows, deadline_t=deadline_t,
+                           trace_span=trace_span).result(timeout)
 
     @property
     def queue_depth(self) -> int:
@@ -260,21 +269,32 @@ class MicroBatcher:
                 batch.append(req)
             if self.metrics is not None:
                 self.metrics.record_queue(self._queued_rows)
+        # queue-wait evidence + trace spans BEFORE resolving the expired
+        # futures: a synchronous waiter finishes its trace the moment its
+        # future resolves, and a span recorded after that misses the
+        # flight-recorder snapshot
+        for req in batch + expired:
+            if self.metrics is not None:
+                # expired requests' waits count too — they are the
+                # LONGEST waits, and an estimate built only from
+                # survivors would read low exactly when deadlines are
+                # being missed, keeping admission open for more doomed
+                # work
+                self.metrics.record_queue_wait(now - req.t_enqueue)
+            if req.trace is not None:
+                req.trace.child_at("serving.queue_wait", req.t_enqueue,
+                                   now - req.t_enqueue,
+                                   expired=req.deadline_t is not None
+                                   and now >= req.deadline_t)
         for req in expired:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(DeadlineExceededError(
                     "deadline expired while queued "
                     f"({(now - req.t_enqueue) * 1e3:.1f}ms in queue)"))
             if self.metrics is not None:
-                self.metrics.record_deadline_refusal()
-                self.metrics.record_request(req.rows.shape[0], error=True)
-        if self.metrics is not None:
-            # expired requests' waits count too — they are the LONGEST
-            # waits, and an estimate built only from survivors would
-            # read low exactly when deadlines are being missed, keeping
-            # admission open for more doomed work
-            for req in batch + expired:
-                self.metrics.record_queue_wait(now - req.t_enqueue)
+                self.metrics.record_deadline_refusal(counted_request=True)
+                self.metrics.record_request(req.rows.shape[0], error=True,
+                                            deadline_miss=True)
         return batch
 
     def _flush(self, batch) -> None:
@@ -321,6 +341,15 @@ class MicroBatcher:
         t_done = time.perf_counter()
         for req in batch:
             hi = lo + req.rows.shape[0]
+            if req.trace is not None:
+                # the flush is shared; each rider's trace gets its own
+                # view of it (batch size + fill say how much of the
+                # device time was really "theirs") — recorded BEFORE the
+                # future resolves so a synchronous caller's root span
+                # always contains it
+                req.trace.child_at(
+                    "serving.device_flush", t0, device_s,
+                    batch_rows=int(X.shape[0]), batch_requests=len(batch))
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(
                     out[lo:hi] if meta is _NO_META else (out[lo:hi], meta))
@@ -390,9 +419,11 @@ class MicroBatcher:
                         "deadline expired while queued (drained at "
                         "close)"))
                 if self.metrics is not None:
-                    self.metrics.record_deadline_refusal()
+                    self.metrics.record_deadline_refusal(
+                        counted_request=True)
                     self.metrics.record_request(req.rows.shape[0],
-                                                error=True)
+                                                error=True,
+                                                deadline_miss=True)
             elif drain:
                 self._flush([req])
             else:

@@ -97,6 +97,28 @@ class LatencyWindow:
             out[f"p{int(p)}_ms"] = live[idx] * 1e3
         return out
 
+    def window_sum(self) -> float:
+        """Sum of the retained (and, with ``window_s``, recent) values —
+        observing ROW COUNTS instead of latencies turns the window into
+        a goodput meter (rows over the last window_s seconds)."""
+        with self._lock:
+            k = min(self._n, self._cap)
+            if self.window_s is None:
+                return float(sum(self._buf[:k]))
+            horizon = time.monotonic() - self.window_s
+            return float(sum(v for v, t in zip(self._buf[:k], self._t[:k])
+                             if t >= horizon))
+
+    def window_count(self) -> int:
+        """How many retained observations are still inside the window —
+        the denominator for recent-evidence ratios (miss ratio)."""
+        with self._lock:
+            k = min(self._n, self._cap)
+            if self.window_s is None:
+                return k
+            horizon = time.monotonic() - self.window_s
+            return sum(1 for t in self._t[:k] if t >= horizon)
+
     @property
     def count(self) -> int:
         return self._n
@@ -163,6 +185,22 @@ class ModelMetrics:
         self._compiles = reg.gauge(
             "lgbm_serving_compile_count", "XLA programs compiled for this "
             "model (all versions)", **lab)
+        # per-model SLO gauges (the ROADMAP's router-driven-placement
+        # feed): derived views over the windows below, refreshed by
+        # refresh_slo_gauges() at metrics render time — gauges so any
+        # Prometheus scrape sees them without computing quantiles itself
+        self._slo_p99 = reg.gauge(
+            "lgbm_serving_model_p99_ms",
+            "per-model SLO gauge: p99 of this model's recent request "
+            "latencies in milliseconds", **lab)
+        self._slo_miss = reg.gauge(
+            "lgbm_serving_model_deadline_miss_ratio",
+            "per-model SLO gauge: fraction of recent-window requests "
+            "refused for a spent deadline budget", **lab)
+        self._slo_goodput = reg.gauge(
+            "lgbm_serving_model_goodput_rows_per_s",
+            "per-model SLO gauge: rows served successfully per second "
+            "over the recent window", **lab)
         self.latency = LatencyWindow()
         # recent queue waits (seconds): the admission check's evidence —
         # bounded in COUNT and TIME (not the all-time histogram), because
@@ -172,6 +210,18 @@ class ModelMetrics:
         # record no new waits, so the window would never refresh itself)
         self.queue_wait = LatencyWindow(512, window_s=30.0)
         self._queue_wait_cache = (-1e18, 0.0)   # (monotonic t, estimate)
+        # goodput evidence: row counts of SUCCESSFUL requests with their
+        # wall times — window_sum()/window_s is rows-per-second "now"
+        # (count cap bounds memory; above ~cap/window_s req/s the gauge
+        # reads a shorter effective window, never a wrong rate direction)
+        self.goodput = LatencyWindow(8192, window_s=30.0)
+        # recent-evidence OUTCOME ring for the miss ratio (one sample per
+        # request: 1.0 = deadline miss, 0.0 = anything else): numerator
+        # and denominator come from the same samples, so saturation
+        # cannot skew the ratio (it just shortens the effective window),
+        # and it is time-bounded so one early 504 burst does not pin the
+        # gauge for the process lifetime
+        self.outcomes = LatencyWindow(8192, window_s=60.0)
         self.last_active_s = 0.0   # wall time of the last user request
         # keeps the batch triple (batches, batched_requests, batched_rows)
         # mutually consistent between record_batch and the ratio reads in
@@ -217,15 +267,21 @@ class ModelMetrics:
 
     # -- recording -------------------------------------------------------
     def record_request(self, rows: int, latency_s: Optional[float] = None,
-                       error: bool = False) -> None:
+                       error: bool = False,
+                       deadline_miss: bool = False) -> None:
         """One USER-FACING request (batcher scatter or app direct path).
         The predictor's own device call is recorded separately via
-        record_device, so coalesced traffic isn't double-counted."""
+        record_device, so coalesced traffic isn't double-counted.
+        ``deadline_miss`` marks this request's outcome a 504 for the SLO
+        miss-ratio ring (the batcher's expired-in-queue path)."""
         self._requests.inc()
         self._rows.inc(int(rows))
         self.last_active_s = time.time()
+        self.outcomes.observe(1.0 if deadline_miss else 0.0)
         if error:
             self._errors.inc()
+        else:
+            self.goodput.observe(float(rows))
         if latency_s is not None:
             self.latency.observe(latency_s)
             self._latency_hist.observe(latency_s)
@@ -269,8 +325,23 @@ class ModelMetrics:
         self._queue_wait_cache = (now, v)
         return v
 
-    def record_deadline_refusal(self) -> None:
+    def record_deadline_refusal(self, counted_request: bool = False) -> None:
+        """``counted_request``: the caller ALSO records this request via
+        ``record_request(deadline_miss=True)`` (the batcher's
+        expired-in-queue path) — its outcome sample rides that call, not
+        this one, so the ratio counts it exactly once."""
         self._deadline_refused.inc()
+        if not counted_request:
+            self.outcomes.observe(1.0)
+
+    def refresh_slo_gauges(self) -> None:
+        """Recompute the derived per-model SLO gauges from the live
+        windows (called at metrics render, not per request)."""
+        self._slo_p99.set(self.latency.percentiles()["p99_ms"])
+        n = self.outcomes.window_count()
+        self._slo_miss.set(self.outcomes.window_sum() / n if n else 0.0)
+        window_s = self.goodput.window_s or 1.0
+        self._slo_goodput.set(self.goodput.window_sum() / window_s)
 
     @property
     def deadline_refused(self) -> int:
@@ -337,6 +408,15 @@ class ServingMetrics:
             if m is None:
                 m = self._models[name] = ModelMetrics(name, self.registry)
             return m
+
+    def refresh_slo_gauges(self) -> None:
+        """Refresh every model's derived SLO gauges (p99 / deadline-miss
+        ratio / goodput) — the Prometheus route calls this so scrapes
+        always see current values."""
+        with self._lock:
+            models = list(self._models.values())
+        for m in models:
+            m.refresh_slo_gauges()
 
     def snapshot(self, compile_counts: Optional[Dict[str, int]] = None) -> Dict:
         compile_counts = compile_counts or {}

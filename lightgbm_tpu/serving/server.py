@@ -46,6 +46,7 @@ import numpy as np
 
 from ..checkpoint.fault import RequestFaultLatch
 from ..log import LightGBMError
+from ..telemetry import trace as _trace
 from .batcher import (DeadlineExceededError, MicroBatcher, QueueFullError,
                       ServingClosedError)
 from .metrics import ServingMetrics
@@ -89,10 +90,15 @@ class ServingApp:
                  max_batch: int = 1024, max_wait_ms: float = 2.0,
                  max_queue_rows: int = 16384, batching: bool = True,
                  continuous: bool = True,
-                 default_deadline_ms: float = 0.0):
+                 default_deadline_ms: float = 0.0,
+                 tracer=None):
         self.metrics = metrics or ServingMetrics()
         self.registry = registry or ModelRegistry(metrics=self.metrics)
         self.batching = batching
+        # distributed tracing (telemetry/trace.py): adopts the wire
+        # context a router forwarded in the request body, or roots a new
+        # trace for direct traffic.  Disabled tracer = None spans = no-op
+        self.tracer = tracer if tracer is not None else _trace.TRACER
         # deadline a predict gets when its body carries none (0 = no
         # default: such requests wait as long as they must).  A router
         # in front always forwards an explicit remaining budget, so this
@@ -204,6 +210,15 @@ class ServingApp:
             return 200, self.metrics.snapshot(self.registry.compile_counts())
         if method == "GET" and path == "/v1/metrics/prometheus":
             return 200, self._prometheus()
+        if method == "GET" and path == "/v1/trace/recent":
+            return 200, {"traces": self.tracer.recorder.recent()}
+        if method == "GET" and path.startswith("/v1/trace/"):
+            tid = path[len("/v1/trace/"):]
+            own = self.tracer.recorder.get(tid)
+            if own is None:
+                return 404, {"error": f"no trace {tid!r} in this "
+                                      "process's flight recorder"}
+            return 200, own
         if path.startswith("/v1/models/") and ":" in path:
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
@@ -245,6 +260,9 @@ class ServingApp:
         # refresh the per-model compile gauges from the live predictors
         for name, count in self.registry.compile_counts().items():
             self.metrics.model(name).set_compile_count(count)
+        # derived per-model SLO gauges (p99 / deadline-miss ratio /
+        # goodput) recomputed at scrape time
+        self.metrics.refresh_slo_gauges()
         return prometheus_text(self.metrics.registry, REGISTRY)
 
     def _publish(self, name: str, body: dict) -> Tuple[int, dict]:
@@ -264,6 +282,49 @@ class ServingApp:
         return 200, {"name": name, "version": version}
 
     def _predict(self, name: str, body: dict) -> Tuple[int, dict]:
+        """Trace wrapper around the predict path: roots (or adopts) this
+        hop's span, finishes it with the outcome status whatever the
+        exit path — the HTTP status mapping itself stays in handle()."""
+        ctx = body.get(_trace.BODY_KEY)
+        span = self.tracer.start_request(
+            "replica.predict", ctx=ctx if isinstance(ctx, dict) else None,
+            model=name)
+        if span is None:                       # tracing off: zero overhead
+            return self._predict_inner(name, body, None)
+        try:
+            with _trace.activate(span):
+                status, payload = self._predict_inner(name, body, span)
+        except QueueFullError:
+            span.finish_request(status=429)
+            raise
+        except DeadlineExceededError:
+            span.finish_request(status=504)
+            raise
+        except ServingClosedError:
+            span.finish_request(status=503)
+            raise
+        except LightGBMError as exc:
+            span.finish_request(
+                status=404 if "no model published" in str(exc) else 400,
+                error=str(exc))
+            raise
+        except (KeyError, ValueError, TypeError, OSError) as exc:
+            # handle() maps these to the client's 400 — the trace must
+            # agree, or bad-input fuzzing reads as a 5xx storm in the
+            # flight recorder and force-keeps every poisoned request
+            span.finish_request(status=400, error=f"{type(exc).__name__}")
+            raise
+        except Exception as exc:
+            span.finish_request(status=500, error=repr(exc))
+            raise
+        if isinstance(payload, dict):
+            span.set(version=payload.get("version"))
+            payload.setdefault("trace_id", span.trace_id)
+        span.finish_request(status=status)
+        return status, payload
+
+    def _predict_inner(self, name: str, body: dict,
+                       span) -> Tuple[int, dict]:
         # fault injection BEFORE serving: a killed replica loses this
         # in-flight request with the process — the case the fleet
         # router's reroute-and-retry must absorb for zero failed requests
@@ -319,7 +380,8 @@ class ServingApp:
                     f"predict called with {rows.shape[1]} features; model "
                     f"{name!r} expects {nfeat}")
             out, served_version = batcher.predict(rows,
-                                                  deadline_t=deadline_t)
+                                                  deadline_t=deadline_t,
+                                                  trace_span=span)
         else:
             # the non-batched path has no queue, but the deadline still
             # gates DISPATCH: a pinned-version/sliced predict whose
@@ -331,9 +393,19 @@ class ServingApp:
                 raise DeadlineExceededError(
                     f"deadline budget ({float(deadline_ms):g}ms) spent "
                     "before dispatch")
-            with self.registry.acquire(name, version) as (pred, v):
-                out = pred.predict(rows, **kwargs)
-                served_version = v
+            dspan = (None if span is None
+                     else span.child("replica.device",
+                                     rows=int(rows.shape[0])))
+            try:
+                with self.registry.acquire(name, version) as (pred, v):
+                    out = pred.predict(rows, **kwargs)
+                    served_version = v
+            finally:
+                # finish even when predict raises: the trace that should
+                # show WHERE the device call died must not serialize its
+                # device span as in-flight/instant
+                if dspan is not None:
+                    dspan.finish()
             self.metrics.model(name).record_request(
                 rows.shape[0], latency_s=time.perf_counter() - t0)
         return 200, {"name": name, "version": served_version,

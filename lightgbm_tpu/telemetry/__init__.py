@@ -34,15 +34,22 @@ registry, and export are light.
 from . import spans
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                        get_counter)
-from .export import (JsonlEventLog, chrome_trace, prometheus_text,
-                     rollup_telemetry_dir, write_chrome_trace)
+from .export import (JsonlEventLog, assemble_traces, chrome_trace,
+                     prometheus_text, read_trace_spans,
+                     rollup_telemetry_dir, trace_chrome_trace,
+                     write_chrome_trace, write_trace_chrome_trace)
 from .spans import span, set_enabled, set_recording, set_context
+from . import trace
+from .trace import TRACER, Tracer
 
 __all__ = ["spans", "span", "set_enabled", "set_recording", "set_context",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "get_counter",
            "prometheus_text", "chrome_trace", "write_chrome_trace",
-           "JsonlEventLog", "rollup_telemetry_dir"]
+           "JsonlEventLog", "rollup_telemetry_dir",
+           "trace", "TRACER", "Tracer", "assemble_traces",
+           "read_trace_spans", "trace_chrome_trace",
+           "write_trace_chrome_trace"]
 
 
 def __getattr__(name):

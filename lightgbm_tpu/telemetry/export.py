@@ -29,7 +29,9 @@ from .registry import Histogram, MetricsRegistry
 from . import spans as _spans
 
 __all__ = ["prometheus_text", "chrome_trace", "write_chrome_trace",
-           "JsonlEventLog", "rank_jsonl_path", "rollup_telemetry_dir"]
+           "JsonlEventLog", "rank_jsonl_path", "rollup_telemetry_dir",
+           "read_trace_spans", "assemble_traces", "trace_chrome_trace",
+           "write_trace_chrome_trace"]
 
 
 # ---------------------------------------------------------------------------
@@ -117,6 +119,86 @@ def write_chrome_trace(path: str,
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as fh:
         json.dump(chrome_trace(span_list), fh)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Distributed-trace collector (telemetry/trace.py span sinks)
+# ---------------------------------------------------------------------------
+def read_trace_spans(trace_dir: str) -> List[Dict]:
+    """Every trace span recorded under ``trace_dir`` (recursive glob over
+    the per-rank ``trace_spans_rank*.jsonl`` sinks — a fleet's processes
+    may each own a subdirectory).  Torn lines from killed workers are
+    skipped, same policy as the telemetry rollup."""
+    import glob
+    out: List[Dict] = []
+    # "**" matches zero path segments too, so one recursive glob covers
+    # both top-level rank files and per-process subdirectories
+    pattern = os.path.join(trace_dir, "**", "trace_spans_rank*.jsonl")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("kind") == "trace_span" and rec.get("trace_id"):
+                    out.append(rec)
+    return out
+
+
+def assemble_traces(spans_or_dir) -> Dict[str, List[Dict]]:
+    """Group spans by trace_id (the cross-process assembly step): accepts
+    a trace_dir or an iterable of span dicts, returns
+    ``{trace_id: [span, ...]}`` with each trace's spans sorted by start
+    time — one request's full causal chain across every process that
+    recorded a piece of it."""
+    spans = (read_trace_spans(spans_or_dir)
+             if isinstance(spans_or_dir, str) else list(spans_or_dir))
+    traces: Dict[str, List[Dict]] = {}
+    for s in spans:
+        traces.setdefault(str(s["trace_id"]), []).append(s)
+    for tid in traces:
+        traces[tid].sort(key=lambda s: (float(s.get("start_unix_s", 0.0)),
+                                        str(s.get("span_id", ""))))
+    return traces
+
+
+def trace_chrome_trace(spans: Iterable[Dict]) -> Dict:
+    """Assembled trace spans -> one Chrome-trace/Perfetto dict.  Each
+    RANK renders as a process row (pid = rank, so cross-process hops are
+    visually stacked), threads within a rank as tracks; span attributes
+    (replica picked, breaker state, version) land in ``args``."""
+    spans = sorted(spans, key=lambda s: float(s.get("start_unix_s", 0.0)))
+    ranks = sorted({int(s.get("rank", 0)) for s in spans})
+    events: List[Dict] = [
+        {"name": "process_name", "ph": "M", "pid": r, "tid": 0,
+         "args": {"name": f"lightgbm_tpu rank {r}"}} for r in ranks]
+    for s in spans:
+        args = dict(s.get("attrs") or {})
+        args["trace_id"] = s.get("trace_id")
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id") is not None:
+            args["parent_id"] = s.get("parent_id")
+        events.append({
+            "name": s.get("name", "span"), "ph": "X",
+            "pid": int(s.get("rank", 0)),
+            "tid": int(s.get("thread_id", 0)),
+            "ts": float(s.get("start_unix_s", 0.0)) * 1e6,
+            "dur": float(s.get("dur_s", 0.0)) * 1e6,
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_trace_chrome_trace(path: str, spans: Iterable[Dict]) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(trace_chrome_trace(spans), fh, sort_keys=True)
     return path
 
 

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 __all__ = ["Span", "PhaseTimer", "global_timer", "span", "enabled",
            "set_enabled", "recording", "set_recording", "set_context",
            "get_context", "recorded_spans", "clear_recorded",
-           "current_span"]
+           "current_span", "set_trace_id_provider"]
 
 # wall-clock epoch matching perf_counter 0, so exported timestamps are
 # absolute while in-process math stays on the monotonic clock
@@ -74,6 +74,17 @@ _ids = itertools.count(1)
 _tls = threading.local()
 _ctx_lock = threading.Lock()
 _context: Dict[str, Any] = {}   # process-wide attrs stamped on every span
+
+# distributed-trace correlation: telemetry/trace.py registers a provider
+# returning the thread's active trace id, and recorded spans carry it as
+# an attribute — only consulted when event recording is on, so the plain
+# timer fast path never pays the lookup
+_TRACE_ID_PROVIDER = None
+
+
+def set_trace_id_provider(fn) -> None:
+    global _TRACE_ID_PROVIDER
+    _TRACE_ID_PROVIDER = fn
 
 
 class Span:
@@ -204,6 +215,10 @@ def span(name: str, sync=None, **attrs):
     stack = _stack()
     merged = get_context()
     merged.update(attrs)
+    if _recording and _TRACE_ID_PROVIDER is not None:
+        tid = _TRACE_ID_PROVIDER()
+        if tid is not None:
+            merged.setdefault("trace_id", tid)
     s = Span(name, stack[-1] if stack else None, merged)
     stack.append(s)
     try:

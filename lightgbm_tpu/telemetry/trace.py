@@ -1,0 +1,586 @@
+"""Distributed request tracing + tail-sampled flight recorder.
+
+PR 4's spans are process-local; since the fleet tier a single predict
+crosses router -> hedge peer -> replica HTTP -> MicroBatcher queue ->
+device flush, with breakers, retry budgets, and deadline squeezes deciding
+its fate.  This module ties those hops together:
+
+- a ``TraceSpan`` tree per request: the root is minted at the first traced
+  hop (``Tracer.start_request``), children record routing decisions
+  (pick / reroute / hedge / hedge-win), per-attempt forwards, replica
+  admission, queue wait, and the device flush.  The wire context (trace
+  id + parent span id + hop count + sampling verdict) rides the request
+  body under ``BODY_KEY`` alongside the existing ``deadline_ms``, so HTTP
+  hops propagate it for free.
+- **head sampling + tail-based keep**: every traced request records its
+  spans in memory (a handful of small objects); whether the finished
+  trace is *persisted* is decided at completion — head-sampled traces
+  (``sample_rate``) always keep, and tail rules force-keep anything
+  interesting regardless of the coin flip: SLO breach, hedged, rerouted,
+  breaker involvement, 503/504 death.  A hedge duplicate carries a
+  ``keep`` hint in its wire context so the downstream hop persists its
+  half of a trace the root already marked.
+- **flight recorder**: a bounded ring of the most recent completed traces
+  per process (kept or not), dumped to disk on demand and — rate-limited
+  — when the router sees a failure burst (breaker open, shed, partial
+  publish).  ``GET /v1/trace/recent`` and ``GET /v1/trace/<id>`` serve it;
+  the router's ``/v1/trace/<id>`` additionally fans out to its replicas
+  and assembles the cross-process span set.
+- **per-rank JSONL sink**: kept traces append one JSON line per span to
+  ``trace_spans_rank<R>-<pid>.jsonl`` under ``trace_dir``;
+  ``telemetry.export.assemble_traces`` groups any number of rank files by
+  trace id and renders the merged set through the Chrome-trace writer.
+
+The disabled fast path is one attribute read returning ``None``; every
+call site guards on that, so ``trace_requests=false`` is a no-op on the
+hot path (guard-tested).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from .. import log as _log
+from . import spans as _spans
+
+__all__ = ["BODY_KEY", "TraceSpan", "Tracer", "FlightRecorder", "TRACER",
+           "activate", "current", "current_trace_id", "child_span",
+           "configure_from_config"]
+
+# request-body key the wire context rides under (next to deadline_ms)
+BODY_KEY = "trace"
+
+# wall-clock epoch matching perf_counter 0 (same convention as spans.py)
+_EPOCH = time.time() - time.perf_counter()
+
+_ids = itertools.count(1)
+_PID = os.getpid()
+
+
+def _new_span_id() -> str:
+    # unique across processes without uuid cost: pid tag + local counter
+    return f"{_PID:x}.{next(_ids)}"
+
+
+# trace ids only need to be unique and unguessable-enough to never
+# collide across a fleet; a seeded-per-process SystemRandom-free 64-bit
+# draw is ~4x cheaper than uuid4 on the mint path
+_id_rng = random.Random(int.from_bytes(os.urandom(8), "big") ^ _PID)
+_id_lock = threading.Lock()
+
+
+def _new_trace_id() -> str:
+    with _id_lock:
+        return f"{_id_rng.getrandbits(64):016x}"
+
+
+class TraceSpan:
+    """One node of a request's span tree (always owned by a ``_Trace``)."""
+
+    __slots__ = ("_trace", "span_id", "parent_id", "name", "start_unix_s",
+                 "_t0", "dur_s", "thread_id", "attrs", "finished")
+
+    def __init__(self, trace: "_Trace", name: str,
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self._trace = trace
+        self.span_id = _new_span_id()
+        self.parent_id = parent_id
+        self.name = name
+        self._t0 = time.perf_counter()
+        self.start_unix_s = self._t0 + _EPOCH
+        self.dur_s = 0.0
+        self.thread_id = threading.get_ident()
+        self.finished = False
+        # ownership, not a copy: every caller passes a fresh kwargs dict
+        self.attrs = attrs
+
+    # ------------------------------------------------------------------
+    @property
+    def trace_id(self) -> str:
+        return self._trace.trace_id
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def mark(self, reason: str) -> None:
+        """Tail-based keep rule: a trace marked with any reason is
+        persisted regardless of the head-sampling coin flip."""
+        self._trace.mark(reason)
+
+    def child(self, name: str, **attrs) -> "TraceSpan":
+        return self._trace.add_span(name, self.span_id, attrs)
+
+    def event(self, name: str, **attrs) -> "TraceSpan":
+        """Zero-duration child: a point-in-time decision (pick, hedge,
+        reroute, verdict) stamped on the timeline."""
+        e = self.child(name, **attrs)
+        e.dur_s = 0.0
+        e.finished = True
+        return e
+
+    def child_at(self, name: str, start_perf_s: float, dur_s: float,
+                 **attrs) -> "TraceSpan":
+        """Child with explicit timing — for phases measured elsewhere
+        (queue wait from t_enqueue, a shared device flush)."""
+        c = self.child(name, **attrs)
+        c._t0 = float(start_perf_s)
+        c.start_unix_s = c._t0 + _EPOCH
+        c.dur_s = float(dur_s)
+        c.finished = True
+        return c
+
+    def finish(self) -> None:
+        self.dur_s = time.perf_counter() - self._t0
+        self.finished = True
+
+    def finish_request(self, status: Optional[int] = None, **attrs) -> None:
+        """Finish the ROOT span and complete its trace (tail rules, ring,
+        sink)."""
+        if attrs:
+            self.attrs.update(attrs)
+        self.finish()
+        self._trace.complete(status)
+
+    def discard(self) -> None:
+        """Drop the trace without recording it anywhere (e.g. an idle
+        continuous poll that turned out not to be a cycle)."""
+        self._trace.discarded = True
+
+    def wire(self) -> Dict[str, Any]:
+        """Context to propagate to the next hop (request-body dict)."""
+        t = self._trace
+        return {"id": t.trace_id, "parent": self.span_id,
+                "hop": t.hop + 1, "sampled": t.sampled,
+                # downstream hops persist their half of a trace this
+                # process already decided to keep (e.g. a hedge duplicate
+                # — the mark happens before the duplicate is sent)
+                "keep": bool(t.keep)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"trace_id": self._trace.trace_id, "span_id": self.span_id,
+             "parent_id": self.parent_id, "name": self.name,
+             "start_unix_s": self.start_unix_s, "dur_s": self.dur_s,
+             "thread_id": self.thread_id, "rank": self._trace.rank,
+             "pid": _PID, "attrs": dict(self.attrs)}
+        if not self.finished:
+            # serialized mid-flight (a hedge-abandoned primary attempt
+            # when its root completes): dur_s is CENSORED, not zero —
+            # say so instead of letting analysis read it as instant
+            d["in_flight"] = True
+        return d
+
+
+class _Trace:
+    """Process-local span set of one request/cycle."""
+
+    __slots__ = ("tracer", "trace_id", "hop", "sampled", "rank", "spans",
+                 "keep", "root", "discarded", "_lock", "_completed")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, hop: int,
+                 sampled: bool):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.hop = hop
+        self.sampled = sampled
+        self.rank = tracer.rank
+        self.spans: List[TraceSpan] = []
+        self.keep: set = set()
+        self.root: Optional[TraceSpan] = None
+        self.discarded = False
+        self._completed = False
+        self._lock = threading.Lock()
+
+    def add_span(self, name: str, parent_id: Optional[str],
+                 attrs: Dict[str, Any]) -> TraceSpan:
+        s = TraceSpan(self, name, parent_id, attrs)
+        with self._lock:
+            self.spans.append(s)
+        return s
+
+    def mark(self, reason: str) -> None:
+        with self._lock:
+            self.keep.add(str(reason))
+
+    def complete(self, status: Optional[int]) -> None:
+        with self._lock:
+            if self._completed:
+                return
+            self._completed = True
+        if not self.discarded:
+            self.tracer._complete(self, status)
+
+    def to_dict(self, status: Optional[int], kept: bool,
+                include_spans: bool = True) -> Dict[str, Any]:
+        root = self.root
+        with self._lock:
+            spans = ([s.to_dict() for s in self.spans]
+                     if include_spans else None)
+            keep = sorted(self.keep)
+        out = {"trace_id": self.trace_id, "root": root.name,
+               "model": root.attrs.get("model"),
+               "status": status, "kept": kept, "keep": keep,
+               "sampled": self.sampled, "hop": self.hop,
+               "start_unix_s": root.start_unix_s,
+               "dur_ms": round(root.dur_s * 1e3, 3),
+               "rank": self.rank, "pid": _PID}
+        if include_spans:
+            out["spans"] = spans
+        return out
+
+
+class FlightRecorder:
+    """Bounded ring of recently COMPLETED traces (kept or not): the
+    per-process black box the trace routes and burst dumps read.
+
+    The ring holds live ``_Trace`` objects and serializes LAZILY at read
+    time: pushes happen once per request on the hot path, reads happen
+    when a human (or a burst dump) asks — building the span dicts per
+    request was the dominant measured tracing overhead."""
+
+    def __init__(self, capacity: int = 256):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(int(capacity), 1))
+
+    def push(self, trace: "_Trace", status: Optional[int],
+             kept: bool) -> None:
+        with self._lock:
+            self._ring.append((trace, status, kept))
+
+    def resize(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(int(capacity), 1))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self) -> List[Dict]:
+        """Newest-first full trace dicts."""
+        with self._lock:
+            items = list(reversed(self._ring))
+        return [t.to_dict(status, kept) for t, status, kept in items]
+
+    def recent(self, limit: int = 100) -> List[Dict]:
+        """Newest-first summaries (no spans) for ``/v1/trace/recent``."""
+        with self._lock:
+            items = list(reversed(self._ring))[:max(int(limit), 1)]
+        return [t.to_dict(status, kept, include_spans=False)
+                for t, status, kept in items]
+
+    def get(self, trace_id: str) -> Optional[Dict]:
+        with self._lock:
+            items = list(reversed(self._ring))
+        for t, status, kept in items:
+            if t.trace_id == trace_id:
+                return t.to_dict(status, kept)
+        return None
+
+
+class Tracer:
+    """Per-process tracing policy + sinks.  ``TRACER`` is the module
+    default every component falls back to; tests and benches construct
+    their own."""
+
+    # burst dumps are rate-limited so a flapping breaker cannot turn the
+    # flight recorder into a disk-filling loop
+    _DUMP_MIN_INTERVAL_S = 30.0
+
+    def __init__(self, enabled: bool = False, sample_rate: float = 0.01,
+                 ring: int = 256, trace_dir: str = "",
+                 keep_slo_ms: float = 0.0, rank: int = 0,
+                 sink_path: Optional[str] = None):
+        self._enabled = bool(enabled)
+        self.sample_rate = float(sample_rate)
+        self.trace_dir = str(trace_dir or "")
+        self.keep_slo_ms = float(keep_slo_ms)
+        self.rank = int(rank)
+        self.recorder = FlightRecorder(ring)
+        self._sink_path = sink_path
+        self._sink = None
+        self._sink_lock = threading.Lock()
+        self._rng = random.Random()
+        self._last_dump_s = 0.0
+        self.dumps: List[str] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: Optional[bool] = None,
+                  sample_rate: Optional[float] = None,
+                  ring: Optional[int] = None,
+                  trace_dir: Optional[str] = None,
+                  keep_slo_ms: Optional[float] = None,
+                  rank: Optional[int] = None,
+                  sink_path: Optional[str] = None) -> "Tracer":
+        if enabled is not None:
+            self._enabled = bool(enabled)
+        if sample_rate is not None:
+            self.sample_rate = float(sample_rate)
+        if ring is not None:
+            self.recorder.resize(ring)
+        if keep_slo_ms is not None:
+            self.keep_slo_ms = float(keep_slo_ms)
+        if rank is not None:
+            self.rank = int(rank)
+        if trace_dir is not None and str(trace_dir) != self.trace_dir:
+            self.trace_dir = str(trace_dir)
+            self._close_sink()
+        if sink_path is not None and sink_path != self._sink_path:
+            self._sink_path = sink_path
+            self._close_sink()
+        return self
+
+    # ------------------------------------------------------------------
+    def _sample(self) -> bool:
+        r = self.sample_rate
+        if r <= 0.0:
+            return False
+        if r >= 1.0:
+            return True
+        return self._rng.random() < r
+
+    def start_request(self, name: str, ctx: Optional[Dict] = None,
+                      **attrs) -> Optional[TraceSpan]:
+        """Root span of this process's part of a request.  ``ctx`` is the
+        upstream wire context (request body ``trace`` dict) — adopted
+        when present, minted otherwise.  Returns None when disabled (the
+        whole fast-path cost)."""
+        if not self._enabled:
+            return None
+        parent = None
+        if isinstance(ctx, dict) and ctx.get("id"):
+            trace_id = str(ctx["id"])
+            parent = ctx.get("parent")
+            try:
+                hop = int(ctx.get("hop", 1))
+            except (TypeError, ValueError):
+                hop = 1
+            sampled = bool(ctx.get("sampled"))
+            tr = _Trace(self, trace_id, hop, sampled)
+            if ctx.get("keep"):
+                # the upstream hop already decided this trace matters
+                # (e.g. it is a hedge duplicate): persist our half too
+                tr.keep.add("upstream")
+        else:
+            tr = _Trace(self, _new_trace_id(), 0, self._sample())
+        root = tr.add_span(name, parent, attrs)
+        tr.root = root
+        return root
+
+    def start_cycle(self, name: str, **attrs) -> Optional[TraceSpan]:
+        """Root span of a continuous-training cycle: cycles are rare and
+        each one matters, so they bypass sampling (always kept)."""
+        if not self._enabled:
+            return None
+        tr = _Trace(self, _new_trace_id(), 0, True)
+        tr.keep.add("cycle")
+        root = tr.add_span(name, None, attrs)
+        tr.root = root
+        return root
+
+    # -- completion ----------------------------------------------------
+    def _complete(self, trace: _Trace, status: Optional[int]) -> None:
+        root = trace.root
+        dur_ms = root.dur_s * 1e3
+        slo_ms = root.attrs.get("slo_ms") or self.keep_slo_ms
+        if slo_ms and dur_ms > float(slo_ms):
+            trace.mark("slo_breach")
+        if status in (503, 504):
+            trace.mark(f"status_{status}")
+        elif status is not None and status >= 500:
+            trace.mark("error_5xx")
+        kept = trace.sampled or bool(trace.keep)
+        self.recorder.push(trace, status, kept)
+        if kept:
+            # only kept traces pay serialization on the request path
+            # (head sample + tail rules — a small fraction by design)
+            self._write_sink(trace.to_dict(status, kept))
+
+    # -- per-rank JSONL sink --------------------------------------------
+    def sink_path(self) -> Optional[str]:
+        if self._sink_path:
+            return self._sink_path
+        if not self.trace_dir:
+            return None
+        return os.path.join(self.trace_dir,
+                            f"trace_spans_rank{self.rank}-{_PID}.jsonl")
+
+    def _write_sink(self, trace_dict: Dict) -> None:
+        path = self.sink_path()
+        if path is None:
+            return
+        lines = []
+        for s in trace_dict["spans"]:
+            rec = {"kind": "trace_span"}
+            rec.update(s)
+            lines.append(json.dumps(rec, default=str))
+        with self._sink_lock:
+            if self._sink is None:
+                d = os.path.dirname(os.path.abspath(path))
+                os.makedirs(d, exist_ok=True)
+                self._sink = open(path, "a")
+            self._sink.write("\n".join(lines) + "\n")
+            self._sink.flush()
+
+    def _close_sink(self) -> None:
+        with self._sink_lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except Exception:
+                    pass
+                self._sink = None
+
+    def close(self) -> None:
+        self._close_sink()
+
+    # -- flight-recorder dumps ------------------------------------------
+    def dump(self, reason: str = "manual",
+             path: Optional[str] = None) -> Optional[str]:
+        """Write the whole ring to disk (kept AND unkept traces — the
+        black box's value is exactly the requests nothing chose to
+        keep).  Returns the path, or None without a destination."""
+        if path is None:
+            if not self.trace_dir:
+                return None
+            path = os.path.join(
+                self.trace_dir,
+                f"flight_{reason}_{int(time.time() * 1e3)}.json")
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        payload = {"reason": reason, "unix_s": time.time(),
+                   "rank": self.rank, "pid": _PID,
+                   "traces": self.recorder.snapshot()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, default=str)
+        os.replace(tmp, path)
+        self.dumps.append(path)
+        _log.log_info(f"trace: flight recorder dumped to {path} "
+                      f"({len(payload['traces'])} traces, reason="
+                      f"{reason})")
+        return path
+
+    def maybe_dump(self, reason: str) -> Optional[str]:
+        """Rate-limited burst dump — the router calls this on breaker
+        open / shed / partial publish.  Cheap no-op when disabled or
+        without a trace_dir; the dump itself runs on a background thread
+        so the request that tripped the burst never waits on ring
+        serialization.  Returns the path the dump will land at."""
+        if not self._enabled or not self.trace_dir:
+            return None
+        now = time.monotonic()
+        with self._sink_lock:
+            if now - self._last_dump_s < self._DUMP_MIN_INTERVAL_S:
+                return None
+            self._last_dump_s = now
+        path = os.path.join(
+            self.trace_dir,
+            f"flight_{reason}_{int(time.time() * 1e3)}.json")
+        threading.Thread(target=self.dump, args=(reason, path),
+                         daemon=True, name="lgbm-tpu-trace-dump").start()
+        return path
+
+
+# process-wide default: disabled until configure()d (CLI wires it from the
+# trace_* config params; tests construct their own instances)
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# thread-local activation: log correlation + nested child spans without
+# threading a span object through every signature
+# ---------------------------------------------------------------------------
+_tls = threading.local()
+
+
+def current() -> Optional[TraceSpan]:
+    return getattr(_tls, "span", None)
+
+
+def current_trace_id() -> Optional[str]:
+    s = getattr(_tls, "span", None)
+    return s.trace_id if s is not None else None
+
+
+class _Activation:
+    """Class-based context manager (cheaper than a generator on the
+    per-request hot path): makes a span the thread's active span."""
+
+    __slots__ = ("_span", "_prev")
+
+    def __init__(self, span):
+        self._span = span
+
+    def __enter__(self):
+        if self._span is not None:
+            self._prev = getattr(_tls, "span", None)
+            _tls.span = self._span
+        return self._span
+
+    def __exit__(self, *exc):
+        if self._span is not None:
+            _tls.span = self._prev
+
+
+def activate(span: Optional[TraceSpan]) -> _Activation:
+    """Make ``span`` the thread's active span (None-safe no-op)."""
+    return _Activation(span)
+
+
+@contextmanager
+def child_span(name: str, **attrs):
+    """Timed child of the thread's ACTIVE span; no-op (yields None) when
+    no trace is active — deep layers (trainer, gate) use this so they
+    need no tracer plumbing at all."""
+    parent = getattr(_tls, "span", None)
+    if parent is None:
+        yield None
+        return
+    c = parent.child(name, **attrs)
+    _tls.span = c
+    try:
+        yield c
+    finally:
+        _tls.span = parent
+        c.finish()
+
+
+# ---------------------------------------------------------------------------
+# wiring: CLI config + log/span correlation providers
+# ---------------------------------------------------------------------------
+def configure_from_config(config) -> Tracer:
+    """Wire the process-default tracer (and the log JSON mode) from the
+    ``trace_*`` config params — Application.run calls this once."""
+    try:
+        rank = int(os.environ.get("LIGHTGBM_TPU_RANK", "0") or 0)
+    except ValueError:
+        rank = 0
+    TRACER.configure(enabled=bool(config.trace_requests),
+                     sample_rate=config.trace_sample_rate,
+                     ring=config.trace_ring,
+                     trace_dir=config.trace_dir,
+                     keep_slo_ms=config.trace_keep_slo_ms,
+                     rank=rank)
+    if config.trace_log_json:
+        # enable-only: the default (False) must not clobber an
+        # operator's LIGHTGBM_TPU_LOG_JSON=1 env default on every run
+        _log.set_json_lines(True)
+    return TRACER
+
+
+# warnings/errors emitted while a trace is active carry its trace_id
+# (log.py), and telemetry spans recorded inside a traced region are
+# stamped with it (spans.py) — one id correlates logs, phase spans, and
+# the distributed trace
+_log.set_trace_provider(current_trace_id)
+_spans.set_trace_id_provider(current_trace_id)

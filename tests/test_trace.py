@@ -1,0 +1,599 @@
+"""Distributed request tracing + flight recorder (telemetry/trace.py).
+
+Covers the ISSUE-14 bars: the disabled fast path is a literal no-op,
+head-sampling + tail keep rules (SLO breach / hedged / 503 / 504 kept,
+happy path sampled out at rate 0), golden-file cross-process assembly into
+a valid Chrome trace, the /v1/trace/* routes with the router's cross-
+process fan-out for a HEDGED request (router pick -> hedge -> both replica
+attempts with queue-wait + device spans -> winning hop), per-model SLO
+gauges separating two models at different latencies on BOTH the replica
+and the router, and log/trace correlation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import log as lgb_log
+from lightgbm_tpu.fleet import FleetRouter
+from lightgbm_tpu.fleet.slo import SLOPolicy
+from lightgbm_tpu.serving import ServingApp
+from lightgbm_tpu.serving.metrics import ServingMetrics
+from lightgbm_tpu.telemetry import trace as tr
+from lightgbm_tpu.telemetry.export import (assemble_traces,
+                                           prometheus_text,
+                                           read_trace_spans,
+                                           trace_chrome_trace,
+                                           write_trace_chrome_trace)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "trace_assembly.json")
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path: the whole hot-path cost of trace_requests=false
+# ---------------------------------------------------------------------------
+def test_disabled_tracer_is_noop():
+    t = tr.Tracer(enabled=False)
+    assert t.start_request("router.predict", model="m") is None
+    assert t.start_cycle("cycle") is None
+    # tracers construct disabled: components built without an explicit
+    # tracer trace nothing until configure_from_config flips the module
+    # default (which an earlier in-process CLI run may have done — so
+    # assert the constructor default, not TRACER's current state)
+    assert tr.Tracer().enabled is False
+    # the None-safe helpers are no-ops a call site can use unguarded
+    with tr.activate(None) as a:
+        assert a is None
+        assert tr.current_trace_id() is None
+        with tr.child_span("x") as c:
+            assert c is None
+    assert len(t.recorder) == 0
+    assert t.maybe_dump("anything") is None
+
+
+def test_disabled_tracer_serving_hot_path(tmp_path, binary_app):
+    """A ServingApp over a disabled tracer answers predicts without ever
+    touching the recorder — the guard for 'tracing fully off is a no-op
+    on the hot path'."""
+    app, X = binary_app
+    assert app.tracer.enabled is False
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X[:4].tolist()})
+    assert status == 200 and "trace_id" not in body
+    assert len(app.tracer.recorder) == 0
+
+
+# ---------------------------------------------------------------------------
+# tail-sampling keep-rule matrix
+# ---------------------------------------------------------------------------
+def _finished(t, name="router.predict", status=200, marks=(), ctx=None,
+              **attrs):
+    root = t.start_request(name, ctx=ctx, **attrs)
+    for m in marks:
+        root.mark(m)
+    root.finish_request(status=status)
+    return t.recorder.get(root.trace_id)
+
+
+def test_tail_sampling_matrix():
+    t = tr.Tracer(enabled=True, sample_rate=0.0, ring=32)
+    # happy path at rate 0: recorded in the ring, NOT kept
+    rec = _finished(t)
+    assert rec is not None and rec["kept"] is False and rec["keep"] == []
+    # hedged kept
+    rec = _finished(t, marks=("hedged",))
+    assert rec["kept"] is True and "hedged" in rec["keep"]
+    # rerouted kept
+    assert _finished(t, marks=("rerouted",))["kept"] is True
+    # 503 / 504 deaths kept
+    assert "status_503" in _finished(t, status=503)["keep"]
+    assert "status_504" in _finished(t, status=504)["keep"]
+    assert "error_5xx" in _finished(t, status=500)["keep"]
+    # SLO breach kept: per-trace slo_ms attr (the router stamps its
+    # policy target) or the tracer-wide knob
+    rec = _finished(t, slo_ms=1e-7)
+    assert "slo_breach" in rec["keep"]
+    t.keep_slo_ms = 1e-7
+    assert "slo_breach" in _finished(t)["keep"]
+    t.keep_slo_ms = 1e9
+    assert _finished(t)["kept"] is False
+    # head sampling at rate 1.0 keeps the happy path
+    t.sample_rate = 1.0
+    rec = _finished(t)
+    assert rec["kept"] is True and rec["sampled"] is True
+
+
+def test_wire_context_adoption_and_keep_hint():
+    t = tr.Tracer(enabled=True, sample_rate=0.0)
+    root = t.start_request("router.predict", model="m")
+    attempt = root.child("router.attempt", replica="b")
+    w0 = attempt.wire()
+    assert w0 == {"id": root.trace_id, "parent": attempt.span_id,
+                  "hop": 1, "sampled": False, "keep": False}
+    root.mark("hedged")
+    w1 = attempt.wire()
+    assert w1["keep"] is True
+    # a downstream tracer adopts id/parent and honors the keep hint
+    t2 = tr.Tracer(enabled=True, sample_rate=0.0, rank=1)
+    rec = _finished(t2, name="replica.predict", ctx=w1)
+    assert rec["trace_id"] == root.trace_id
+    assert rec["hop"] == 1 and "upstream" in rec["keep"]
+    spans = rec["spans"]
+    assert spans[0]["parent_id"] == attempt.span_id
+    assert spans[0]["rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring, routes' source, burst dumps
+# ---------------------------------------------------------------------------
+def test_flight_recorder_ring_and_dump(tmp_path):
+    t = tr.Tracer(enabled=True, sample_rate=0.0, ring=4,
+                  trace_dir=str(tmp_path))
+    ids = [_finished(t, i=i)["trace_id"] for i in range(6)]
+    assert len(t.recorder) == 4                       # bounded
+    assert t.recorder.get(ids[0]) is None             # oldest evicted
+    assert t.recorder.recent()[0]["trace_id"] == ids[-1]   # newest first
+    assert "spans" not in t.recorder.recent()[0]
+    path = t.dump(reason="test")
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert payload["reason"] == "test" and len(payload["traces"]) == 4
+    # burst dumps are rate-limited; manual dump() is not
+    assert t.maybe_dump("breaker_open") is not None
+    assert t.maybe_dump("breaker_open") is None
+
+
+def test_sink_writes_kept_traces_only(tmp_path):
+    sink = str(tmp_path / "trace_spans_rank0-1.jsonl")
+    t = tr.Tracer(enabled=True, sample_rate=0.0, sink_path=sink)
+    kept = _finished(t, marks=("hedged",))
+    _finished(t)          # not kept: must not reach the sink
+    t.close()
+    spans = read_trace_spans(str(tmp_path))
+    assert spans and {s["trace_id"] for s in spans} == {kept["trace_id"]}
+    traces = assemble_traces(str(tmp_path))
+    assert list(traces) == [kept["trace_id"]]
+
+
+# ---------------------------------------------------------------------------
+# golden-file cross-process assembly -> Chrome trace
+# ---------------------------------------------------------------------------
+def _golden_spans():
+    """Two in-process 'ranks' worth of deterministic spans for one
+    request: the router hop (rank 0) and the replica hop (rank 1), with
+    the replica's root parented under the router's attempt span."""
+    def s(rank, sid, parent, name, start, dur, **attrs):
+        return {"kind": "trace_span", "trace_id": "t0ld3n", "rank": rank,
+                "pid": 4000 + rank, "thread_id": 7, "span_id": sid,
+                "parent_id": parent, "name": name, "start_unix_s": start,
+                "dur_s": dur, "attrs": attrs}
+    rank0 = [
+        s(0, "r0.1", None, "router.predict", 100.000, 0.050, model="m"),
+        s(0, "r0.2", "r0.1", "router.pick", 100.001, 0.0, replica="b"),
+        s(0, "r0.3", "r0.1", "router.attempt", 100.002, 0.046,
+          replica="b", status=200),
+    ]
+    rank1 = [
+        s(1, "r1.1", "r0.3", "replica.predict", 100.004, 0.040,
+          model="m", version=1),
+        s(1, "r1.2", "r1.1", "serving.queue_wait", 100.004, 0.005),
+        s(1, "r1.3", "r1.1", "serving.device_flush", 100.010, 0.020,
+          batch_rows=8, batch_requests=2),
+    ]
+    return rank0, rank1
+
+
+def test_golden_cross_process_assembly(tmp_path):
+    rank0, rank1 = _golden_spans()
+    for rank, spans in ((0, rank0), (1, rank1)):
+        with open(tmp_path / f"trace_spans_rank{rank}-x.jsonl", "w") as fh:
+            for sp in spans:
+                fh.write(json.dumps(sp) + "\n")
+    traces = assemble_traces(str(tmp_path))
+    assert list(traces) == ["t0ld3n"]
+    spans = traces["t0ld3n"]
+    assert len(spans) == 6
+    # correct parent/child nesting: every parent exists, and a child's
+    # interval sits inside its parent's
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["parent_id"] is None:
+            continue
+        parent = by_id[s["parent_id"]]
+        assert s["start_unix_s"] >= parent["start_unix_s"]
+        assert (s["start_unix_s"] + s["dur_s"]
+                <= parent["start_unix_s"] + parent["dur_s"] + 1e-9)
+    # monotonic timestamps in assembly order
+    starts = [s["start_unix_s"] for s in spans]
+    assert starts == sorted(starts)
+    out = write_trace_chrome_trace(str(tmp_path / "trace.json"), spans)
+    with open(out) as fh:
+        produced = json.load(fh)
+    # valid Chrome trace: the viewer's minimal contract
+    events = produced["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 6 and all(e["dur"] >= 0 for e in xs)
+    assert {e["pid"] for e in xs} == {0, 1}        # one row per rank
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    with open(GOLDEN) as fh:
+        golden = json.load(fh)
+    assert produced == golden
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: hedged request assembled across router + two replica apps
+# ---------------------------------------------------------------------------
+class AppEndpoint:
+    """Transport-free 'HTTP replica' over a real ServingApp — the same
+    handle() contract HttpReplica speaks, so the router drives the full
+    replica path (registry, micro-batcher, tracing) without sockets."""
+
+    def __init__(self, name, app):
+        self.name = name
+        self.app = app
+
+    def request(self, method, path, body=None, timeout_s=None):
+        return self.app.handle(method, path, body)
+
+    def health(self, timeout_s=2.0):
+        status, payload = self.app.handle("GET", "/v1/fleet/health")
+        return payload.get("gauges") if status == 200 else None
+
+
+@pytest.fixture(scope="module")
+def tiny_model_str():
+    rs = np.random.RandomState(7)
+    X = rs.randn(400, 4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, y), num_boost_round=3)
+    return bst.model_to_string(), X
+
+
+@pytest.fixture()
+def binary_app(tiny_model_str):
+    model_str, X = tiny_model_str
+    app = ServingApp(tracer=tr.Tracer(enabled=False))
+    app.registry.publish("m", model_str=model_str)
+    try:
+        yield app, X
+    finally:
+        app.close()
+
+
+def test_hedged_request_assembles_across_processes(tmp_path,
+                                                   tiny_model_str):
+    """The acceptance bar, in-process: a hedged request's assembled trace
+    shows the router pick, the hedge fire, BOTH replica attempts (each
+    with queue-wait + device spans), and the winning hop — assembled two
+    ways: the router's /v1/trace/<id> fan-out over the flight-recorder
+    rings, and the JSONL-sink collector."""
+    model_str, X = tiny_model_str
+    rt_tr = tr.Tracer(enabled=True, sample_rate=1.0, ring=64,
+                      trace_dir=str(tmp_path / "router"), rank=0)
+    apps, eps = [], []
+    for i, nm in enumerate(("a", "b")):
+        t = tr.Tracer(enabled=True, sample_rate=0.0, ring=64,
+                      trace_dir=str(tmp_path / f"replica{i}"), rank=i + 1)
+        app = ServingApp(tracer=t)
+        app.registry.publish("m", model_str=model_str)
+        apps.append(app)
+        eps.append(AppEndpoint(nm, app))
+    release, entered = threading.Event(), threading.Event()
+    inner_request = eps[0].request
+
+    def stalling_request(method, path, body=None, timeout_s=None):
+        if path.endswith(":predict"):
+            entered.set()
+            assert release.wait(10.0)
+        return inner_request(method, path, body, timeout_s)
+
+    eps[0].request = stalling_request
+    # b reports one queued row so least-loaded ranking deterministically
+    # picks `a` first (the stalling primary) — same setup as the
+    # gray-failure hedge test
+    inner_health = eps[1].health
+
+    def loaded_health(timeout_s=2.0):
+        g = dict(inner_health(timeout_s) or {})
+        g["queue_rows"] = 1
+        return g
+
+    eps[1].health = loaded_health
+    router = FleetRouter(eps, policy=SLOPolicy(), poll_interval_ms=0,
+                         autostart=False, hedge_min_ms=1.0, tracer=rt_tr)
+    try:
+        router.poll_once()
+        # warm both apps' predict paths (compiles) outside the traced
+        # request, like the fleet's bundle-warm cold start
+        apps[1].handle("POST", "/v1/models/m:predict",
+                       {"rows": X[:2].tolist()})
+        release.set()
+        apps[0].handle("POST", "/v1/models/m:predict",
+                       {"rows": X[:2].tolist()})
+        release.clear()
+        entered.clear()
+        # fast history on `a` => ~1ms hedge delay; its next predict
+        # stalls, so the router hedges to `b` which answers first
+        for _ in range(8):
+            router._replicas[0].digest.observe(0.001)
+        status, body = router.handle("POST", "/v1/models/m:predict",
+                                     {"rows": X[:2].tolist()})
+        assert status == 200 and body.get("hedged") is True
+        tid = body["trace_id"]
+        release.set()
+        # the abandoned primary finishes on its own; wait for its spans
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if apps[0].tracer.recorder.get(tid) is not None:
+                break
+            time.sleep(0.02)
+        rec = rt_tr.recorder.get(tid)
+        assert rec["kept"] and {"hedged", "hedge_win"} <= set(rec["keep"])
+        # --- assembly 1: router fan-out over the flight recorders -----
+        status, merged = router.handle("GET", f"/v1/trace/{tid}")
+        assert status == 200 and merged["processes"] == 3
+        names = [s["name"] for s in merged["spans"]]
+        assert "router.pick" in names
+        assert "router.hedge" in names
+        assert "router.hedge_win" in names
+        assert names.count("router.attempt") == 2
+        assert names.count("replica.predict") == 2     # BOTH attempts
+        assert "serving.queue_wait" in names
+        assert "serving.device_flush" in names
+        # the winning hop is attributed: root span names the replica
+        # that served, and it matches the hedge target
+        root = next(s for s in merged["spans"]
+                    if s["name"] == "router.predict")
+        assert root["attrs"]["replica"] == "b"
+        # nesting: each replica root parents under a distinct attempt
+        attempts = {s["span_id"] for s in merged["spans"]
+                    if s["name"] == "router.attempt"}
+        rep_parents = {s["parent_id"] for s in merged["spans"]
+                       if s["name"] == "replica.predict"}
+        assert rep_parents <= attempts and len(rep_parents) == 2
+        # --- assembly 2: the JSONL-sink collector ----------------------
+        for t in [rt_tr] + [a.tracer for a in apps]:
+            t.close()
+        traces = assemble_traces(str(tmp_path))
+        assert tid in traces
+        disk_names = [s["name"] for s in traces[tid]]
+        assert "router.hedge" in disk_names
+        assert "replica.predict" in disk_names
+        starts = [s["start_unix_s"] for s in traces[tid]]
+        assert starts == sorted(starts)
+        # /v1/trace/recent lists it on the router
+        status, recent = router.handle("GET", "/v1/trace/recent")
+        assert status == 200
+        assert any(t["trace_id"] == tid for t in recent["traces"])
+    finally:
+        release.set()
+        router.close()
+        for app in apps:
+            app.close()
+
+
+def test_replica_trace_routes(binary_app):
+    app, X = binary_app
+    app.tracer = tr.Tracer(enabled=True, sample_rate=1.0, ring=16)
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X[:3].tolist()})
+    assert status == 200
+    tid = body["trace_id"]
+    status, detail = app.handle("GET", f"/v1/trace/{tid}")
+    assert status == 200
+    names = [s["name"] for s in detail["spans"]]
+    assert names[0] == "replica.predict"
+    assert "serving.queue_wait" in names
+    assert "serving.device_flush" in names
+    root = detail["spans"][0]
+    assert root["attrs"]["version"] == 1       # model-version link
+    status, _ = app.handle("GET", "/v1/trace/nope")
+    assert status == 404
+
+
+def test_replica_404_and_504_traces_are_kept(binary_app):
+    app, X = binary_app
+    app.tracer = tr.Tracer(enabled=True, sample_rate=0.0, ring=16)
+    status, _ = app.handle("POST", "/v1/models/m:predict",
+                           {"rows": X[:2].tolist(),
+                            "deadline_ms": 0.0})
+    assert status == 504
+    rec = app.tracer.recorder.recent()[0]
+    assert rec["status"] == 504 and "status_504" in rec["keep"]
+    # happy path at rate 0 recorded but not kept
+    status, body = app.handle("POST", "/v1/models/m:predict",
+                              {"rows": X[:2].tolist()})
+    assert status == 200
+    assert app.tracer.recorder.get(body["trace_id"])["kept"] is False
+
+
+# ---------------------------------------------------------------------------
+# per-model SLO gauges: replica and router separate two models
+# ---------------------------------------------------------------------------
+def test_replica_per_model_slo_gauges_separate():
+    sm = ServingMetrics()
+    fast, slow = sm.model("fast"), sm.model("slow")
+    for _ in range(20):
+        fast.record_request(4, latency_s=0.002)
+        slow.record_request(4, latency_s=0.080)
+    slow.record_request(4, error=True)
+    for _ in range(5):
+        slow.record_deadline_refusal()
+    sm.refresh_slo_gauges()
+    text = prometheus_text(sm.registry)
+    assert 'lgbm_serving_model_p99_ms{model="fast"}' in text
+    snap = sm.registry.snapshot()
+    p99 = snap["lgbm_serving_model_p99_ms"]
+    assert p99["model=slow"] > 10 * p99["model=fast"]
+    miss = snap["lgbm_serving_model_deadline_miss_ratio"]
+    assert miss["model=slow"] > 0.1 and miss["model=fast"] == 0.0
+    good = snap["lgbm_serving_model_goodput_rows_per_s"]
+    assert good["model=fast"] > 0.0
+
+
+def test_router_per_model_labels_and_slo_gauges_separate():
+    from test_fleet_gray import FakeReplica, _router
+
+    class TwoSpeed(FakeReplica):
+        def request(self, method, path, body=None, timeout_s=None):
+            if ":predict" in path and "/mslow:" in path:
+                time.sleep(0.03)
+            return super().request(method, path, body, timeout_s)
+
+    r = _router([TwoSpeed("a")])
+    try:
+        r.poll_once()
+        for _ in range(6):
+            s, _ = r.handle("POST", "/v1/models/mfast:predict",
+                            {"rows": [[0.0]]})
+            assert s == 200
+            s, _ = r.handle("POST", "/v1/models/mslow:predict",
+                            {"rows": [[0.0]]})
+            assert s == 200
+        # a spent-deadline request ends 504 and counts as a miss for
+        # mslow only
+        s, _ = r.handle("POST", "/v1/models/mslow:predict",
+                        {"rows": [[0.0]], "deadline_ms": -1.0})
+        assert s == 504
+        status, out = r.handle("GET", "/v1/metrics")
+        snap = out["router"]
+        # model label on the fleet counters, unlabeled total kept
+        req = snap["lgbm_fleet_requests_total"]
+        assert req["_"] == 13
+        assert req["model=mfast"] == 6 and req["model=mslow"] == 7
+        p99 = snap["lgbm_fleet_model_p99_ms"]
+        assert p99["model=mslow"] > 2 * p99["model=mfast"] > 0
+        miss = snap["lgbm_fleet_model_deadline_miss_ratio"]
+        assert miss["model=mslow"] > 0 and miss["model=mfast"] == 0.0
+        assert snap["lgbm_fleet_model_goodput_rows_per_s"][
+            "model=mfast"] > 0
+        # the Prometheus route renders both labeled rows
+        status, text = r.handle("GET", "/v1/metrics/prometheus")
+        assert 'lgbm_fleet_model_p99_ms{model="mslow"}' in text
+        assert 'lgbm_fleet_requests_total{model="mfast"}' in text
+        assert "\nlgbm_fleet_requests_total 13" in "\n" + text
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# log correlation + telemetry-span stamping
+# ---------------------------------------------------------------------------
+def test_log_warning_carries_trace_id():
+    t = tr.Tracer(enabled=True, sample_rate=0.0)
+    lines = []
+    lgb_log.register_log_callback(lines.append)
+    lgb_log.set_verbosity(1)     # a prior verbosity=-1 fit mutes warnings
+    try:
+        root = t.start_request("router.predict")
+        with tr.activate(root):
+            lgb_log.log_warning("plain-mode warning")
+            lgb_log.set_json_lines(True)
+            lgb_log.log_warning("json-mode warning")
+            lgb_log.set_json_lines(False)
+        root.finish_request(status=200)
+        lgb_log.log_warning("outside any trace")
+    finally:
+        lgb_log.register_log_callback(None)
+        lgb_log.set_json_lines(False)
+    assert f"[trace_id={root.trace_id}]" in lines[0]
+    rec = json.loads(lines[1])
+    assert rec["level"] == "warning"
+    assert rec["trace_id"] == root.trace_id
+    assert "trace_id" not in lines[2]
+
+
+def test_telemetry_spans_stamped_with_trace_id():
+    from lightgbm_tpu.telemetry import spans
+    t = tr.Tracer(enabled=True, sample_rate=0.0)
+    spans.set_enabled(True)
+    spans.set_recording(True)
+    try:
+        root = t.start_request("router.predict")
+        with tr.activate(root):
+            with spans.span("serving::batch"):
+                pass
+        rec = [s for s in spans.recorded_spans()
+               if s.name == "serving::batch"][-1]
+        assert rec.attrs["trace_id"] == root.trace_id
+    finally:
+        spans.set_recording(False)
+        spans.set_enabled(False)
+        spans.clear_recorded()
+
+
+# ---------------------------------------------------------------------------
+# cycle-scoped trace: poll -> train -> gate -> publish carries the version
+# ---------------------------------------------------------------------------
+def test_cycle_trace_links_publish_version():
+    from lightgbm_tpu.continuous.gate import PublishGate
+    from lightgbm_tpu.continuous.service import ContinuousService
+
+    class _Batch:
+        def __init__(self, n):
+            self.X = np.zeros((n, 2))
+            self.y = np.arange(n, dtype=np.float64) % 2
+            self.name = "seg"
+
+    class StubTail:
+        def __init__(self):
+            self.fed = [ [_Batch(8)], [] ]
+
+        def poll(self):
+            return self.fed.pop(0) if self.fed else []
+
+    class StubTrainer:
+        cycle = 0
+        resume_events = ()
+
+        def ingest(self, X, y):
+            return X[:2], y[:2]
+
+        @property
+        def num_train_rows(self):
+            return 8
+
+        def train_cycle(self, callbacks=None):
+            return {"cycle": 0, "candidate_str": "model",
+                    "auc": 0.9, "resumed_from": 0}
+
+        def commit(self, s):
+            pass
+
+        def discard(self):
+            pass
+
+        def revert(self):
+            pass
+
+    published = []
+    gate = PublishGate(None, "m", min_auc=0.5,
+                       publish_fn=lambda s, b: published.append(s) or 7)
+    gate.min_fresh_rows = 10 ** 9      # keep watch() out of this test
+    t = tr.Tracer(enabled=True, sample_rate=0.0, ring=8)
+    svc = ContinuousService(StubTail(), StubTrainer(), gate,
+                            poll_s=0.0, tracer=t)
+    summary = svc.step()
+    assert summary["decision"]["action"] == "publish"
+    rec = t.recorder.get(summary["trace_id"])
+    assert rec is not None and "cycle" in rec["keep"]   # cycles always kept
+    names = [s["name"] for s in rec["spans"]]
+    for want in ("cycle", "cycle.poll", "cycle.train", "cycle.gate",
+                 "cycle.publish"):
+        assert want in names, names
+    pub = next(s for s in rec["spans"] if s["name"] == "cycle.publish")
+    assert pub["attrs"]["version"] == 7      # the minted version, linkable
+    assert rec["spans"][0]["attrs"]["version"] == 7
+    # an idle poll is not a cycle: nothing new lands in the ring
+    before = len(t.recorder)
+    svc.step()
+    assert len(t.recorder) == before
