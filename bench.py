@@ -148,6 +148,23 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  this backend has no cross-process device collectives);
                  the claims are coordination claims.  Knobs:
                  BENCH_SHARD_{ROUNDS,SEG_ROWS,TIMEOUT}.
+- continuous_gray  training-fleet GRAY-failure soak
+                 (run_continuous_gray): one rank STALLS mid-cycle
+                 (LGBM_TPU_FAULT_RANK_STALL — alive, renewing nothing)
+                 plus a torn exchange write and a slow barrier.  Phase 1
+                 runs the UN-hardened fleet (fleet_train_* knobs zeroed
+                 = the pre-hardening wait-forever contract): it must
+                 exceed the cycle-time bound — it hangs until the
+                 supervisor's attempt deadline reaps it.  Phase 2 runs
+                 the hardened fleet (bounded barriers, rank leases,
+                 quorum cycle commit, poison-cycle guard): bars are >= 3
+                 gated publish cycles with max inter-commit gap inside
+                 BENCH_GRAY_CYCLE_BOUND_S, ZERO torn commit state, the
+                 stalled rank's prepared segments requeued and replayed
+                 byte-equal into a later committed cycle after its
+                 targeted kill-and-relaunch + quorum re-admission, and
+                 every injected fault's fired counter nonzero.  Knobs:
+                 BENCH_GRAY_{ROUNDS,SEG_ROWS,CYCLE_BOUND_S,UNHARDENED_S}.
 """
 
 import json
@@ -1978,6 +1995,303 @@ def run_continuous_sharded():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_continuous_gray():
+    """Child body for BENCH_STAGE=continuous_gray: the training-fleet
+    GRAY-failure soak.  One rank stalls mid-cycle (alive, renewing
+    nothing).  The un-hardened fleet (timeout knobs zeroed — the
+    pre-hardening contract) exceeds the cycle-time bound: it hangs until
+    the supervisor's attempt deadline reaps it.  The hardened fleet
+    (bounded barriers + rank leases + quorum commit) completes >= 3
+    gated publish cycles inside the bound with zero torn commits,
+    replays the stalled rank's segments byte-equal after recovery, and
+    every injected fault's fired counter is nonzero."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    t_start = time.time()
+    import hashlib
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    from lightgbm_tpu.cluster import continuous_distributed
+    from lightgbm_tpu.continuous import shard_of
+
+    rounds = int(os.environ.get("BENCH_GRAY_ROUNDS", 4))
+    seg_rows = int(os.environ.get("BENCH_GRAY_SEG_ROWS", 600))
+    cycle_bound_s = float(os.environ.get("BENCH_GRAY_CYCLE_BOUND_S", 90))
+    unhardened_timeout = int(os.environ.get("BENCH_GRAY_UNHARDENED_S",
+                                            50))
+    nf = 8
+
+    def seg_name(i, want_rank):
+        j = 0
+        while True:
+            name = f"seg{i:03d}_{j}.csv"
+            if shard_of(name, 2) == want_rank:
+                return name
+            j += 1
+
+    def write_segment(src, name, seed, rows=None):
+        rows = int(rows or seg_rows)
+        r = np.random.RandomState(seed)
+        X = r.randn(rows, nf)
+        y = (r.rand(rows) < 1 / (1 + np.exp(
+            -(2 * X[:, 0] + X[:, 1])))).astype(float)
+        lines = [",".join([f"{y[i]:.0f}"]
+                          + [f"{v:.6f}" for v in X[i]])
+                 for i in range(rows)]
+        tpath = os.path.join(src, f"_{name}.part")
+        with open(tpath, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tpath, os.path.join(src, name))
+
+    base_params = {"objective": "binary", "num_leaves": 15,
+                   "learning_rate": 0.2, "verbosity": -1,
+                   "max_bin": MAX_BIN, "min_data_in_leaf": 20, "seed": 7,
+                   "continuous_rounds": rounds,
+                   "continuous_poll_s": 0.3,
+                   "continuous_min_auc": 0.55}
+    stall_seg = seg_name(3, 1)
+
+    def run_fleet(root, hardened, timeout, max_restarts, fault_env,
+                  stage_segments=True, idle_polls=150):
+        src = os.path.join(root, "src")
+        work = os.path.join(root, "work")
+        os.makedirs(src)
+        os.makedirs(work)
+        write_segment(src, seg_name(0, 0), seed=10)
+        write_segment(src, seg_name(1, 1), seed=11)
+        commit_times = []
+        stop_writer = threading.Event()
+
+        def watcher():
+            # release cycle-1 segments only after cycle 0 commits (the
+            # stall must land on a cycle with real prepared segments),
+            # and record every commit-record advance for the
+            # cycle-time-bound bar
+            state_path = os.path.join(work, "fleet",
+                                      "commit_state.json")
+            released = False
+            last = -1
+            deadline = time.time() + 600
+            while not stop_writer.is_set() and time.time() < deadline:
+                try:
+                    cyc = json.load(open(state_path))["cycle"]
+                except (OSError, ValueError, KeyError):
+                    cyc = -1
+                if cyc > last:
+                    commit_times.append((cyc, time.time()))
+                    last = cyc
+                if cyc >= 0 and stage_segments and not released:
+                    # the stall target lands FIRST: if rank 0's segment
+                    # landed alone, the fleet could commit cycle 1
+                    # without rank 1's shard and the cycle-keyed stall
+                    # would never fire
+                    write_segment(src, stall_seg, seed=13)
+                    write_segment(src, seg_name(2, 0), seed=12)
+                    released = True
+                time.sleep(0.3)
+
+        wt = threading.Thread(target=watcher, daemon=True)
+        wt.start()
+        params = dict(base_params)
+        params.update({"continuous_source": src, "continuous_dir": work,
+                       "continuous_max_idle_polls": idle_polls,
+                       "max_restarts": max_restarts})
+        if hardened:
+            params.update({"fleet_train_barrier_timeout_s": 8.0,
+                           "fleet_train_rank_timeout_s": 4.0})
+        else:
+            # the pre-hardening contract: wait forever, no quorum
+            params.update({"fleet_train_barrier_timeout_s": 0.0,
+                           "fleet_train_rank_timeout_s": 0.0})
+        env = dict(fault_env)
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        hung = False
+        error = None
+        try:
+            continuous_distributed(params, num_workers=2,
+                                   platform="cpu", timeout=timeout,
+                                   log_dir=os.path.join(root, "logs"))
+        except subprocess.TimeoutExpired:
+            hung = True
+        except RuntimeError as exc:
+            error = str(exc)[:500]
+        finally:
+            stop_writer.set()
+            wt.join()
+            for k, v in old.items():
+                os.environ.pop(k, None) if v is None else \
+                    os.environ.__setitem__(k, v)
+        state = None
+        try:
+            state = json.load(open(os.path.join(
+                work, "fleet", "commit_state.json")))
+        except (OSError, ValueError):
+            pass
+        fired = {"rank_stall": 0, "exchange_torn": 0,
+                 "barrier_stall": 0}
+        logdir = os.path.join(root, "logs")
+        if os.path.isdir(logdir):
+            for fn in os.listdir(logdir):
+                text = open(os.path.join(logdir, fn),
+                            errors="replace").read()
+                for name in fired:
+                    fired[name] += text.count(
+                        f"LGBM_TPU_FAULT_FIRED {name}")
+        return {"hung": hung, "error": error, "state": state,
+                "commit_times": commit_times, "work": work,
+                "src": src, "fired": fired}
+
+    # one fault per phase where durations conflict: RANK_STALL and
+    # BARRIER share LGBM_TPU_FAULT_STALL_S, so the tolerated-slow-
+    # barrier probe (stall < deadline) runs as its own short phase
+    stall_faults = {"LGBM_TPU_FAULT_RANK_STALL": "1",
+                    "LGBM_TPU_FAULT_RANK": "1",
+                    "LGBM_TPU_FAULT_STALL_S": "600"}
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_gray_")
+    try:
+        # ---- phase 1: un-hardened (knobs zeroed) — must exceed the
+        # bound: the fleet hangs at the stalled rank's first collective
+        # until the attempt deadline reaps it
+        un = run_fleet(os.path.join(tmp, "unhardened"), hardened=False,
+                       timeout=unhardened_timeout, max_restarts=0,
+                       fault_env=stall_faults)
+        un_cycles = (un["state"] or {}).get("cycle", -1) + 1
+        un_exceeded = un["hung"] or un_cycles < 3
+
+        # ---- phase 2: hardened — quorum commits through the stall
+        # (and a torn exchange write healed 0.3s later), the relaunched
+        # rank rejoins and replays
+        hd = run_fleet(os.path.join(tmp, "hardened"), hardened=True,
+                       timeout=420, max_restarts=2,
+                       fault_env=dict(stall_faults,
+                                      LGBM_TPU_FAULT_EXCHANGE_TORN="1",
+                                      LGBM_TPU_FAULT_TORN_DELAY_S="0.3"))
+
+        # ---- phase 3: slow-barrier tolerance — a 3s barrier stall
+        # UNDER the 8s deadline must fire and be absorbed (no abort,
+        # no exclusion, cycle 0 commits normally)
+        bar = run_fleet(os.path.join(tmp, "barrier"), hardened=True,
+                        timeout=180, max_restarts=1,
+                        fault_env={"LGBM_TPU_FAULT_BARRIER": "2",
+                                   "LGBM_TPU_FAULT_RANK": "1",
+                                   "LGBM_TPU_FAULT_STALL_S": "3"},
+                        stage_segments=False, idle_polls=40)
+        bar_cycles = (bar["state"] or {}).get("cycle", -1) + 1
+        bar_ok = (not bar["hung"] and bar["error"] is None
+                  and bar_cycles >= 1
+                  and bar["fired"]["barrier_stall"] >= 1
+                  and not (bar["state"] or {}).get("excluded_history"))
+        state = hd["state"] or {}
+        cycles_committed = state.get("cycle", -1) + 1
+        gaps = [t2 - t1 for (_, t1), (_, t2) in
+                zip(hd["commit_times"], hd["commit_times"][1:])]
+        max_gap = round(max(gaps), 1) if gaps else None
+        # torn commits: every journal line parses, the commit record
+        # parses, and its model file matches its sha256
+        torn = 0
+        model_ok = False
+        try:
+            mf = state.get("model_file")
+            if mf:
+                text = open(mf).read()
+                model_ok = (hashlib.sha256(text.encode()).hexdigest()
+                            == state.get("model_sha256"))
+        except OSError:
+            pass
+        journal1 = []
+        for r in range(2):
+            jp = os.path.join(hd["work"], "fleet",
+                              f"journal_rank{r}.jsonl")
+            if os.path.exists(jp):
+                for line in open(jp):
+                    if not line.strip():
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        torn += 1
+                        continue
+                    if r == 1:
+                        journal1.append(e)
+        # the stalled rank's segment: prepared, then re-prepared at a
+        # later cycle, trained in a committed cycle, byte-identical
+        prepares = [int(e["cycle"]) for e in journal1
+                    if e.get("phase", "prepare") == "prepare"
+                    and stall_seg in e["segments"]]
+        requeued = any(e.get("phase") == "requeue"
+                       and stall_seg in e["segments"] for e in journal1)
+        replay_ok = (len(prepares) >= 2
+                     and max(prepares) > min(prepares)
+                     and max(prepares) <= state.get("cycle", -1))
+        ev1 = os.path.join(hd["work"], "fleet", "events_rank1.jsonl")
+        trained_after_requeue = False
+        if os.path.exists(ev1):
+            evs = [json.loads(l) for l in open(ev1) if l.strip()]
+            trained_after_requeue = any(
+                stall_seg in (e.get("segments") or []) for e in evs)
+        excluded = any(rs == [1] for rs in
+                       state.get("excluded_history", {}).values())
+        fired = {"rank_stall": hd["fired"]["rank_stall"],
+                 "exchange_torn": hd["fired"]["exchange_torn"],
+                 "barrier_stall": bar["fired"]["barrier_stall"]}
+        fired_ok = all(v > 0 for v in fired.values())
+        result = {
+            "metric": f"continuous_gray_2workers_{rounds}rounds_"
+                      f"{seg_rows}segrows",
+            "value": round(time.time() - t_start, 1),
+            "unit": "s",
+            "vs_baseline": (1.0 if (un_exceeded and cycles_committed >= 3
+                                    and (max_gap or 1e9) <= cycle_bound_s
+                                    and torn == 0 and model_ok
+                                    and replay_ok and fired_ok
+                                    and bar_ok)
+                            else 0.0),
+            "unhardened": {"hung": un["hung"],
+                           "cycles_committed": un_cycles,
+                           "exceeded_bound": un_exceeded,
+                           "error": un["error"]},
+            "hardened": {
+                "cycles_committed": cycles_committed,
+                "published_at_least_3": cycles_committed >= 3,
+                "max_intercommit_gap_s": max_gap,
+                "cycle_bound_s": cycle_bound_s,
+                "within_cycle_bound": (max_gap or 1e9) <= cycle_bound_s,
+                "torn_journal_lines": torn,
+                "commit_model_sha_ok": model_ok,
+                "rank1_excluded_in_history": excluded,
+                "stall_seg_requeued": requeued,
+                "stall_seg_replayed_committed": replay_ok,
+                "stall_seg_trained_after_requeue": trained_after_requeue,
+                "faults_fired": fired,
+                "all_faults_fired": fired_ok,
+            },
+            "barrier_tolerance": {
+                "slow_barrier_absorbed": bar_ok,
+                "cycles_committed": bar_cycles,
+                "barrier_stall_fired": bar["fired"]["barrier_stall"],
+            },
+            "backend": backend,
+        }
+    finally:
+        if os.environ.get("BENCH_GRAY_KEEP") == "1":
+            print(f"BENCH_GRAY_KEEP: artifacts left at {tmp}",
+                  flush=True)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def run_hist():
     """Child body for BENCH_STAGE=hist: prove the bin-width-class histogram
     engine without the chip.
@@ -2230,6 +2544,8 @@ if __name__ == "__main__":
             run_continuous()
         elif stage == "continuous_sharded":
             run_continuous_sharded()
+        elif stage == "continuous_gray":
+            run_continuous_gray()
         else:
             run_training()
     else:

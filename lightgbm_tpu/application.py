@@ -69,7 +69,18 @@ class Application:
         # (router / replica / continuous rank) starts handling requests
         from .telemetry import trace as _trace
         _trace.configure_from_config(self.config)
-        if self.config.num_machines > 1 and self.config.machines:
+        sharded_cpu_continuous = False
+        if self.config.task == "continuous" \
+                and int(self.config.continuous_shards or 0) > 1:
+            # CPU continuous fleets coordinate entirely over the shared
+            # filesystem (FleetComm transport="fs"): joining
+            # jax.distributed here would make a SOLO worker relaunch
+            # impossible — the coordination service aborts every task
+            # when a member reconnects with a new incarnation
+            import jax as _jax
+            sharded_cpu_continuous = _jax.default_backend() == "cpu"
+        if self.config.num_machines > 1 and self.config.machines \
+                and not sharded_cpu_continuous:
             # reference Application::InitTrain -> Network::Init
             # (application.cpp:170): join the cluster before any device work
             from .parallel.mesh import maybe_init_distributed
@@ -353,11 +364,32 @@ class Application:
             rebin_threshold=cfg.continuous_rebin_threshold,
             rebin_every_k=cfg.continuous_rebin_every_k)
         if sharded:
-            from .parallel.mesh import comm_rank, maybe_init_distributed
-            maybe_init_distributed(cfg)
-            rank = comm_rank()
-            comm = FleetComm(rank, shards,
-                             exchange_dir=f"{workdir}/fleet/exchange")
+            import jax as _jax
+            if _jax.default_backend() == "cpu":
+                # CPU fleets coordinate ENTIRELY over the shared
+                # filesystem (token barriers + sha256-verified
+                # exchanges): no jax.distributed membership means a
+                # stalled worker can be killed and relaunched SOLO and
+                # simply ask the surviving quorum for re-admission —
+                # no coordinator to re-register with.  Rank resolution
+                # is the same env-then-machines-list walk the
+                # jax.distributed path uses — a silent default of 0
+                # would split-brain a manually-launched fleet into N
+                # self-appointed rank-0s
+                from .parallel.mesh import _detect_rank
+                transport = "fs"
+                rank = _detect_rank(cfg)
+            else:
+                from .parallel.mesh import (comm_rank,
+                                            maybe_init_distributed)
+                maybe_init_distributed(cfg)
+                transport = "auto"
+                rank = comm_rank()
+            comm = FleetComm(
+                rank, shards,
+                exchange_dir=f"{workdir}/fleet/exchange",
+                barrier_timeout_s=cfg.fleet_train_barrier_timeout_s,
+                transport=transport)
             tail = DataTail(
                 cfg.continuous_source,
                 quarantine_path=f"{workdir}/quarantine_rank{rank}.jsonl",
@@ -405,7 +437,9 @@ class Application:
             # the constructor; an input_model seed never overrides a
             # recovered commit record
             service = ShardedContinuousService(
-                tail, trainer, gate, poll_s=cfg.continuous_poll_s)
+                tail, trainer, gate, poll_s=cfg.continuous_poll_s,
+                rank_timeout_s=cfg.fleet_train_rank_timeout_s,
+                poison_cycle_attempts=cfg.continuous_poison_cycle_attempts)
         else:
             service = ContinuousService(tail, trainer, gate,
                                         poll_s=cfg.continuous_poll_s)

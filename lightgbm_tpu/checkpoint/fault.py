@@ -26,6 +26,41 @@ test (or a chaos-engineering harness) schedule one fault:
                                 in-process variant for fast tier-1 tests
     LGBM_TPU_FAULT_EXIT_CODE    exit status for mode=exit (default 43)
 
+GRAY faults (the rank stays ALIVE — passing health checks, renewing
+nothing — which is exactly what the training fleet's bounded barriers,
+rank leases and quorum cycle commit exist to survive):
+
+    LGBM_TPU_FAULT_BARRIER=<n>  the fault rank's n-th FleetComm barrier
+                                call (1-based, per process) stalls for
+                                LGBM_TPU_FAULT_STALL_S seconds before
+                                participating — peers see a barrier
+                                deadline, not a death
+    LGBM_TPU_FAULT_RANK_STALL=<c>
+                                at continuous cycle c, AFTER the cycle's
+                                segments were polled and journaled as
+                                prepared (an idle poll at cycle c keeps
+                                waiting for real work), the fault rank
+                                sleeps LGBM_TPU_FAULT_STALL_S seconds
+                                mid-phase: alive, answering nothing,
+                                renewing no lease — the canonical gray
+                                failure
+    LGBM_TPU_FAULT_EXCHANGE_TORN=<n>
+                                the fault rank's n-th filesystem exchange
+                                write lands TORN (truncated payload under
+                                a correct sha256 sidecar); the real bytes
+                                follow after LGBM_TPU_FAULT_TORN_DELAY_S
+                                seconds — readers must skip-and-retry,
+                                never crash on the torn npz
+    LGBM_TPU_FAULT_STALL_S      stall duration for BARRIER/RANK_STALL
+                                (default 30)
+    LGBM_TPU_FAULT_TORN_DELAY_S seconds before the good exchange bytes
+                                replace the torn ones (default 0.5)
+
+Every fired fault increments an in-process counter
+(``fault_fired_count``) and writes a greppable ``LGBM_TPU_FAULT_FIRED
+<name>`` line to stderr so multi-process soaks can assert each injected
+fault actually fired.
+
 The engine's training loop calls ``maybe_inject_fault(it)`` each
 iteration and the serving front-end calls its own
 ``RequestFaultLatch.maybe_inject(count)`` per admitted predict; with no
@@ -45,6 +80,9 @@ from typing import Optional
 __all__ = ["InjectedWorkerFault", "fault_spec", "maybe_inject_fault",
            "cycle_fault_spec", "maybe_inject_cycle_fault",
            "request_fault_spec", "RequestFaultLatch",
+           "barrier_fault_spec", "maybe_inject_barrier_stall",
+           "rank_stall_spec", "maybe_inject_rank_stall",
+           "exchange_torn_spec", "fault_fired", "fault_fired_count",
            "FAULT_ENV_VARS", "DEFAULT_FAULT_EXIT_CODE"]
 
 FAULT_ITER_ENV = "LGBM_TPU_FAULT_ITER"
@@ -53,9 +91,36 @@ FAULT_REQUEST_ENV = "LGBM_TPU_FAULT_REQUEST"
 FAULT_RANK_ENV = "LGBM_TPU_FAULT_RANK"
 FAULT_MODE_ENV = "LGBM_TPU_FAULT_MODE"
 FAULT_EXIT_CODE_ENV = "LGBM_TPU_FAULT_EXIT_CODE"
+FAULT_BARRIER_ENV = "LGBM_TPU_FAULT_BARRIER"
+FAULT_RANK_STALL_ENV = "LGBM_TPU_FAULT_RANK_STALL"
+FAULT_EXCHANGE_TORN_ENV = "LGBM_TPU_FAULT_EXCHANGE_TORN"
+FAULT_STALL_S_ENV = "LGBM_TPU_FAULT_STALL_S"
+FAULT_TORN_DELAY_S_ENV = "LGBM_TPU_FAULT_TORN_DELAY_S"
 FAULT_ENV_VARS = (FAULT_ITER_ENV, FAULT_CYCLE_ENV, FAULT_REQUEST_ENV,
-                  FAULT_RANK_ENV, FAULT_MODE_ENV, FAULT_EXIT_CODE_ENV)
+                  FAULT_RANK_ENV, FAULT_MODE_ENV, FAULT_EXIT_CODE_ENV,
+                  FAULT_BARRIER_ENV, FAULT_RANK_STALL_ENV,
+                  FAULT_EXCHANGE_TORN_ENV, FAULT_STALL_S_ENV,
+                  FAULT_TORN_DELAY_S_ENV)
 DEFAULT_FAULT_EXIT_CODE = 43
+
+# in-process fired counters (name -> count): soaks and unit tests assert
+# every injected fault actually FIRED, the same contract as chaosio and
+# chaosnet counters.  Multi-process harnesses grep the stderr line.
+_FIRED: dict = {}
+
+
+def fault_fired(name: str, detail: str = "") -> None:
+    _FIRED[name] = _FIRED.get(name, 0) + 1
+    sys.stderr.write(f"LGBM_TPU_FAULT_FIRED {name} {detail}\n")
+    sys.stderr.flush()
+
+
+def fault_fired_count(name: str) -> int:
+    return _FIRED.get(name, 0)
+
+
+def _stall_seconds() -> float:
+    return float(os.environ.get(FAULT_STALL_S_ENV, "30") or 30)
 
 
 class InjectedWorkerFault(RuntimeError):
@@ -187,3 +252,69 @@ class RequestFaultLatch:
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(spec["exit_code"])
+
+
+# ---------------------------------------------------------------------------
+# Gray faults: the rank stays alive.  These never kill the process — the
+# whole point is a worker that passes liveness checks while making no
+# progress, which kill-based injection cannot model.
+# ---------------------------------------------------------------------------
+def barrier_fault_spec() -> Optional[dict]:
+    """Parse the FleetComm barrier-stall fault; None when none set."""
+    raw = os.environ.get(FAULT_BARRIER_ENV)
+    if raw is None or raw == "":
+        return None
+    return {"barrier": int(raw),
+            "rank": int(os.environ.get(FAULT_RANK_ENV, "0") or 0),
+            "stall_s": _stall_seconds()}
+
+
+def maybe_inject_barrier_stall(count: int, rank: int,
+                               sleep_fn=None) -> None:
+    """Stall (sleep, alive) before participating in this rank's
+    ``count``-th FleetComm barrier.  The peers observe exactly what a
+    gray rank produces: a barrier that never completes inside its
+    deadline, from a process that is demonstrably still running."""
+    spec = barrier_fault_spec()
+    if spec is None or count != spec["barrier"] or rank != spec["rank"]:
+        return
+    fault_fired("barrier_stall",
+                f"rank={rank} barrier={count} stall_s={spec['stall_s']}")
+    import time
+    (sleep_fn or time.sleep)(spec["stall_s"])
+
+
+def rank_stall_spec() -> Optional[dict]:
+    """Parse the mid-cycle rank-stall fault; None when none set."""
+    raw = os.environ.get(FAULT_RANK_STALL_ENV)
+    if raw is None or raw == "":
+        return None
+    return {"cycle": int(raw),
+            "rank": int(os.environ.get(FAULT_RANK_ENV, "0") or 0),
+            "stall_s": _stall_seconds()}
+
+
+def maybe_inject_rank_stall(cycle: int, rank: int,
+                            sleep_fn=None) -> None:
+    """Sleep mid-cycle on the fault rank: segments polled and journaled
+    as prepared, then nothing — no collectives, no lease renewals, no
+    death.  The window where the fleet must choose between waiting
+    forever (pre-hardening) and a quorum degraded commit."""
+    spec = rank_stall_spec()
+    if spec is None or cycle != spec["cycle"] or rank != spec["rank"]:
+        return
+    fault_fired("rank_stall",
+                f"rank={rank} cycle={cycle} stall_s={spec['stall_s']}")
+    import time
+    (sleep_fn or time.sleep)(spec["stall_s"])
+
+
+def exchange_torn_spec() -> Optional[dict]:
+    """Parse the torn-exchange-write fault; None when none set."""
+    raw = os.environ.get(FAULT_EXCHANGE_TORN_ENV)
+    if raw is None or raw == "":
+        return None
+    return {"exchange": int(raw),
+            "rank": int(os.environ.get(FAULT_RANK_ENV, "0") or 0),
+            "delay_s": float(os.environ.get(FAULT_TORN_DELAY_S_ENV,
+                                            "0.5") or 0.5)}

@@ -97,22 +97,83 @@ def _kill_all(procs) -> None:
         p.wait()
 
 
+def _lease_evidence(lease_monitor) -> str:
+    if lease_monitor is None:
+        return ""
+    try:
+        rows = lease_monitor.summary()
+    except Exception as exc:        # evidence, not a dependency
+        return f"\n(lease table unreadable: {exc!r})"
+    return "\nlease ages at failure:\n" + "\n".join(
+        f"  rank {r['rank']}: {r['state']}"
+        + (f" (age {r['age_s']}s, phase={r['phase']}, "
+           f"cycle={r['cycle']}, iter={r['iteration']})"
+           if r["age_s"] is not None else " (no lease written)")
+        for r in rows)
+
+
 def _supervise(launch, max_restarts: int, backoff_s: float,
-               timeout: int, script: str) -> None:
+               timeout: int, script: str,
+               lease_monitor=None, launch_one=None) -> None:
     """Synchronous-SPMD supervision shared by the training launcher and
     the sharded continuous fleet: poll worker processes; on any abnormal
     exit (or a hung attempt past ``timeout``) kill the survivors and
     relaunch the WHOLE job — workers recover from their own persistent
     state (checkpoints / ingest journals) — with bounded exponential
     backoff up to ``max_restarts``.  ``launch(attempt) -> (procs,
-    logs)``; fault env stripping per attempt is the launcher's job."""
+    logs)``; fault env stripping per attempt is the launcher's job.
+
+    **Gray-failure supervision** (``lease_monitor`` + ``launch_one``,
+    the continuous fleet): a worker whose process is ALIVE but whose
+    rank lease has gone stalled is a gray failure no exit code will ever
+    report.  The supervisor kills and relaunches ONLY that worker
+    (``launch_one(rank, attempt, solo) -> (proc, log)``); the relaunched
+    rank recovers from its journal and asks the surviving quorum for
+    re-admission.  Solo relaunches share the ``max_restarts`` budget,
+    and every budget-exhausted error carries the lease-age table — the
+    evidence of who was stalled, slow, or fresh when the budget died."""
     attempt = 0
+    solo_restarts = 0
     while True:
         procs, logs = launch(attempt)
+        # grace window per rank: a just-(re)launched worker's lease
+        # still carries its pre-kill age until recovery writes the
+        # first heartbeat — judging it stalled in that window would
+        # kill-loop the relaunch
+        grace = getattr(lease_monitor, "stalled_after_s", 60.0)
+        launched_at = [time.time()] * len(procs)
         deadline = time.time() + timeout
         failed_rank = None
         hung = False
         while True:
+            if lease_monitor is not None and launch_one is not None:
+                for r in lease_monitor.stalled_ranks():
+                    if procs[r].poll() is not None:
+                        continue     # dead, not gray: the rc path below
+                    if time.time() - launched_at[r] < grace:
+                        continue     # lease may predate the relaunch
+                    if solo_restarts + attempt >= max_restarts:
+                        _kill_all(procs)
+                        raise RuntimeError(
+                            f"worker {r} is stalled (alive, lease "
+                            "expired) and the restart budget is "
+                            f"exhausted ({solo_restarts} solo + "
+                            f"{attempt} fleet restarts of "
+                            f"{max_restarts});"
+                            f"{_lease_evidence(lease_monitor)}\n"
+                            f"--- tail of rank {r} ---\n"
+                            f"{_tail(logs[r])}")
+                    log_warning(
+                        f"worker {r} is STALLED (process alive, lease "
+                        "expired): killing and relaunching only it "
+                        f"(solo restart {solo_restarts + 1});"
+                        f"{_lease_evidence(lease_monitor)}")
+                    procs[r].kill()
+                    procs[r].wait()
+                    procs[r], logs[r] = launch_one(r, attempt,
+                                                   solo_restarts)
+                    launched_at[r] = time.time()
+                    solo_restarts += 1
             rcs = [p.poll() for p in procs]
             bad = [r for r, rc in enumerate(rcs) if rc not in (None, 0)]
             if bad:
@@ -138,7 +199,7 @@ def _supervise(launch, max_restarts: int, backoff_s: float,
         _kill_all(procs)
         why = (f"hung past the {timeout}s attempt deadline" if hung
                else f"died (rc={rc})")
-        if attempt >= max_restarts:
+        if attempt + solo_restarts >= max_restarts:
             if hung:
                 raise subprocess.TimeoutExpired(
                     cmd=f"{sys.executable} {script}", timeout=timeout)
@@ -147,7 +208,8 @@ def _supervise(launch, max_restarts: int, backoff_s: float,
             raise RuntimeError(
                 f"worker {failed_rank} failed (rc={rc}) and the restart "
                 f"budget is exhausted ({attempt}/{max_restarts} restarts "
-                f"used); worker logs:\n{log_list}\n"
+                f"used);{_lease_evidence(lease_monitor)}\n"
+                f"worker logs:\n{log_list}\n"
                 f"--- tail of rank {failed_rank} ---\n"
                 f"{_tail(logs[failed_rank])}")
         delay = backoff_s * (2.0 ** attempt)
@@ -339,49 +401,89 @@ def continuous_distributed(params: Dict, num_workers: int = 2,
     os.makedirs(tmp, exist_ok=True)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+    def _spawn_worker(rank: int, machines: str, ports, attempt: int,
+                      strip_faults: bool, log_path: str):
+        argv = dict(params)
+        argv["num_machines"] = num_workers
+        argv["machines"] = machines
+        argv["local_listen_port"] = ports[rank]
+        # every rank serves its own registry copy: one port each
+        # (0 = train/gate only, the localhost-fleet default — a
+        # front door would sit behind fleet/router.py anyway)
+        base_port = int(params.get("serving_port", 0) or 0)
+        argv["serving_port"] = (base_port + rank) if base_port else 0
+        cmd = [sys.executable, "-m", "lightgbm_tpu"] + [
+            f"{k}={v}" for k, v in argv.items()]
+        env = dict(os.environ)
+        env["LIGHTGBM_TPU_RANK"] = str(rank)
+        # attempt-namespaced coordination files (FleetComm): a killed
+        # attempt's stale barrier tokens / exchange payloads can never
+        # satisfy a fresh attempt's collectives
+        env["LIGHTGBM_TPU_FLEET_ATTEMPT"] = str(attempt)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        if platform:
+            env["LIGHTGBM_TPU_PLATFORM"] = platform
+            env["JAX_PLATFORMS"] = platform
+        if strip_faults:
+            # transient-fault model: an injected fault does not
+            # recur on the relaunch (checkpoint/fault.py)
+            from .checkpoint.fault import FAULT_ENV_VARS
+            for var in FAULT_ENV_VARS:
+                env.pop(var, None)
+        log_info(f"continuous worker {rank} log: {log_path}")
+        log_fh = open(log_path, "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=log_fh,
+                                stderr=subprocess.STDOUT, text=True)
+        log_fh.close()       # the child keeps its own handle
+        return proc
+
+    launch_state = {"machines": "", "ports": []}
+
     def _launch(attempt: int):
         ports = find_open_ports(num_workers)
         machines = ",".join(f"{h}:{p}" for h, p in zip(hosts, ports))
+        launch_state["machines"] = machines
+        launch_state["ports"] = ports
         log_info(f"launching {num_workers} continuous workers "
                  f"(attempt {attempt}): {machines}")
         procs, logs = [], []
         for rank in range(num_workers):
-            argv = dict(params)
-            argv["num_machines"] = num_workers
-            argv["machines"] = machines
-            argv["local_listen_port"] = ports[rank]
-            # every rank serves its own registry copy: one port each
-            # (0 = train/gate only, the localhost-fleet default — a
-            # front door would sit behind fleet/router.py anyway)
-            base_port = int(params.get("serving_port", 0) or 0)
-            argv["serving_port"] = (base_port + rank) if base_port else 0
-            cmd = [sys.executable, "-m", "lightgbm_tpu"] + [
-                f"{k}={v}" for k, v in argv.items()]
-            env = dict(os.environ)
-            env["LIGHTGBM_TPU_RANK"] = str(rank)
-            env["PYTHONPATH"] = repo + os.pathsep + env.get(
-                "PYTHONPATH", "")
-            if platform:
-                env["LIGHTGBM_TPU_PLATFORM"] = platform
-                env["JAX_PLATFORMS"] = platform
-            if attempt > 0:
-                # transient-fault model: an injected fault does not
-                # recur on the relaunch (checkpoint/fault.py)
-                from .checkpoint.fault import FAULT_ENV_VARS
-                for var in FAULT_ENV_VARS:
-                    env.pop(var, None)
             log_path = os.path.join(tmp, f"worker_{rank}_a{attempt}.log")
             logs.append(log_path)
-            log_info(f"continuous worker {rank} log: {log_path}")
-            log_fh = open(log_path, "w")
-            procs.append(subprocess.Popen(
-                cmd, env=env, stdout=log_fh,
-                stderr=subprocess.STDOUT, text=True))
-            log_fh.close()       # the child keeps its own handle
+            procs.append(_spawn_worker(rank, machines, ports, attempt,
+                                       strip_faults=attempt > 0,
+                                       log_path=log_path))
         return procs, logs
 
+    def _launch_one(rank: int, attempt: int, solo: int):
+        """Gray-failure targeted relaunch: only the stalled worker comes
+        back (same fleet attempt — it must share the survivors'
+        coordination namespace to be re-admitted), faults stripped."""
+        log_path = os.path.join(
+            tmp, f"worker_{rank}_a{attempt}s{solo}.log")
+        proc = _spawn_worker(rank, launch_state["machines"],
+                             launch_state["ports"], attempt,
+                             strip_faults=True, log_path=log_path)
+        return proc, log_path
+
+    # lease-age supervision: only meaningful when the quorum machinery
+    # is on (rank timeout > 0).  The stalled threshold sits well past
+    # the in-process vote window so quorum exclusion gets first shot and
+    # the supervisor's kill is the recovery of last resort.
+    rank_timeout = float(params.get("fleet_train_rank_timeout_s",
+                                    60.0) or 0.0)
+    lease_monitor = None
+    if rank_timeout > 0:
+        from .continuous.lease import LeaseMonitor
+        lease_monitor = LeaseMonitor(
+            f"{workdir.rstrip('/')}/fleet", num_workers,
+            slow_after_s=rank_timeout,
+            stalled_after_s=3.0 * rank_timeout)
+
     _supervise(_launch, max_restarts, backoff_s, timeout,
-               "python -m lightgbm_tpu task=continuous")
+               "python -m lightgbm_tpu task=continuous",
+               lease_monitor=lease_monitor, launch_one=_launch_one)
     # the fleet's single source of truth for "what is committed"
     import json as _json
 

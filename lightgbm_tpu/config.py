@@ -452,6 +452,31 @@ _PARAMS: List[ParamSpec] = [
     _p("continuous_segment_retry_backoff_s", float, 0.5, (), ">=0",
        "base backoff before re-reading an unreadable segment (doubles "
        "per attempt, capped at 60s)"),
+    _p("fleet_train_barrier_timeout_s", float, 600.0, (), ">=0",
+       "deadline for every training-fleet FleetComm barrier and "
+       "filesystem exchange (sharded continuous coordination): past it "
+       "the rank raises a typed CoordinationTimeoutError instead of "
+       "hanging, the cycle aborts cleanly (prepared segments stay "
+       "journaled, the registry keeps serving) and either the quorum "
+       "degraded path or a supervised relaunch finishes the work.  "
+       "0 = wait forever (the pre-hardening contract, kept for A/B "
+       "chaos runs)"),
+    _p("fleet_train_rank_timeout_s", float, 60.0, (), ">=0",
+       "quorum degraded mode (filesystem coordination transport): after "
+       "a coordination timeout, surviving ranks vote for this window — "
+       "a rank that shows no presence is excluded, the cycle completes "
+       "on the quorum's union of shards, and the excluded rank's "
+       "prepared segments are re-queued (lgbm_continuous_rank_excluded_"
+       "total, re-admission on recovery).  Also the lease-age threshold "
+       "past which a rank counts as stalled rather than slow.  0 = no "
+       "quorum: a timeout fails the worker fast for a supervised "
+       "whole-fleet relaunch"),
+    _p("continuous_poison_cycle_attempts", int, 3, (), ">0",
+       "poison-cycle guard: an in-flight segment set that crashes its "
+       "cycle this many consecutive relaunches is quarantined (reason "
+       "poison_cycle, lgbm_continuous_poison_cycle_total) instead of "
+       "replaying into yet another crash and burning the restart "
+       "budget"),
     # ---- Objective ----
     _p("num_class", int, 1, ("num_classes",), ">0"),
     _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),

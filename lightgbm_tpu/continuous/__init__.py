@@ -21,8 +21,10 @@ coverage in tests).
   consensus, two-phase ingest commit; ``continuous_shards > 1``)
 """
 
+from ..log import CoordinationTimeoutError
 from .drift import DriftSketch, reduce_sketch
 from .gate import PublishGate
+from .lease import LeaseMonitor, RankLease, classify_age
 from .service import ContinuousService
 from .sharded import (FleetComm, ShardedContinuousService,
                       ShardedContinuousTrainer, load_mapper_artifact,
@@ -37,6 +39,8 @@ __all__ = [
     "ContinuousTrainer", "combine_model_strings", "holdout_auc",
     "checkpoint_prefix_matches",
     "PublishGate", "ContinuousService",
-    "FleetComm", "ShardedContinuousTrainer", "ShardedContinuousService",
+    "FleetComm", "CoordinationTimeoutError",
+    "RankLease", "LeaseMonitor", "classify_age",
+    "ShardedContinuousTrainer", "ShardedContinuousService",
     "save_mapper_artifact", "load_mapper_artifact",
 ]

@@ -1,0 +1,160 @@
+"""Rank leases: cheap per-rank heartbeats for the training fleet.
+
+A gray rank is alive (its process answers ``poll()``, the OS says
+nothing is wrong) while making no progress.  Process liveness therefore
+cannot distinguish *stalled* from *slow* — but a lease can: each rank
+renews a tiny tmp+rename JSON file (phase, cycle, iteration, timestamp)
+through the io scheme registry as it moves through a cycle, and any
+observer (rank 0 deciding a quorum, ``cluster._supervise`` deciding whom
+to kill-and-relaunch) classifies ranks by lease AGE:
+
+- **fresh** — renewed within ``slow_after_s``: making normal progress.
+- **slow**  — older than ``slow_after_s`` but younger than
+  ``stalled_after_s``: degraded, keep waiting (killing a slow rank
+  converts a latency problem into an availability problem).
+- **stalled** — older than ``stalled_after_s``: treat as failed even
+  though the process is alive.  Quorum exclusion and targeted
+  kill-and-relaunch key off this state.
+- **missing** — never wrote a lease (a rank that died before its first
+  renewal, or one whose storage is gone).
+
+Everything is clock-injectable so the state machine unit-tests run with
+zero wall-clock sleeps; renewals are rate-limited (``min_interval_s``)
+so per-iteration training callbacks cost one comparison, not one write.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..io import file_io
+from ..log import log_warning
+
+__all__ = ["RankLease", "LeaseMonitor", "lease_path", "classify_age"]
+
+
+def lease_path(fleet_dir: str, rank: int) -> str:
+    return f"{fleet_dir}/leases/lease_rank{int(rank)}.json"
+
+
+def classify_age(age_s: Optional[float], slow_after_s: float,
+                 stalled_after_s: float) -> str:
+    """The lease state machine's single transition function."""
+    if age_s is None:
+        return "missing"
+    if age_s >= stalled_after_s:
+        return "stalled"
+    if age_s >= slow_after_s:
+        return "slow"
+    return "fresh"
+
+
+class RankLease:
+    """Writer side: one rank's heartbeat file.
+
+    ``renew`` is called from hot-ish paths (per training iteration via a
+    callback), so it rate-limits actual writes to ``min_interval_s`` —
+    the freshness resolution observers can rely on is therefore
+    ``min_interval_s``, and thresholds should sit well above it."""
+
+    def __init__(self, fleet_dir: str, rank: int,
+                 min_interval_s: float = 0.5, clock=None):
+        self.path = lease_path(fleet_dir, rank)
+        self.rank = int(rank)
+        self.min_interval_s = float(min_interval_s)
+        self._clock = clock or time.time
+        self._last_write = float("-inf")
+        self._last_payload: Dict = {}
+        self._dir_ready = False
+
+    def renew(self, phase: str, cycle: int = -1,
+              iteration: int = -1, force: bool = False) -> bool:
+        """Write the heartbeat (rate-limited); returns True when a write
+        actually happened.  Failures are logged, never raised — a lease
+        is evidence, not a dependency, and a rank must not die because
+        its heartbeat disk hiccuped."""
+        now = self._clock()
+        if not force and now - self._last_write < self.min_interval_s:
+            return False
+        payload = {"rank": self.rank, "phase": str(phase),
+                   "cycle": int(cycle), "iteration": int(iteration),
+                   "ts": float(now)}
+        try:
+            from ..checkpoint.manager import atomic_write_bytes
+            if not self._dir_ready:
+                file_io.makedirs(self.path.rsplit("/", 1)[0])
+                self._dir_ready = True
+            atomic_write_bytes(self.path,
+                               json.dumps(payload).encode("utf-8"))
+        except OSError as exc:
+            log_warning(f"continuous: lease renewal failed for rank "
+                        f"{self.rank}: {exc}")
+            return False
+        self._last_write = now
+        self._last_payload = payload
+        return True
+
+
+class LeaseMonitor:
+    """Reader side: classify every rank's lease by age.
+
+    Used by rank 0 (and every surviving rank) when a coordination
+    deadline fires — to distinguish the stalled rank from merely slow
+    ones before voting it out — and by ``cluster._supervise`` to
+    kill-and-relaunch ONLY the stuck worker instead of the whole
+    fleet."""
+
+    def __init__(self, fleet_dir: str, size: int,
+                 slow_after_s: float = 15.0,
+                 stalled_after_s: float = 60.0, clock=None):
+        self.fleet_dir = fleet_dir.rstrip("/")
+        self.size = int(size)
+        self.slow_after_s = float(slow_after_s)
+        self.stalled_after_s = float(stalled_after_s)
+        self._clock = clock or time.time
+
+    def read(self, rank: int) -> Optional[Dict]:
+        try:
+            return json.loads(file_io.read_text(
+                lease_path(self.fleet_dir, rank)))
+        except (OSError, ValueError):
+            return None
+
+    def ages(self) -> List[Optional[float]]:
+        """Per-rank lease age in seconds (None = missing/unreadable)."""
+        now = self._clock()
+        out: List[Optional[float]] = []
+        for r in range(self.size):
+            lease = self.read(r)
+            out.append(None if lease is None
+                       else max(0.0, now - float(lease.get("ts", 0.0))))
+        return out
+
+    def states(self) -> List[str]:
+        return [classify_age(a, self.slow_after_s, self.stalled_after_s)
+                for a in self.ages()]
+
+    def stalled_ranks(self) -> List[int]:
+        return [r for r, s in enumerate(self.states()) if s == "stalled"]
+
+    def summary(self) -> List[Dict]:
+        """One row per rank: the evidence block error messages and
+        exclusion trace spans carry (age, state, last phase/cycle)."""
+        now = self._clock()
+        rows = []
+        for r in range(self.size):
+            lease = self.read(r) or {}
+            ts = lease.get("ts")
+            age = None if ts is None else max(0.0, now - float(ts))
+            rows.append({
+                "rank": r,
+                "age_s": None if age is None else round(age, 3),
+                "state": classify_age(age, self.slow_after_s,
+                                      self.stalled_after_s),
+                "phase": lease.get("phase"),
+                "cycle": lease.get("cycle"),
+                "iteration": lease.get("iteration"),
+            })
+        return rows

@@ -155,19 +155,34 @@ class ContinuousService:
         self.events.append(summary)
         return summary
 
+    def _cycle_callbacks(self) -> List:
+        """Per-iteration callbacks threaded into each training cycle
+        (the sharded service renews its rank lease here so observers can
+        tell a slow iteration from a stalled worker)."""
+        return []
+
     def _train_cycle_supervised(self) -> Dict:
         """Run one cycle, retrying a crashed attempt from its checkpoints
         with bounded exponential backoff — the in-process analog of
-        cluster.py's supervised restart (same budget semantics)."""
+        cluster.py's supervised restart (same budget semantics).
+        Coordination timeouts pass straight through: they are the
+        fleet's abort signal, and wrapping them in a generic cycle
+        failure would hide the quorum path behind a retry loop."""
+        from ..log import CoordinationTimeoutError
         delay = self.retry_backoff_s
         for attempt in range(self.max_cycle_retries + 1):
             try:
-                return self.trainer.train_cycle()
-            except (KeyboardInterrupt, SystemExit):
+                return self.trainer.train_cycle(
+                    callbacks=self._cycle_callbacks())
+            except (KeyboardInterrupt, SystemExit,
+                    CoordinationTimeoutError):
                 raise
             except Exception as exc:
                 self.m_cycle_failures.inc()
                 if attempt == self.max_cycle_retries:
+                    # the decision evidence must survive the incident:
+                    # burst-dump the recent traces before giving up
+                    self.tracer.maybe_dump("train_abort")
                     raise LightGBMError(
                         f"continuous: cycle {self.trainer.cycle} failed "
                         f"{attempt + 1} times (last: {exc}); giving up — "

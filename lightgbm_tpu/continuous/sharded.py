@@ -50,19 +50,31 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+import os
 import pickle
-from typing import Dict, List, Optional
+import re
+import threading
+import time
+import zipfile
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..checkpoint.fault import (exchange_torn_spec, fault_fired,
+                                maybe_inject_barrier_stall)
 from ..io import file_io
-from ..log import LightGBMError, log_info, log_warning
+from ..log import (CoordinationTimeoutError, LightGBMError, log_info,
+                   log_warning)
+from ..telemetry import get_counter
 from .service import ContinuousService
 from .trainer import ContinuousTrainer
 
-__all__ = ["FleetComm", "ShardedContinuousTrainer",
+__all__ = ["FleetComm", "CoordinationTimeoutError",
+           "ShardedContinuousTrainer",
            "ShardedContinuousService", "save_mapper_artifact",
            "load_mapper_artifact", "mapper_artifact_path"]
+
+FLEET_ATTEMPT_ENV = "LIGHTGBM_TPU_FLEET_ATTEMPT"
 
 
 def _alloc_bucket(n: int) -> int:
@@ -85,34 +97,119 @@ class FleetComm:
     - **filesystem** — on backends that cannot (multi-process CPU: jax
       raises "Multiprocess computations aren't implemented on the CPU
       backend"), payloads ride the shared ``exchange_dir`` through the
-      io scheme registry, sequenced by the jax.distributed
-      coordination-service barrier (which IS available on every
-      backend).  Collective calls are made in lockstep on every rank, so
-      a monotonic per-comm counter names each exchange uniquely;
+      io scheme registry.  Collective calls are made in lockstep on
+      every rank, so a monotonic per-comm counter names each exchange
+      uniquely; ``transport="fs"`` forces this mode (in-process test
+      fleets drive the whole hardened path over real files);
     - **injected** — tests pass thread-backed ``allgather_fn`` /
       ``barrier_fn`` to drive an N-rank fleet inside one process, the
       same injected-collective pattern the loading-phase exchanges use.
-    """
+
+    **Gray-failure hardening** (the training-fleet half of the PR 12
+    story): every barrier and exchange takes a DEADLINE
+    (``barrier_timeout_s``, config ``fleet_train_barrier_timeout_s``;
+    0 = wait forever, the pre-hardening contract) and raises a typed
+    :class:`CoordinationTimeoutError` instead of hanging.  Filesystem
+    exchange payloads carry a size/sha256 sidecar, verified BEFORE
+    ``np.load`` — a torn npz (killed writer, chaos injection) is
+    skip-and-retried inside the deadline, never a ``BadZipFile`` crash.
+    Filesystem barriers are token files polled with the same deadline.
+
+    **Roster + epochs** (quorum degraded mode, filesystem transport
+    only): ``members`` is the currently-participating rank set and
+    ``adopt(members, epoch)`` moves every participant to a fresh
+    coordination namespace with reset sequence counters — all adopting
+    ranks reset identically, so lockstep restarts aligned at the new
+    epoch's first collective, and a stalled rank's late writes land in a
+    namespace nobody reads.  ``FLEET_ATTEMPT_ENV`` (set per launch by
+    ``cluster.continuous_distributed``) namespaces a whole relaunch the
+    same way, so a killed run's stale files can never satisfy a fresh
+    run's barriers."""
 
     def __init__(self, rank: int = 0, size: int = 1,
                  allgather_fn=None, barrier_fn=None,
-                 exchange_dir: Optional[str] = None):
+                 exchange_dir: Optional[str] = None,
+                 barrier_timeout_s: float = 600.0,
+                 transport: str = "auto"):
         self.rank = int(rank)
         self.size = max(int(size), 1)
         if not 0 <= self.rank < self.size:
             raise ValueError(f"rank {rank} not in [0, {self.size})")
+        if transport not in ("auto", "fs"):
+            raise ValueError(f"transport {transport!r} must be "
+                             "'auto' or 'fs'")
         self._allgather_fn = allgather_fn
         self._barrier_fn = barrier_fn
         self.exchange_dir = exchange_dir
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self._transport = transport
+        self.attempt = int(os.environ.get(FLEET_ATTEMPT_ENV, "0") or 0)
+        self.members: List[int] = list(range(self.size))
+        self.epoch = 0
+        # invoked on every wait-loop iteration (fs barriers, exchange
+        # retries, vote polls): the service hangs its rank-lease renewal
+        # here, because a rank WAITING at a bounded barrier is alive and
+        # progressing — without a heartbeat its lease would age through
+        # the whole wait and the supervisor would kill the healthy
+        # waiter instead of the stalled peer it is waiting for
+        self.heartbeat = None
         self._xchg = 0
+        self._bar_seq = 0
+        self._barrier_calls = 0
+        self._xchg_writes = 0
+        self._own_tokens: Dict[int, str] = {}
+        self.m_exchange_retries = get_counter(
+            None, "lgbm_continuous_exchange_retry_total",
+            "torn/partial fleet exchange files skipped and re-read "
+            "(sha256 sidecar mismatch or unparsable npz)")
+
+    # -- roster --------------------------------------------------------
+    @property
+    def active_size(self) -> int:
+        return len(self.members)
+
+    @property
+    def leader(self) -> int:
+        """Lowest participating rank: constructs mapper artifacts and
+        writes commit records (rank 0's jobs survive rank 0's
+        exclusion)."""
+        return self.members[0]
+
+    @property
+    def member_pos(self) -> int:
+        """This rank's position in the member order (the index
+        variable-length block concatenations are sliced by)."""
+        return self.members.index(self.rank)
+
+    def adopt(self, members, epoch: int) -> None:
+        """Adopt a quorum-agreed roster + coordination epoch: subsequent
+        barriers/exchanges run among ``members`` only, under a fresh
+        file namespace with reset sequence counters."""
+        members = sorted(int(m) for m in members)
+        if not members or any(not 0 <= m < self.size for m in members):
+            raise LightGBMError(f"invalid fleet roster {members}")
+        self.members = members
+        self.epoch = int(epoch)
+        self._xchg = 0
+        self._bar_seq = 0
+        self._own_tokens = {}
+
+    def supports_membership(self) -> bool:
+        """Quorum degraded mode needs per-rank addressable exchange
+        files and barriers — the filesystem transport.  Injected
+        (thread-barrier) and device (fixed-mesh) transports cannot drop
+        a participant."""
+        return self._fs_mode()
 
     # -- transport choice ----------------------------------------------
     def _fs_mode(self) -> bool:
         """True when cross-process device collectives are unavailable
         (multi-process CPU) and the shared filesystem must carry the
-        exchange instead."""
+        exchange instead — or when ``transport='fs'`` forces it."""
         if self.size <= 1 or self._allgather_fn is not None:
             return False
+        if self._transport == "fs":
+            return True
         import jax
         return jax.process_count() > 1 and jax.default_backend() == "cpu"
 
@@ -124,42 +221,89 @@ class FleetComm:
             return True
         if self._allgather_fn is not None:
             return False               # in-process fleet: no real mesh
+        if self._transport == "fs":
+            return False
         import jax
         return jax.default_backend() != "cpu"
 
+    def _resolve_timeout(self, timeout_s) -> float:
+        """None -> the comm-wide default; 0 -> unbounded (the
+        pre-hardening contract, selectable for A/B chaos runs)."""
+        return (self.barrier_timeout_s if timeout_s is None
+                else float(timeout_s))
+
+    def _require_full_roster(self, what: str) -> None:
+        if self.active_size != self.size:
+            raise LightGBMError(
+                f"{what} cannot run a degraded roster "
+                f"({self.members} of {self.size}): quorum exclusion is "
+                "a filesystem-transport feature")
+
+    def _epoch_dir(self) -> str:
+        return (f"{self.exchange_dir}/a{self.attempt}_e{self.epoch}"
+                if self.exchange_dir else "")
+
     # -- primitives ----------------------------------------------------
-    def allgather(self, arr: np.ndarray) -> np.ndarray:
-        """Equal-shaped per-rank array -> [size, ...] stacked."""
+    def allgather(self, arr: np.ndarray,
+                  timeout_s: Optional[float] = None) -> np.ndarray:
+        """Equal-shaped per-member array -> [active_size, ...] stacked
+        in member order (== rank order on a full roster)."""
         arr = np.ascontiguousarray(arr)
-        if self.size <= 1:
+        if self.active_size <= 1 or self.size <= 1:
             return arr[None]
         if self._allgather_fn is not None:
+            self._require_full_roster("injected collectives")
             return np.asarray(self._allgather_fn(arr))
         if self._fs_mode():
-            return self._fs_allgather(arr)
+            return self._fs_allgather(arr, timeout_s=timeout_s)
+        self._require_full_roster("device collectives")
         from ..parallel.mesh import host_allgather
         return host_allgather(arr)
 
-    def allreduce(self, arr: np.ndarray) -> np.ndarray:
-        """Element-wise int64 sum across ranks (drift-sketch consensus
+    def allreduce(self, arr: np.ndarray,
+                  timeout_s: Optional[float] = None) -> np.ndarray:
+        """Element-wise int64 sum across members (drift-sketch consensus
         and fleet train decisions): device psum on a real multi-process
         mesh, allgather-sum otherwise."""
         arr = np.ascontiguousarray(np.asarray(arr, np.int64))
-        if self.size <= 1:
+        if self.active_size <= 1 or self.size <= 1:
             return arr.copy()
         if self._allgather_fn is not None:
+            self._require_full_roster("injected collectives")
             return np.asarray(self._allgather_fn(arr)).sum(axis=0)
         if self._fs_mode():
-            return self._fs_allgather(arr).sum(axis=0)
+            return self._fs_allgather(arr,
+                                      timeout_s=timeout_s).sum(axis=0)
+        self._require_full_roster("device collectives")
         from ..parallel.mesh import allreduce_sum
         return allreduce_sum(arr)
 
-    def barrier(self, tag: str, timeout_s: float = 600.0) -> None:
-        """Named fleet rendezvous (mapper publish, cycle commit)."""
-        if self.size <= 1:
+    def barrier(self, tag: str,
+                timeout_s: Optional[float] = None) -> None:
+        """Named fleet rendezvous (mapper publish, cycle commit).
+        Bounded: past the deadline it raises
+        :class:`CoordinationTimeoutError` instead of waiting forever."""
+        if self.active_size <= 1 or self.size <= 1:
             return
+        if self.rank not in self.members:
+            raise LightGBMError(
+                f"rank {self.rank} is excluded from the current roster "
+                f"{self.members} and must not join its collectives")
+        self._barrier_calls += 1
+        maybe_inject_barrier_stall(self._barrier_calls, rank=self.rank)
+        t = self._resolve_timeout(timeout_s)
         if self._barrier_fn is not None:
-            self._barrier_fn(tag)
+            try:
+                self._barrier_fn(tag)
+            except CoordinationTimeoutError:
+                raise
+            except threading.BrokenBarrierError as exc:
+                raise CoordinationTimeoutError(
+                    f"barrier:{tag}", t, self.rank,
+                    "injected barrier broke") from exc
+            return
+        if self._fs_mode():
+            self._fs_barrier(tag, t)
             return
         try:
             from jax._src import distributed as _jd
@@ -167,62 +311,282 @@ class FleetComm:
         except ImportError:          # pragma: no cover - jax internal move
             client = None
         if client is not None:
-            client.wait_at_barrier(f"lgbm_tpu_fleet_{tag}",
-                                   timeout_in_ms=int(timeout_s * 1000))
+            ms = int((t if t > 0 else 864000.0) * 1000)
+            name = f"lgbm_tpu_fleet_a{self.attempt}_e{self.epoch}_{tag}"
+            try:
+                client.wait_at_barrier(name, timeout_in_ms=ms)
+            except Exception as exc:
+                text = f"{type(exc).__name__}: {exc}"
+                if ("DEADLINE" in text.upper()
+                        or "TIME" in text.upper()):
+                    raise CoordinationTimeoutError(
+                        f"barrier:{tag}", t, self.rank, text) from exc
+                raise
             return
         # injected external collectives (no coordination service): a
         # tag-keyed allgather doubles as the rendezvous
         import zlib
         from ..checkpoint.manager import restore_barrier
+        # 0 = wait forever (pre-hardening contract): effectively
+        # unbounded here, like the coordination-service path above
         restore_barrier(zlib.crc32(f"fleet:{tag}".encode()),
-                        timeout_s=timeout_s)
+                        timeout_s=(t if t > 0 else 864000.0))
 
-    def _fs_allgather(self, arr: np.ndarray) -> np.ndarray:
-        """Filesystem allgather: write own payload (tmp+rename), barrier,
-        read everyone's, barrier, clean own file.  The exchange counter
-        advances identically on every rank (lockstep collectives), so
-        file names never collide across calls; a relaunch overwrites any
-        stale files a killed run left at the same counter BEFORE the
-        read barrier admits a reader."""
+    # -- filesystem transport ------------------------------------------
+    def _fs_barrier(self, tag: str, timeout_s: float) -> None:
+        """Token-file barrier: write own token, poll for every member's,
+        bounded by the deadline.  Lag-2 cleanup: entering barrier k
+        implies every member saw all tokens at k-1, so this rank's k-2
+        token can no longer be awaited by anyone and is removed."""
+        if not self.exchange_dir:
+            raise LightGBMError(
+                "FleetComm needs exchange_dir for filesystem barriers")
+        self._bar_seq += 1
+        seq = self._bar_seq
+        d = self._epoch_dir()
+        file_io.makedirs(d)
+        mine = f"{d}/b{seq:06d}_r{self.rank}.tok"
+        _write_bytes_atomic(mine, tag.encode("utf-8"))
+        stale = self._own_tokens.pop(seq - 2, None)
+        if stale:
+            try:
+                file_io.remove(stale)
+            except OSError:
+                pass
+        self._own_tokens[seq] = mine
+        deadline = (None if timeout_s <= 0
+                    else time.monotonic() + timeout_s)
+        delay = 0.005
+        while True:
+            if self.heartbeat is not None:
+                self.heartbeat()
+            missing = [r for r in self.members
+                       if not file_io.exists(f"{d}/b{seq:06d}_r{r}.tok")]
+            if not missing:
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise CoordinationTimeoutError(
+                    f"barrier:{tag}", timeout_s, self.rank,
+                    f"epoch {self.epoch} seq {seq}: waiting on ranks "
+                    f"{missing}")
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.05)
+
+    def _write_exchange_payload(self, path: str, payload: bytes) -> None:
+        """Payload then sha256/size sidecar, both tmp+rename: a sidecar's
+        presence implies the payload is complete — except under chaos,
+        which is what the reader's verify-and-retry is for."""
+        digest = hashlib.sha256(payload).hexdigest()
+        sidecar = json.dumps({"sha256": digest,
+                              "size": len(payload)}).encode("utf-8")
+        self._xchg_writes += 1
+        spec = exchange_torn_spec()
+        if spec is not None and spec["rank"] == self.rank \
+                and self._xchg_writes == spec["exchange"]:
+            # a killed writer's half-file: torn payload under the real
+            # sidecar; the good bytes land delay_s later on a thread —
+            # readers must skip-and-retry, never crash
+            fault_fired("exchange_torn",
+                        f"rank={self.rank} write={self._xchg_writes}")
+            _write_bytes_atomic(path + ".sha256", sidecar)
+            _write_bytes_atomic(path, payload[:max(1, len(payload) // 2)])
+
+            def _heal():
+                time.sleep(spec["delay_s"])
+                _write_bytes_atomic(path, payload)
+            threading.Thread(target=_heal, daemon=True).start()
+            return
+        _write_bytes_atomic(path, payload)
+        _write_bytes_atomic(path + ".sha256", sidecar)
+
+    def _read_exchange_payload(self, path: str, deadline,
+                               timeout_s: float) -> np.ndarray:
+        """Integrity-verified exchange read: the size/sha256 sidecar is
+        checked BEFORE ``np.load`` parses anything, and a torn/partial
+        file (killed writer, chaos injection) is skipped and re-read
+        inside the deadline instead of crashing the cycle with
+        ``BadZipFile``."""
+        delay = 0.01
+        last = "missing"
+        while True:
+            if self.heartbeat is not None:
+                self.heartbeat()
+            try:
+                want = json.loads(file_io.read_text(path + ".sha256"))
+                data = file_io.read_bytes(path)
+                if (len(data) != int(want["size"])
+                        or hashlib.sha256(data).hexdigest()
+                        != want["sha256"]):
+                    raise OSError(f"torn exchange file ({len(data)} of "
+                                  f"{want['size']} bytes)")
+                with np.load(io.BytesIO(data)) as z:
+                    return np.asarray(z["a"])
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile) as exc:
+                last = f"{type(exc).__name__}: {exc}"
+            if deadline is not None and time.monotonic() > deadline:
+                raise CoordinationTimeoutError(
+                    f"exchange:{path.rsplit('/', 1)[-1]}", timeout_s,
+                    self.rank, f"unreadable after retries: {last}")
+            self.m_exchange_retries.inc()
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def _fs_allgather(self, arr: np.ndarray,
+                      timeout_s: Optional[float] = None) -> np.ndarray:
+        """Filesystem allgather: write own payload + sidecar, barrier,
+        verify-read every member's, barrier, clean own files.  The
+        exchange counter advances identically on every member (lockstep
+        collectives) and names are attempt/epoch-namespaced, so a killed
+        or excluded run's stale files can never satisfy a live read."""
         if not self.exchange_dir:
             raise LightGBMError(
                 "FleetComm needs exchange_dir on backends without cross-"
                 "process device collectives (multi-process CPU)")
+        t = self._resolve_timeout(timeout_s)
         self._xchg += 1
-        file_io.makedirs(self.exchange_dir)
-        mine = f"{self.exchange_dir}/x{self._xchg:06d}_r{self.rank}.npz"
+        d = self._epoch_dir()
+        file_io.makedirs(d)
+        mine = f"{d}/x{self._xchg:06d}_r{self.rank}.npz"
         buf = io.BytesIO()
         np.savez(buf, a=arr)
-        _write_bytes_atomic(mine, buf.getvalue())
-        self.barrier(f"x{self._xchg}w")
-        blocks = []
-        for r in range(self.size):
-            path = f"{self.exchange_dir}/x{self._xchg:06d}_r{r}.npz"
-            with np.load(io.BytesIO(file_io.read_bytes(path))) as z:
-                blocks.append(np.asarray(z["a"]))
-        self.barrier(f"x{self._xchg}r")
-        try:
-            file_io.remove(mine)
-        except OSError:
-            pass
+        self._write_exchange_payload(mine, buf.getvalue())
+        self.barrier(f"x{self._xchg}w", timeout_s=t)
+        deadline = None if t <= 0 else time.monotonic() + t
+        blocks = [self._read_exchange_payload(
+            f"{d}/x{self._xchg:06d}_r{r}.npz", deadline, t)
+            for r in self.members]
+        self.barrier(f"x{self._xchg}r", timeout_s=t)
+        for p in (mine, mine + ".sha256"):
+            try:
+                file_io.remove(p)
+            except OSError:
+                pass
         return np.stack(blocks)
 
     # -- composites ----------------------------------------------------
-    def allgather_blocks(self, arr: np.ndarray):
-        """Variable-length per-rank blocks -> (concatenated-in-rank-order
-        array, [size] block sizes).  Blocks are padded to a power-of-two
-        bucket so the underlying collective reuses stable shapes."""
+    def allgather_blocks(self, arr: np.ndarray,
+                         timeout_s: Optional[float] = None):
+        """Variable-length per-member blocks -> (concatenated-in-member-
+        order array, [active_size] block sizes).  Blocks are padded to a
+        power-of-two bucket so the underlying collective reuses stable
+        shapes."""
         arr = np.ascontiguousarray(arr)
         n = arr.shape[0]
-        sizes = self.allgather(np.asarray([n], np.int64)).reshape(-1)
-        if self.size <= 1:
+        sizes = self.allgather(np.asarray([n], np.int64),
+                               timeout_s=timeout_s).reshape(-1)
+        if self.active_size <= 1 or self.size <= 1:
             return arr, sizes
         m = _alloc_bucket(int(sizes.max()))
         padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
         padded[:n] = arr
-        stacked = self.allgather(padded)
-        return (np.concatenate([stacked[r, :sizes[r]]
-                                for r in range(self.size)]), sizes)
+        stacked = self.allgather(padded, timeout_s=timeout_s)
+        return (np.concatenate([stacked[i, :sizes[i]]
+                                for i in range(stacked.shape[0])]),
+                sizes)
+
+    # -- quorum vote ----------------------------------------------------
+    def quorum_vote(self, vote_dir: str, cycle: int, window_s: float,
+                    decision_timeout_s: float,
+                    evidence=None, lease_states=None) -> Optional[Dict]:
+        """Surviving-rank vote after a coordination timeout: who is
+        still making progress, and may the fleet complete the cycle
+        without the rest?
+
+        Presence phase: every surviving rank writes a presence file and
+        waits the FULL window (early exit only if all ``size`` ranks
+        show up — then nobody is stalled and the vote is a pure
+        re-sync).  A stalled rank writes nothing — that is the
+        definition of stalled.  Decision phase: the lowest present rank
+        writes the decision (members, excluded, next epoch, lease
+        evidence) atomically; everyone else polls for it.  A rank that
+        wakes up late MUST check for an existing decision before voting
+        (check-first rule) — the file is the tombstone that tells it it
+        was excluded.
+
+        ``lease_states`` (callable -> per-rank states, see
+        lease.classify_age) is the stalled-vs-slow distinction: a rank
+        absent from the vote whose lease is still fresh/slow is BUSY
+        (single-threaded mid-training past the deadline), not stalled —
+        excluding it would convert a latency problem into retrained
+        work.  That vote is INCONCLUSIVE (returns None) and the caller
+        retries the collective instead.
+
+        Requires at least ``ceil(size/2)`` present ranks; fewer raises
+        ``LightGBMError`` (no quorum — fail fast, let the supervisor
+        relaunch the fleet).  The stall-not-partition failure model is
+        load-bearing here: votes ride the same shared filesystem as the
+        exchange itself, so a rank that can read the data can read the
+        vote."""
+        if not self.supports_membership():
+            raise LightGBMError(
+                "quorum degraded mode needs the filesystem coordination "
+                "transport (injected/device transports cannot drop a "
+                "participant)")
+        key = f"a{self.attempt}_e{self.epoch}_c{int(cycle)}"
+        decision_path = f"{vote_dir}/decision_{key}.json"
+        existing = _try_read_json(decision_path)
+        if existing is not None:
+            return existing
+        file_io.makedirs(vote_dir)
+        _write_bytes_atomic(
+            f"{vote_dir}/presence_{key}_r{self.rank}.json",
+            json.dumps({"rank": self.rank}).encode("utf-8"))
+        deadline = time.monotonic() + max(float(window_s), 0.05)
+        while time.monotonic() < deadline:
+            if self.heartbeat is not None:
+                self.heartbeat()
+            if len(self._present(vote_dir, key)) == self.size:
+                break
+            time.sleep(0.02)
+        existing = _try_read_json(decision_path)
+        if existing is not None:
+            return existing
+        present = self._present(vote_dir, key)
+        absent = [r for r in range(self.size) if r not in present]
+        if lease_states is not None and absent:
+            states = (lease_states() if callable(lease_states)
+                      else list(lease_states))
+            busy = [r for r in absent if r < len(states)
+                    and states[r] in ("fresh", "slow")]
+            if busy:
+                log_warning(
+                    f"quorum vote {key} inconclusive on rank "
+                    f"{self.rank}: rank(s) {busy} absent but still "
+                    "renewing their lease (busy, not stalled) — "
+                    "retrying the collective instead of excluding")
+                return None
+        quorum_min = (self.size + 1) // 2
+        if len(present) < quorum_min:
+            raise LightGBMError(
+                f"no quorum: only ranks {present} of {self.size} voted "
+                f"within {window_s:.1f}s — failing fast for a "
+                "supervised relaunch")
+        if self.rank == min(present):
+            decision = {"key": key, "members": present,
+                        "excluded": [r for r in range(self.size)
+                                     if r not in present],
+                        "epoch": self.epoch + 1,
+                        "evidence": evidence or []}
+            _write_bytes_atomic(
+                decision_path,
+                json.dumps(decision, indent=1).encode("utf-8"))
+            return decision
+        dl = time.monotonic() + max(float(decision_timeout_s), 0.05)
+        while time.monotonic() < dl:
+            if self.heartbeat is not None:
+                self.heartbeat()
+            existing = _try_read_json(decision_path)
+            if existing is not None:
+                return existing
+            time.sleep(0.02)
+        raise CoordinationTimeoutError(
+            f"quorum:{key}", decision_timeout_s, self.rank,
+            "no decision from the vote leader")
+
+    def _present(self, vote_dir: str, key: str) -> List[int]:
+        return [r for r in range(self.size)
+                if file_io.exists(f"{vote_dir}/presence_{key}_r{r}.json")]
 
 
 # ----------------------------------------------------------------------
@@ -239,6 +603,13 @@ def _write_bytes_atomic(path: str, data: bytes) -> None:
     # cache) get the same durability story as checkpoints themselves
     from ..checkpoint.manager import atomic_write_bytes
     atomic_write_bytes(path, data)
+
+
+def _try_read_json(path: str) -> Optional[Dict]:
+    try:
+        return json.loads(file_io.read_text(path))
+    except (OSError, ValueError):
+        return None
 
 
 def save_mapper_artifact(fleet_dir: str, version: int, mappers,
@@ -320,10 +691,12 @@ class ShardedContinuousTrainer(ContinuousTrainer):
             # matrix nobody holds
             self.params.setdefault("tree_learner", "data")
             self.params["num_machines"] = self.comm.size
-        if self.comm.size > 1 and comm._allgather_fn is None:
+        if self.comm.size > 1 and comm._allgather_fn is None \
+                and comm._transport != "fs":
             # real fleet: the first collective fires in the mapper sync,
             # long before any training builds a mesh — join the
-            # jax.distributed cluster up front
+            # jax.distributed cluster up front (forced-fs in-process
+            # fleets have no cluster to join)
             from ..config import Config
             from ..parallel.mesh import maybe_init_distributed
             maybe_init_distributed(Config(self.params))
@@ -336,23 +709,49 @@ class ShardedContinuousTrainer(ContinuousTrainer):
         self.artifact_digest: Optional[str] = None
         self._view_row_offset = 0
 
+    def _coord_timeout(self) -> float:
+        """The deadline every trainer-side collective runs under (config
+        ``fleet_train_barrier_timeout_s`` via the comm)."""
+        return self.comm.barrier_timeout_s
+
+    def _cycle_dir(self, cycle: int) -> str:
+        # forced-fs fleets run WITHOUT jax.distributed (that is what
+        # makes solo kill-and-relaunch possible), so the checkpoint
+        # manager's mesh-rank-0 write gate sees every worker as rank 0:
+        # give each fleet rank its own cycle namespace instead of
+        # racing identical writes into a shared one.  The namespace is
+        # also EPOCH-qualified: after a quorum roster change the cycle's
+        # training dataset (union of member shards) is a different
+        # dataset, and resuming its checkpoints would trip the
+        # fingerprint guard — the degraded retry starts fresh instead
+        if self.comm.size > 1 and self.comm._transport == "fs":
+            return (f"{self.workdir}/cycles/rank{self.comm.rank}"
+                    f"/cycle_{cycle:05d}_e{self.comm.epoch}")
+        return super()._cycle_dir(cycle)
+
     # -- fleet mapper construction -------------------------------------
     def _fleet_mappers(self, X: np.ndarray):
         """One fleet-wide mapper construction: sample → allgather →
-        rank 0 constructs + publishes the fingerprinted artifact →
-        barrier → all ranks load, verify, and agree on the digest."""
+        the leader constructs + publishes the fingerprinted artifact →
+        barrier → all ranks load, verify, and agree on the digest.  The
+        artifact version is itself a consensus (max over ranks + 1), so
+        a quorum retry where some ranks already advanced cannot fork the
+        version sequence."""
         from ..binning import find_bin_mappers
         from ..config import Config
         cfg = Config(self.params)
         n = X.shape[0]
         rng = np.random.RandomState(cfg.data_random_seed + self.comm.rank)
         take = min(n, max(1, int(cfg.bin_construct_sample_cnt)
-                          // self.comm.size))
+                          // self.comm.active_size))
         pick = np.sort(rng.choice(n, size=take, replace=False))
         sample, _ = self.comm.allgather_blocks(
-            np.ascontiguousarray(X[pick], np.float64))
-        version = self.artifact_version + 1
-        if self.comm.rank == 0:
+            np.ascontiguousarray(X[pick], np.float64),
+            timeout_s=self._coord_timeout())
+        version = int(self.comm.allgather(
+            np.asarray([self.artifact_version + 1], np.int64),
+            timeout_s=self._coord_timeout()).max())
+        if self.comm.rank == self.comm.leader:
             min_split = (cfg.min_data_in_leaf
                          if cfg.feature_pre_filter else 0)
             mappers = find_bin_mappers(
@@ -369,13 +768,15 @@ class ShardedContinuousTrainer(ContinuousTrainer):
                 {"sample_rows": int(sample.shape[0]),
                  "num_features": int(sample.shape[1]),
                  "built_cycle": int(self.cycle)})
-        self.comm.barrier(f"mapper_publish_{version}")
+        self.comm.barrier(f"mapper_publish_{version}",
+                          timeout_s=self._coord_timeout())
         obj, digest = load_mapper_artifact(self.fleet_dir, version)
         # digest consensus: every rank must have read the SAME bytes —
         # a rank that loaded a torn or stale artifact must abort the
         # cycle, not train under silently different bins
         mine = np.frombuffer(bytes.fromhex(digest), np.uint8)
-        everyone = self.comm.allgather(mine)
+        everyone = self.comm.allgather(mine,
+                                       timeout_s=self._coord_timeout())
         if not (everyone == everyone[0]).all():
             raise LightGBMError(
                 "fleet mapper refresh aborted: ranks read different "
@@ -425,7 +826,10 @@ class ShardedContinuousTrainer(ContinuousTrainer):
     # -- consensus seams ------------------------------------------------
     def _decision_sketch(self):
         from .drift import reduce_sketch
-        return reduce_sketch(self._sketch, allreduce=self.comm.allreduce)
+        t = self._coord_timeout()
+        return reduce_sketch(
+            self._sketch,
+            allreduce=lambda a: self.comm.allreduce(a, timeout_s=t))
 
     def _engine_params(self) -> Dict:
         if self.comm.size <= 1 or self.comm.device_collectives_ok():
@@ -464,17 +868,20 @@ class ShardedContinuousTrainer(ContinuousTrainer):
         from ..config import Config
         from ..dataset import Metadata, TrainDataset
         store = self._store
-        bins_g, sizes = self.comm.allgather_blocks(np.asarray(store.bins))
+        t = self._coord_timeout()
+        bins_g, sizes = self.comm.allgather_blocks(
+            np.asarray(store.bins), timeout_s=t)
         y_local = np.asarray(store.metadata.label,
                              np.float32).reshape(-1)[:store.num_data]
-        label_g, _ = self.comm.allgather_blocks(y_local)
+        label_g, _ = self.comm.allgather_blocks(y_local, timeout_s=t)
         init_g = self._allgather_init(store)
         md = Metadata(label_g, None, init_score=init_g)
         union = TrainDataset.__new__(TrainDataset)
         union._init_from_binned(bins_g, store.all_bin_mappers,
                                 store.num_total_features, md,
                                 Config(self._engine_params()))
-        self._view_row_offset = int(sizes[:self.comm.rank].sum())
+        self._view_row_offset = int(
+            sizes[:self.comm.member_pos].sum())
         self._last_train_bucket = int(union.num_rows_device)
         return union
 
@@ -488,8 +895,10 @@ class ShardedContinuousTrainer(ContinuousTrainer):
         consensus check — commit/revert bookkeeping must agree fleet-
         wide before scores are exchanged."""
         init_local = store.metadata.init_score
+        t = self._coord_timeout()
         has_init = self.comm.allgather(
-            np.asarray([init_local is not None], np.int64)).reshape(-1)
+            np.asarray([init_local is not None], np.int64),
+            timeout_s=t).reshape(-1)
         if not has_init.any():
             return None
         if not has_init.all():
@@ -498,7 +907,7 @@ class ShardedContinuousTrainer(ContinuousTrainer):
                 "init score and some do not — commit/revert "
                 "bookkeeping is inconsistent across the fleet")
         init_g, _ = self.comm.allgather_blocks(
-            np.asarray(init_local, np.float64).reshape(-1))
+            np.asarray(init_local, np.float64).reshape(-1), timeout_s=t)
         return init_g
 
     def _rank_local_view(self):
@@ -510,9 +919,10 @@ class ShardedContinuousTrainer(ContinuousTrainer):
         store = self._store
         y_local = np.asarray(store.metadata.label,
                              np.float32).reshape(-1)[:store.num_data]
-        label_g, sizes = self.comm.allgather_blocks(y_local)
+        label_g, sizes = self.comm.allgather_blocks(
+            y_local, timeout_s=self._coord_timeout())
         n_global = int(sizes.sum())
-        row_offset = int(sizes[:self.comm.rank].sum())
+        row_offset = int(sizes[:self.comm.member_pos].sum())
         md = Metadata(label_g, None,
                       init_score=self._allgather_init(store))
         view = TrainDataset.__new__(TrainDataset)
@@ -530,7 +940,7 @@ class ShardedContinuousTrainer(ContinuousTrainer):
         # rank's block to the serving ladder (train_row_buckets), so the
         # programs re-key exactly when the max block crosses a bucket
         self._last_train_bucket = (_alloc_bucket(int(sizes.max()))
-                                   * self.comm.size)
+                                   * self.comm.active_size)
         return view
 
     def _harvest_candidate_raw(self, booster) -> np.ndarray:
@@ -550,9 +960,10 @@ class ShardedContinuousTrainer(ContinuousTrainer):
                     hx, raw_score=True), np.float64).reshape(-1)
         else:
             raw_local = np.empty((0,), np.float64)
-        raw_g, _ = self.comm.allgather_blocks(raw_local)
+        t = self._coord_timeout()
+        raw_g, _ = self.comm.allgather_blocks(raw_local, timeout_s=t)
         y_g, _ = self.comm.allgather_blocks(
-            np.asarray(hy, np.float64).reshape(-1))
+            np.asarray(hy, np.float64).reshape(-1), timeout_s=t)
         if len(y_g) == 0:
             return float("nan")
         return float(AUCMetric(None).eval(raw_g, y_g, None, None)[0][1])
@@ -587,12 +998,75 @@ class ShardedContinuousService(ContinuousService):
                  poll_s: float = 1.0,
                  max_cycle_retries: int = 2,
                  retry_backoff_s: float = 0.2,
-                 metrics_registry=None):
+                 metrics_registry=None,
+                 rank_timeout_s: float = 0.0,
+                 poison_cycle_attempts: int = 3,
+                 lease_interval_s: float = 0.5):
         super().__init__(tail, trainer, gate, poll_s=poll_s,
                          max_cycle_retries=max_cycle_retries,
                          retry_backoff_s=retry_backoff_s,
                          metrics_registry=metrics_registry)
         self.comm: FleetComm = trainer.comm
+        self.rank_timeout_s = float(rank_timeout_s)
+        self.poison_cycle_attempts = max(int(poison_cycle_attempts), 1)
+        self.fleet_dir = trainer.fleet_dir
+        file_io.makedirs(self.fleet_dir)
+        self._journal_path = (f"{self.fleet_dir}/journal_rank"
+                              f"{self.comm.rank}.jsonl")
+        self._raw_base_path = (f"{self.fleet_dir}/raw_base_rank"
+                               f"{self.comm.rank}.npz")
+        self._state_path = f"{self.fleet_dir}/commit_state.json"
+        self._quorum_dir = f"{self.fleet_dir}/quorum"
+        self._pending_replay: List[str] = []
+        self._pending_needs_prepare = False
+        self._pending_prepared_cycle: Dict[str, int] = {}
+        self._carry_prepare: List[str] = []   # requeued, already in pool
+        self._carry_rows = 0
+        self._awaiting_rejoin = False
+        self._rejoin_nonce: Optional[str] = None
+        self._excluded_history: Dict[int, List[int]] = {}
+        self._reference_train_rows = 0   # train rows when store was built
+        self.recovered_from: Optional[Dict] = None
+        self.m_cycle_aborts = get_counter(
+            metrics_registry, "lgbm_continuous_cycle_aborts_total",
+            "training cycles aborted on a coordination timeout "
+            "(prepared segments re-queued, registry kept serving)")
+        self.m_rank_excluded = get_counter(
+            metrics_registry, "lgbm_continuous_rank_excluded_total",
+            "ranks voted out of a cycle by the surviving quorum "
+            "(their prepared segments are re-queued, not lost)")
+        self.m_poison_cycles = get_counter(
+            metrics_registry, "lgbm_continuous_poison_cycle_total",
+            "in-flight segment sets quarantined by the poison-cycle "
+            "guard after repeatedly crashing their cycle")
+        from .lease import LeaseMonitor, RankLease
+        self.lease = (RankLease(self.fleet_dir, self.comm.rank,
+                                min_interval_s=lease_interval_s)
+                      if self.comm.size > 1 else None)
+        if self.lease is not None:
+            # a rank WAITING at a bounded barrier is alive: renew the
+            # lease from inside every coordination wait loop (rate-
+            # limited by the lease itself) so the supervisor never
+            # mistakes the healthy waiter for the stalled peer
+            self.comm.heartbeat = lambda: self.lease.renew(
+                "coordination", cycle=self.trainer.cycle)
+        slow = max(self.rank_timeout_s / 2.0, 2 * lease_interval_s) \
+            if self.rank_timeout_s > 0 else 15.0
+        stalled = self.rank_timeout_s if self.rank_timeout_s > 0 else 60.0
+        self.monitor = LeaseMonitor(self.fleet_dir, self.comm.size,
+                                    slow_after_s=slow,
+                                    stalled_after_s=stalled)
+        # first heartbeat BEFORE any blocking work (recovery replay,
+        # layout collectives): a relaunched worker whose lease still
+        # shows the pre-kill age would be re-killed by the supervisor
+        # before it ever reached its first step
+        if self.lease is not None:
+            self.lease.renew("recover", cycle=self.trainer.cycle,
+                             force=True)
+        # a rank relaunched while the quorum runs a DEGRADED roster must
+        # not join construction collectives its peers are not at — it
+        # recovers locally and requests re-admission instead
+        self._preexcluded = self._excluded_by_record()
         if self.comm.size > 1:
             # in-process cycle retries are a SINGLE-rank recovery tool:
             # re-entering train_cycle on one rank re-issues collectives
@@ -606,26 +1080,72 @@ class ShardedContinuousService(ContinuousService):
             # hash-splits the top directory would orphan segments with
             # no error (the layout is probed once at tail construction —
             # create ALL rank subdirectories before starting the fleet)
-            layouts = self.comm.allgather(np.asarray(
-                [1 if getattr(tail, "_subdir_layout", False) else 0],
-                np.int64)).reshape(-1)
-            if not (layouts == layouts[0]).all():
-                raise LightGBMError(
-                    "sharded continuous fleet has a MIXED shard layout: "
-                    f"ranks report subdir-layout={layouts.tolist()} — "
-                    "create every <source>/<rank>/ subdirectory before "
-                    "starting the fleet, or none of them")
-        self.fleet_dir = trainer.fleet_dir
-        file_io.makedirs(self.fleet_dir)
-        self._journal_path = (f"{self.fleet_dir}/journal_rank"
-                              f"{self.comm.rank}.jsonl")
-        self._raw_base_path = (f"{self.fleet_dir}/raw_base_rank"
-                               f"{self.comm.rank}.npz")
-        self._state_path = f"{self.fleet_dir}/commit_state.json"
-        self._pending_replay: List[str] = []
-        self._reference_train_rows = 0   # train rows when store was built
-        self.recovered_from: Optional[Dict] = None
+            if not self._preexcluded:
+                try:
+                    layouts = self.comm.allgather(
+                        np.asarray(
+                            [1 if getattr(tail, "_subdir_layout", False)
+                             else 0], np.int64),
+                        timeout_s=self.comm.barrier_timeout_s
+                    ).reshape(-1)
+                except CoordinationTimeoutError:
+                    # peers may be mid-cycle on a degraded roster that
+                    # excluded us between our relaunch and this check.
+                    # The commit record lags the exclusion by a whole
+                    # training cycle, so consult the vote tombstone too
+                    if not (self._excluded_by_record()
+                            or self._excluded_by_latest_decision()):
+                        raise
+                    self._preexcluded = True
+                else:
+                    if not (layouts == layouts[0]).all():
+                        raise LightGBMError(
+                            "sharded continuous fleet has a MIXED shard "
+                            "layout: ranks report subdir-layout="
+                            f"{layouts.tolist()} — create every "
+                            "<source>/<rank>/ subdirectory before "
+                            "starting the fleet, or none of them")
         self.recover()
+
+    def _excluded_by_record(self) -> bool:
+        """True when the commit record's roster excludes this rank (a
+        relaunch landing mid-degraded-mode must rejoin, not barge into
+        the quorum's collectives)."""
+        if self.comm.size <= 1 or not self.comm.supports_membership():
+            return False
+        state = self._read_commit_state()
+        if state is None:
+            return False
+        members = [int(m) for m in
+                   state.get("members", range(self.comm.size))]
+        return self.comm.rank not in members
+
+    def _excluded_by_latest_decision(self) -> bool:
+        """True when the newest quorum decision of this attempt
+        excludes this rank — the tombstone lands at vote time, a whole
+        degraded training cycle before the commit record reflects it,
+        and a relaunched rank must not trigger a fleet-wide relaunch in
+        that window."""
+        if self.comm.size <= 1 or not self.comm.supports_membership():
+            return False
+        try:
+            names = file_io.listdir(self._quorum_dir)
+        except OSError:
+            return False
+        pat = re.compile(
+            rf"decision_a{self.comm.attempt}_e(\d+)_c(-?\d+)\.json$")
+        best = None
+        for n in names:
+            m = pat.match(n)
+            if m is None:
+                continue
+            key = (int(m.group(1)), int(m.group(2)))
+            if best is None or key > best[0]:
+                best = (key, n)
+        if best is None:
+            return False
+        d = _try_read_json(f"{self._quorum_dir}/{best[1]}")
+        return bool(d) and self.comm.rank not in d.get("members", [])
 
     # -- journal / commit-record IO ------------------------------------
     def _journal_append(self, entry: Dict) -> None:
@@ -651,7 +1171,11 @@ class ShardedContinuousService(ContinuousService):
             return None
 
     def _write_commit_state(self, decision: Dict) -> None:
-        """Phase 2, rank 0: the single fleet-wide commit record."""
+        """Phase 2, the roster leader: the single fleet-wide commit
+        record.  Carries the roster (members/epoch) so a relaunch knows
+        whether it must rejoin, and the cumulative exclusion history so
+        recovery can tell which of a rank's journaled prepares actually
+        reached a committed model."""
         tr = self.trainer
         state = {"cycle": tr.cycle - 1,   # commit/discard just advanced it
                  "decision": decision["action"],
@@ -660,6 +1184,11 @@ class ShardedContinuousService(ContinuousService):
                  "cycles_since_rebin": int(tr._cycles_since_rebin),
                  "best_auc": self.gate.best_auc,
                  "live_auc": self.gate.live_auc,
+                 "epoch": int(self.comm.epoch),
+                 "members": list(self.comm.members),
+                 "excluded_history": {str(c): rs for c, rs in
+                                      sorted(
+                                          self._excluded_history.items())},
                  "model_file": None, "model_sha256": None,
                  "prev_model_file": None}
         if tr.model_str is not None:
@@ -690,6 +1219,28 @@ class ShardedContinuousService(ContinuousService):
         _write_bytes_atomic(self._raw_base_path, buf.getvalue())
 
     # -- recovery -------------------------------------------------------
+    def _journal_status(self, journal: List[Dict]
+                        ) -> Dict[str, Tuple[int, str, int]]:
+        """Last-writer-wins status per segment: (entry index, phase,
+        cycle).  A later ``requeue`` cancels an earlier ``prepare`` (the
+        quorum excluded this rank from that cycle's commit); a
+        ``quarantine`` entry drops the segment for good (poison-cycle
+        guard)."""
+        status: Dict[str, Tuple[int, str, int]] = {}
+        for i, e in enumerate(journal):
+            ph = e.get("phase", "prepare")
+            for s in e["segments"]:
+                status[s] = (i, ph, int(e["cycle"]))
+        return status
+
+    def _seg_committed(self, s: str,
+                       status: Dict[str, Tuple[int, str, int]],
+                       committed: int) -> bool:
+        _, ph, c = status[s]
+        return (ph == "prepare" and c <= committed
+                and self.comm.rank
+                not in self._excluded_history.get(c, []))
+
     def recover(self) -> None:
         state = self._read_commit_state()
         journal = self._read_journal()
@@ -697,18 +1248,28 @@ class ShardedContinuousService(ContinuousService):
             return
         committed = int(state["cycle"]) if state is not None else -1
         tr = self.trainer
-        committed_entries = [e for e in journal
-                             if int(e["cycle"]) <= committed]
-        inflight = [e for e in journal if int(e["cycle"]) > committed]
-        # 1) replay committed segments: same bytes, same validation,
-        #    same deterministic split — the pool is rebuilt exactly
+        self._excluded_history = {
+            int(k): [int(r) for r in v] for k, v in
+            (state or {}).get("excluded_history", {}).items()}
+        status = self._journal_status(journal)
+        # 1) replay committed segments in journal order: same bytes,
+        #    same validation, same deterministic split — the pool is
+        #    rebuilt exactly.  Segments a later requeue/quarantine entry
+        #    touched, or whose cycle excluded this rank, are NOT part of
+        #    any committed model and stay out of the committed replay
         replayed_names: List[str] = []
         train_rows_at_cycle: Dict[int, int] = {}
-        for e in committed_entries:
-            batches = self.tail.read_segments(e["segments"])
+        for i, e in enumerate(journal):
+            if e.get("phase", "prepare") != "prepare":
+                continue
+            segs = [s for s in e["segments"] if status[s][0] == i
+                    and self._seg_committed(s, status, committed)]
+            if not segs:
+                continue
+            batches = self.tail.read_segments(segs)
             for b in batches:
                 tr.ingest(b.X, b.y)
-            replayed_names.extend(e["segments"])
+            replayed_names.extend(segs)
             train_rows_at_cycle[int(e["cycle"])] = tr.num_train_rows
         self.tail.mark_seen(replayed_names)
         # 2) committed model + gate baseline
@@ -771,131 +1332,556 @@ class ShardedContinuousService(ContinuousService):
             except OSError:
                 pass
         # 5) the in-flight cycle replays on exactly its prepared
-        #    segments before any new polling
+        #    segments before any new polling.  Requeued segments (and
+        #    prepares whose cycle committed WITHOUT this rank — quorum
+        #    exclusion) need a FRESH prepare entry at the cycle that
+        #    finally consumes them; plain in-flight prepares do not.
         pending: List[str] = []
-        for e in inflight:
-            pending.extend(e["segments"])
+        needs_prepare = False
+        dropped: List[str] = []
+        for s, (_, ph, c) in status.items():
+            if ph == "quarantine":
+                dropped.append(s)
+            elif ph == "requeue":
+                pending.append(s)
+                self._pending_prepared_cycle[s] = -1   # always re-prepare
+                needs_prepare = True
+            elif not self._seg_committed(s, status, committed):
+                pending.append(s)
+                self._pending_prepared_cycle[s] = c
+                if self.comm.rank in self._excluded_history.get(c, []):
+                    needs_prepare = True
+        self.tail.mark_seen(dropped)
+        # poison-cycle guard: an in-flight segment set that keeps
+        # crashing its cycle across relaunches gets quarantined instead
+        # of burning the whole restart budget — the fleet trades those
+        # rows for its liveness, exactly like a poisoned segment
+        if pending:
+            pending = self._poison_cycle_guard(sorted(pending),
+                                               committed + 1, pending)
         self._pending_replay = pending
+        self._pending_needs_prepare = needs_prepare and bool(pending)
         self.tail.mark_seen(pending)
+        if self._preexcluded:
+            # the fleet committed a cycle without us: adopt nothing,
+            # request re-admission, and hold every collective until the
+            # quorum answers (_await_rejoin_step)
+            self._request_rejoin("relaunch")
         self.recovered_from = {
             "committed_cycle": committed,
             "replayed_segments": len(replayed_names),
             "inflight_segments": len(pending),
+            "poison_quarantined": len(dropped),
+            "awaiting_rejoin": self._awaiting_rejoin,
         }
         log_info(f"continuous[shard {self.comm.rank}]: recovered at "
                  f"cycle {committed} ({len(replayed_names)} committed "
-                 f"segments replayed, {len(pending)} in-flight)")
+                 f"segments replayed, {len(pending)} in-flight, "
+                 f"awaiting_rejoin={self._awaiting_rejoin})")
+
+    def _poison_cycle_guard(self, key_names: List[str], cycle: int,
+                            pending: List[str]) -> List[str]:
+        """Count consecutive recoveries that found the SAME in-flight
+        segment set; past the budget, quarantine the set (reason
+        ``poison_cycle``) instead of replaying it into yet another
+        crash."""
+        path = (f"{self.fleet_dir}/recover_attempts_rank"
+                f"{self.comm.rank}.json")
+        fp = hashlib.sha256(
+            json.dumps(key_names).encode("utf-8")).hexdigest()
+        prev = _try_read_json(path) or {}
+        attempts = (int(prev.get("attempts", 0)) + 1
+                    if prev.get("fingerprint") == fp else 1)
+        _write_bytes_atomic(path, json.dumps(
+            {"fingerprint": fp, "attempts": attempts,
+             "cycle": int(cycle)}).encode("utf-8"))
+        if attempts <= self.poison_cycle_attempts:
+            return pending
+        self._journal_append({"phase": "quarantine", "cycle": int(cycle),
+                              "segments": pending})
+        self.tail._quarantine([{"segment": s, "row": -1,
+                                "reason": "poison_cycle", "raw": ""}
+                               for s in pending])
+        self.tail.mark_seen(pending)
+        self.m_poison_cycles.inc()
+        log_warning(
+            f"continuous[shard {self.comm.rank}]: in-flight segments "
+            f"{pending} crashed their cycle {attempts - 1} times — "
+            "quarantined (reason=poison_cycle) instead of burning the "
+            "restart budget")
+        return []
+
+    def _request_rejoin(self, why: str) -> None:
+        self._awaiting_rejoin = True
+        self._rejoin_nonce = (f"c{self.trainer.cycle}_"
+                              f"e{self.comm.epoch}_"
+                              f"{int(time.time() * 1000)}")
+        try:
+            file_io.remove(f"{self._quorum_dir}/admit_rank"
+                           f"{self.comm.rank}.json")
+        except OSError:
+            pass
+        file_io.makedirs(self._quorum_dir)
+        _write_bytes_atomic(
+            f"{self._quorum_dir}/rejoin_rank{self.comm.rank}.json",
+            json.dumps({"rank": self.comm.rank,
+                        "nonce": self._rejoin_nonce,
+                        "why": why}).encode("utf-8"))
+        log_warning(f"continuous[shard {self.comm.rank}]: requesting "
+                    f"re-admission to the fleet ({why})")
 
     # -- the coordinated step ------------------------------------------
     def _step_inner(self) -> Dict:
         # overriding _step_inner (not step) keeps the base class's
         # cycle-trace wrapper: sharded cycles get the same poll -> train
-        # -> gate -> publish trace as the single-process service
-        from ..checkpoint.fault import maybe_inject_cycle_fault
-        tr = self.trainer
-        replaying = bool(self._pending_replay)
-        # replay must be FLEET-consistent: while any rank is replaying
-        # its in-flight cycle, the others consume NOTHING this step —
-        # otherwise segments that arrived during the downtime would be
-        # merged into the replayed cycle, which must re-run on exactly
-        # its original data (the checkpoints it resumes from are keyed
-        # to that data)
-        fleet_replaying = int(self.comm.allreduce(np.asarray(
-            [1 if replaying else 0], np.int64))[0]) > 0
-        if replaying:
-            batches = self.tail.read_segments(self._pending_replay)
-            self._pending_replay = []
-        elif fleet_replaying:
-            batches = []
-        else:
-            batches = self.tail.poll()
-        names = [b.name for b in batches]
-        new_rows = int(sum(len(b.y) for b in batches))
-        summary: Dict = {"new_rows": new_rows, "trained": False,
-                         "decision": None, "rollback": None,
-                         "segments": names, "replayed": replaying}
-        cycle = tr.cycle
-        # phase 1: journal the consumed segments as PREPARED before
-        # anything can die — a replayed cycle's prepare already exists
-        if names and not replaying:
-            self._journal_append({"phase": "prepare", "cycle": cycle,
+        # -> gate -> publish trace as the single-process service.
+        #
+        # The step body runs as a retryable PHASE MACHINE: when a
+        # collective misses its deadline, the surviving quorum votes,
+        # adopts a reduced roster + fresh coordination epoch, and
+        # re-enters the step with the already-finished phases skipped
+        # (ingest/journal are not repeated; training resumes from its
+        # cycle checkpoints).  An excluded rank re-queues its prepared
+        # segments and waits for re-admission instead.
+        if self._awaiting_rejoin:
+            return self._await_rejoin_step()
+        if self.lease is not None:
+            self.lease.renew("poll", cycle=self.trainer.cycle)
+        st: Dict = {"stage": "roster"}
+        retries = 0
+        while True:
+            try:
+                return self._step_phases(st)
+            except CoordinationTimeoutError as exc:
+                retries += 1
+                self._on_coordination_timeout(exc)
+                if (self.rank_timeout_s <= 0 or self.comm.size <= 1
+                        or not self.comm.supports_membership()
+                        or retries > 3):
+                    raise
+                decision = self.comm.quorum_vote(
+                    self._quorum_dir, st.get("cycle",
+                                             self.trainer.cycle),
+                    window_s=self.rank_timeout_s,
+                    decision_timeout_s=max(
+                        self.rank_timeout_s,
+                        self.comm.barrier_timeout_s
+                        or self.rank_timeout_s),
+                    evidence=self.monitor.summary(),
+                    lease_states=self.monitor.states)
+                if decision is None:
+                    # busy-not-stalled verdict: the absent rank is
+                    # still renewing its lease — re-enter the same
+                    # collective and give it another deadline
+                    continue
+                if self.comm.rank not in decision["members"]:
+                    return self._enter_excluded(st, decision)
+                self._adopt_quorum(st, decision, exc)
+
+    def _on_coordination_timeout(self, exc) -> None:
+        self.m_cycle_aborts.inc()
+        # the decision evidence must survive the incident: burst-dump
+        # the flight recorder's recent traces (reason train_abort)
+        self.tracer.maybe_dump("train_abort")
+        log_warning(
+            f"continuous[shard {self.comm.rank}]: coordination timeout "
+            f"({exc}); lease ages: {self.monitor.summary()}")
+
+    def _adopt_quorum(self, st: Dict, decision: Dict, exc) -> None:
+        """Surviving-rank side of an exclusion: record it (counter +
+        always-kept trace span), adopt the reduced roster, retry the
+        cycle on the quorum's union of shards."""
+        from ..telemetry import trace as _trace
+        newly = [r for r in decision.get("excluded", [])
+                 if r in self.comm.members and r != self.comm.rank]
+        if newly:
+            self.m_rank_excluded.inc(len(newly))
+            cyc = st.get("cycle", self.trainer.cycle)
+            hist = set(self._excluded_history.get(cyc, []))
+            self._excluded_history[cyc] = sorted(hist | set(newly))
+            with _trace.child_span(
+                    "cycle.rank_excluded", ranks=list(newly),
+                    cycle=cyc, epoch=decision["epoch"],
+                    timeout=str(exc),
+                    evidence=json.dumps(
+                        decision.get("evidence") or [])) as sp:
+                if sp is not None:
+                    sp.mark("rank_excluded")
+            log_warning(
+                f"continuous[shard {self.comm.rank}]: quorum "
+                f"{decision['members']} excluded stalled rank(s) "
+                f"{newly} at cycle {cyc}; completing the cycle on the "
+                "surviving shards (their prepared segments are "
+                "re-queued, not lost)")
+        self.comm.adopt(decision["members"], decision["epoch"])
+
+    def _enter_excluded(self, st: Dict, decision: Dict) -> Dict:
+        """Excluded-rank side: re-queue this cycle's prepared segments
+        (journal marker + in-memory carry), stand down from every
+        collective, and request re-admission."""
+        summary = st.get("summary") or {
+            "new_rows": 0, "trained": False, "decision": None,
+            "rollback": None, "segments": [], "replayed": False}
+        names = list(summary.get("segments") or [])
+        if names:
+            self._journal_append({"phase": "requeue",
+                                  "cycle": st.get(
+                                      "cycle", self.trainer.cycle),
                                   "segments": names})
-        maybe_inject_cycle_fault(cycle, rank=self.comm.rank)
-        fresh_hX, fresh_hy = [], []
-        for b in batches:
-            hx, hy = tr.ingest(b.X, b.y)
-            if len(hy):
-                fresh_hX.append(hx)
-                fresh_hy.append(hy)
-        # fleet train decision (one reduction, doubles as the lockstep
-        # rendezvous): train only when SOMEONE has fresh rows and EVERY
-        # rank has pool rows (an empty shard cannot join the collective
-        # training program)
-        nf_local = self.tail.num_features or (
-            tr._train_X[0].shape[1] if tr._train_X else 0)
-        flags = self.comm.allgather(np.asarray(
-            [new_rows, 1 if tr.num_train_rows > 0 else 0, nf_local],
-            np.int64))
-        total_fresh = int(flags[:, 0].sum())
-        ranks_with_rows = int(flags[:, 1].sum())
-        # fleet-agreed feature count: a rank whose shard never produced
-        # a segment has no local width yet, and its empty (0, 0) window
-        # must still allgather against the others' (k, F) windows
-        nf = int(flags[:, 2].max())
-        summary["fleet_fresh_rows"] = total_fresh
-        if total_fresh == 0:
-            return summary
-        # fleet-global fresh-holdout window -> identical watch verdict.
-        # Watched BEFORE the deferral below: rows ingested while the
-        # fleet waits for an empty shard must still be monitored for a
-        # live-model regression (the base service watches every fresh
-        # window, so the sharded one must too)
-        wX = (np.concatenate(fresh_hX) if fresh_hy
-              else np.empty((0, nf), np.float64))
-        wy = (np.concatenate(fresh_hy) if fresh_hy
-              else np.empty((0,), np.float64))
-        wX_g, _ = self.comm.allgather_blocks(
-            np.ascontiguousarray(wX, np.float64))
-        wy_g, _ = self.comm.allgather_blocks(
-            np.asarray(wy, np.float64).reshape(-1))
-        if len(wy_g):
-            rb = self.gate.watch(wX_g, wy_g)
-            if rb is not None:
-                summary["rollback"] = rb
-                tr.revert()
-        if ranks_with_rows < self.comm.size:
-            log_info(f"continuous[shard {self.comm.rank}]: "
-                     f"{self.comm.size - ranks_with_rows} rank(s) have "
-                     "no training rows yet; deferring the cycle")
-            return summary
-        result = self._train_cycle_supervised()
-        summary["trained"] = True
-        summary["resumed_from"] = result["resumed_from"]
-        for key in ("setup_s", "init_score_s", "compiles", "fresh_rows",
-                    "rebin", "row_bucket", "pad_fraction",
-                    "drift_max_psi"):
-            if key in result:
-                summary[key] = result[key]
-        decision = self.gate.consider(result["candidate_str"],
-                                      result["auc"],
-                                      cycle=result["cycle"])
-        if decision["action"] == "publish":
-            tr.commit(result["candidate_str"])
-        else:
-            tr.discard()
-        # phase 2: the cycle is decided — rank 0 publishes the commit
-        # record, every rank persists its raw cache, and the fleet
-        # rendezvouses so nobody starts cycle N+1 against an unwritten
-        # commit record
-        self._write_raw_base()
-        if self.comm.rank == 0:
-            self._write_commit_state(decision)
-        self.comm.barrier(f"commit_{cycle}")
-        self.m_cycles.inc()
-        summary["decision"] = decision
+            self._carry_prepare = names
+            self._carry_rows = int(summary.get("new_rows") or 0)
+        self.m_rank_excluded.inc()
+        self.tracer.maybe_dump("train_abort")
+        self._request_rejoin(
+            f"excluded by quorum {decision['members']}")
+        summary["excluded"] = True
+        summary["requeued_segments"] = names
+        # the exclusion must be visible in the per-rank event log (the
+        # soak's and the operator's observable), not only in the
+        # surviving quorum's commit record
         self.events.append(summary)
         self._append_event(summary)
         return summary
+
+    def _await_rejoin_step(self) -> Dict:
+        """One poll while excluded: no collectives, no ingest — just the
+        lease (so the supervisor knows we are alive) and the admission
+        file.  On admission: adopt the fleet's committed state (model,
+        gate baseline, artifact) and the expanded roster; the next step
+        joins the quorum's restarted lockstep at the roster exchange."""
+        if self.lease is not None:
+            self.lease.renew("excluded", cycle=self.trainer.cycle,
+                             force=True)
+        summary: Dict = {"new_rows": 0, "trained": False,
+                         "decision": None, "rollback": None,
+                         "segments": [], "replayed": False,
+                         "awaiting_rejoin": True}
+        admit = _try_read_json(f"{self._quorum_dir}/admit_rank"
+                               f"{self.comm.rank}.json")
+        if admit is None or admit.get("nonce") != self._rejoin_nonce:
+            return summary
+        self._resync_from_commit_record()
+        self.comm.adopt(admit["members"], admit["epoch"])
+        for p in (f"{self._quorum_dir}/rejoin_rank{self.comm.rank}.json",
+                  f"{self._quorum_dir}/admit_rank{self.comm.rank}.json"):
+            try:
+                file_io.remove(p)
+            except OSError:
+                pass
+        self._awaiting_rejoin = False
+        self._rejoin_nonce = None
+        summary["rejoined"] = True
+        log_info(f"continuous[shard {self.comm.rank}]: re-admitted to "
+                 f"the fleet (roster {self.comm.members}, epoch "
+                 f"{self.comm.epoch}); re-queued segments replay next "
+                 "cycle")
+        return summary
+
+    def _resync_from_commit_record(self) -> None:
+        """Adopt the fleet's committed state after an exclusion: the
+        quorum moved on (model, gate baseline, possibly a re-binned
+        mapper artifact) while this rank stood still."""
+        state = self._read_commit_state()
+        if state is None:
+            return
+        tr = self.trainer
+        committed = int(state["cycle"])
+        self._excluded_history = {
+            int(k): [int(r) for r in v] for k, v in
+            state.get("excluded_history", {}).items()}
+        if state.get("model_file"):
+            text = file_io.read_text(state["model_file"])
+            digest = hashlib.sha256(text.encode("utf-8")).hexdigest()
+            if digest != state.get("model_sha256"):
+                raise LightGBMError(
+                    "committed model failed sha256 verification on "
+                    "rejoin — refusing to adopt corrupt state")
+            if text != tr.model_str:
+                tr.model_str = text
+                # a model this rank did not train: the init-score cache
+                # is foreign; _ensure_raw_base backfills by host
+                # prediction over the pool (one-time rejoin cost)
+                tr._raw_base = None
+                tr._last_raw = None
+            if self.gate.registry is not None:
+                self.gate.registry.publish(
+                    self.gate.model_name, model_str=text,
+                    aot_bundle_dir=self.gate.aot_bundle_dir)
+        if state.get("prev_model_file"):
+            tr._prev_model_str = file_io.read_text(
+                state["prev_model_file"])
+        tr.cycle = committed + 1
+        tr._cycles_since_rebin = int(state.get("cycles_since_rebin", 0))
+        self.gate.best_auc = state.get("best_auc")
+        self.gate.live_auc = state.get("live_auc")
+        if self.gate.live_auc is not None:
+            self.gate._live_model_str = tr.model_str
+        want_artifact = int(state.get("artifact_version", 0))
+        if want_artifact > 0 and want_artifact != tr.artifact_version \
+                and tr.num_train_rows > 0 and tr._store is not None:
+            # the fleet re-binned while we were out: rebuild the local
+            # store under the committed artifact.  The whole pool
+            # becomes the sketch reference (degraded but safe: the next
+            # fleet-wide drift decision still reduces over every rank)
+            tr.restore_store(want_artifact, tr.num_train_rows)
+            tr._store_built_cycle = int(
+                state.get("store_built_cycle", 0))
+
+    # -- roster admission ----------------------------------------------
+    def _rejoin_mask(self) -> int:
+        """Bitmask of excluded ranks currently requesting re-admission
+        (read from their rejoin files; exchanged so every member admits
+        the identical set)."""
+        if self.comm.active_size == self.comm.size:
+            return 0
+        mask = 0
+        for r in range(self.comm.size):
+            if r in self.comm.members:
+                continue
+            if file_io.exists(f"{self._quorum_dir}/rejoin_rank{r}.json"):
+                mask |= (1 << r)
+        return mask
+
+    def _admit_ranks(self, mask: int) -> List[int]:
+        """Every member computed the same union mask from the roster
+        exchange: expand the roster, bump the epoch, and (leader) write
+        the admission files the returning ranks are polling."""
+        rejoiners = [r for r in range(self.comm.size)
+                     if (mask >> r) & 1 and r not in self.comm.members]
+        if not rejoiners:
+            return []
+        new_members = sorted(set(self.comm.members) | set(rejoiners))
+        new_epoch = self.comm.epoch + 1
+        # an exclusion that never reached a commit record is void once
+        # the rank is back: the cycle it was voted out of will now
+        # commit WITH its shard, and recovery must not treat that
+        # rank's prepare as uncommitted (every member computes this
+        # identically: same record, same rejoiner set)
+        committed = int((self._read_commit_state() or {}).get("cycle",
+                                                              -1))
+        for c in list(self._excluded_history):
+            if c > committed:
+                kept = [r for r in self._excluded_history[c]
+                        if r not in rejoiners]
+                if kept:
+                    self._excluded_history[c] = kept
+                else:
+                    del self._excluded_history[c]
+        if self.comm.rank == self.comm.leader:
+            for r in rejoiners:
+                req = _try_read_json(
+                    f"{self._quorum_dir}/rejoin_rank{r}.json") or {}
+                _write_bytes_atomic(
+                    f"{self._quorum_dir}/admit_rank{r}.json",
+                    json.dumps({"epoch": new_epoch,
+                                "members": new_members,
+                                "nonce": req.get("nonce")}
+                               ).encode("utf-8"))
+        self.comm.adopt(new_members, new_epoch)
+        log_info(f"continuous[shard {self.comm.rank}]: re-admitted "
+                 f"rank(s) {rejoiners} (roster {new_members}, epoch "
+                 f"{new_epoch})")
+        return rejoiners
+
+    # -- the phase machine ---------------------------------------------
+    def _step_phases(self, st: Dict) -> Dict:
+        from ..checkpoint.fault import (maybe_inject_cycle_fault,
+                                        maybe_inject_rank_stall)
+        tr = self.trainer
+        tmo = self.comm.barrier_timeout_s
+        # ---- roster: admission sweep + fleet replay consensus (the
+        # step's first collective, doubling as the lockstep rendezvous)
+        if st["stage"] == "roster":
+            replaying = bool(self._pending_replay) \
+                or bool(self._carry_prepare)
+            if self.comm.active_size > 1:
+                flags = self.comm.allgather(
+                    np.asarray([1 if replaying else 0,
+                                self._rejoin_mask()], np.int64),
+                    timeout_s=tmo)
+                st["fleet_replaying"] = int(flags[:, 0].sum()) > 0
+                mask = int(np.bitwise_or.reduce(flags[:, 1]))
+            else:
+                st["fleet_replaying"] = replaying
+                mask = self._rejoin_mask()
+            if self._admit_ranks(mask):
+                # restart the step's coordination under the expanded
+                # roster: the rejoiner enters at exactly this exchange
+                st.clear()
+                st["stage"] = "roster"
+                return self._step_phases(st)
+            st["stage"] = "ingest"
+        # ---- ingest: poll/replay + journal PREPARE + pool (local-only;
+        # never repeated on a quorum retry)
+        if st["stage"] == "ingest":
+            replaying = bool(self._pending_replay)
+            if replaying:
+                batches = self.tail.read_segments(self._pending_replay)
+                self._pending_replay = []
+            elif st["fleet_replaying"] and not self._carry_prepare:
+                # replay must be FLEET-consistent: while any rank is
+                # replaying its in-flight cycle, the others consume
+                # NOTHING this step — otherwise downtime arrivals would
+                # merge into the replayed cycle, which must re-run on
+                # exactly its original data
+                batches = []
+            else:
+                batches = self.tail.poll()
+            names = [b.name for b in batches]
+            carried = list(self._carry_prepare)
+            self._carry_prepare = []
+            carry_rows = self._carry_rows
+            self._carry_rows = 0
+            new_rows = int(sum(len(b.y) for b in batches)) + carry_rows
+            st["summary"] = {"new_rows": new_rows, "trained": False,
+                             "decision": None, "rollback": None,
+                             "segments": carried + names,
+                             "replayed": replaying}
+            cycle = tr.cycle
+            st["cycle"] = cycle
+            # phase 1: journal the consumed segments as PREPARED before
+            # anything can die.  A crash-replayed cycle's prepare
+            # already exists WHEN this cycle is the one it was prepared
+            # for; requeued segments, and prepares whose original cycle
+            # moved on without this rank, need a fresh prepare at the
+            # cycle that finally takes them — else a later crash would
+            # double-replay them
+            if replaying and names and (
+                    self._pending_needs_prepare
+                    or any(self._pending_prepared_cycle.get(n, cycle)
+                           != cycle for n in names)):
+                self._journal_append({"phase": "prepare", "cycle": cycle,
+                                      "segments": names})
+            self._pending_needs_prepare = False
+            self._pending_prepared_cycle = {}
+            if names and not replaying:
+                self._journal_append({"phase": "prepare", "cycle": cycle,
+                                      "segments": names})
+            if carried:
+                self._journal_append({"phase": "prepare", "cycle": cycle,
+                                      "segments": carried})
+            maybe_inject_cycle_fault(cycle, rank=self.comm.rank)
+            if names or carried:
+                # the gray stall is defined as "segments polled and
+                # journaled as prepared, then nothing": an idle poll at
+                # the scheduled cycle keeps waiting for real work
+                maybe_inject_rank_stall(cycle, rank=self.comm.rank)
+            fresh_hX, fresh_hy = [], []
+            for b in batches:
+                hx, hy = tr.ingest(b.X, b.y)
+                if len(hy):
+                    fresh_hX.append(hx)
+                    fresh_hy.append(hy)
+            st["fresh"] = (fresh_hX, fresh_hy)
+            if self.lease is not None:
+                self.lease.renew("ingest", cycle=cycle)
+            st["stage"] = "decide"
+        summary = st["summary"]
+        # ---- decide: fleet train decision + drift watch (collectives;
+        # idempotence-guarded so a quorum retry cannot double-watch)
+        if st["stage"] == "decide":
+            fresh_hX, fresh_hy = st["fresh"]
+            nf_local = self.tail.num_features or (
+                tr._train_X[0].shape[1] if tr._train_X else 0)
+            flags = self.comm.allgather(np.asarray(
+                [summary["new_rows"],
+                 1 if tr.num_train_rows > 0 else 0, nf_local],
+                np.int64), timeout_s=tmo)
+            total_fresh = int(flags[:, 0].sum())
+            ranks_with_rows = int(flags[:, 1].sum())
+            # fleet-agreed feature count: a rank whose shard never
+            # produced a segment has no local width yet, and its empty
+            # (0, 0) window must still allgather against (k, F) windows
+            nf = int(flags[:, 2].max())
+            summary["fleet_fresh_rows"] = total_fresh
+            if total_fresh == 0:
+                return summary
+            if not st.get("watched"):
+                # fleet-global fresh-holdout window -> identical watch
+                # verdict, BEFORE the empty-shard deferral below
+                wX = (np.concatenate(fresh_hX) if fresh_hy
+                      else np.empty((0, nf), np.float64))
+                wy = (np.concatenate(fresh_hy) if fresh_hy
+                      else np.empty((0,), np.float64))
+                wX_g, _ = self.comm.allgather_blocks(
+                    np.ascontiguousarray(wX, np.float64), timeout_s=tmo)
+                wy_g, _ = self.comm.allgather_blocks(
+                    np.asarray(wy, np.float64).reshape(-1),
+                    timeout_s=tmo)
+                st["watched"] = True
+                if len(wy_g):
+                    rb = self.gate.watch(wX_g, wy_g)
+                    if rb is not None:
+                        summary["rollback"] = rb
+                        tr.revert()
+            if ranks_with_rows < self.comm.active_size:
+                log_info(
+                    f"continuous[shard {self.comm.rank}]: "
+                    f"{self.comm.active_size - ranks_with_rows} rank(s)"
+                    " have no training rows yet; deferring the cycle")
+                return summary
+            st["stage"] = "train"
+        # ---- train: the supervised cycle (resumes from its checkpoints
+        # on a quorum retry — the collectives inside re-run under the
+        # new epoch)
+        if st["stage"] == "train":
+            if self.lease is not None:
+                self.lease.renew("train", cycle=st["cycle"], force=True)
+            result = self._train_cycle_supervised()
+            st["result"] = result
+            summary["trained"] = True
+            summary["resumed_from"] = result["resumed_from"]
+            for key in ("setup_s", "init_score_s", "compiles",
+                        "fresh_rows", "rebin", "row_bucket",
+                        "pad_fraction", "drift_max_psi"):
+                if key in result:
+                    summary[key] = result[key]
+            st["stage"] = "gate"
+        # ---- gate: local decision (collective AUC already happened
+        # inside train); guarded so a commit-barrier retry cannot
+        # re-decide or double-advance the trainer
+        if st["stage"] == "gate":
+            result = st["result"]
+            decision = self.gate.consider(result["candidate_str"],
+                                          result["auc"],
+                                          cycle=result["cycle"])
+            if decision["action"] == "publish":
+                tr.commit(result["candidate_str"])
+            else:
+                tr.discard()
+            st["decision"] = decision
+            st["stage"] = "commit"
+        # ---- commit: phase 2 of the two-phase cycle commit.  All
+        # writes are atomic and idempotent, so re-entering after a
+        # commit-barrier timeout re-asserts the same record
+        if st["stage"] == "commit":
+            self._write_raw_base()
+            if self.comm.rank == self.comm.leader:
+                self._write_commit_state(st["decision"])
+            if self.lease is not None:
+                self.lease.renew("commit", cycle=st["cycle"], force=True)
+            self.comm.barrier(
+                f"commit_{st['cycle']}",
+                timeout_s=tmo)
+        self.m_cycles.inc()
+        summary["decision"] = st["decision"]
+        self.events.append(summary)
+        self._append_event(summary)
+        return summary
+
+    def _cycle_callbacks(self) -> List:
+        if self.lease is None:
+            return []
+        lease = self.lease
+        cyc = self.trainer.cycle
+
+        def _renew(env) -> None:
+            lease.renew("train", cycle=cyc, iteration=env.iteration)
+        # block-safe: reads no eval results, so the engine keeps the
+        # fused multi-round path (renewals land at block boundaries,
+        # well inside any sane lease threshold)
+        _renew.block_safe = True
+        return [_renew]
 
     def _append_event(self, summary: Dict) -> None:
         """Per-rank cycle event log under the fleet dir (best-effort):
@@ -905,7 +1891,8 @@ class ShardedContinuousService(ContinuousService):
         ev = {k: summary.get(k) for k in
               ("new_rows", "segments", "replayed", "setup_s",
                "init_score_s", "compiles", "fresh_rows", "row_bucket",
-               "pad_fraction", "drift_max_psi", "resumed_from")}
+               "pad_fraction", "drift_max_psi", "resumed_from",
+               "excluded", "requeued_segments")}
         ev["cycle"] = self.trainer.cycle - 1
         ev["rebin"] = bool(summary.get("rebin"))
         dec = summary.get("decision")

@@ -25,11 +25,32 @@ from typing import Callable, Optional
 __all__ = ["log_debug", "log_info", "log_warning", "log_fatal",
            "register_log_callback", "set_verbosity", "apply_verbosity",
            "set_json_lines", "json_lines_enabled", "set_trace_provider",
-           "LightGBMError"]
+           "LightGBMError", "CoordinationTimeoutError"]
 
 
 class LightGBMError(Exception):
     """reference LightGBMException / LGBM_GetLastError convention."""
+
+
+class CoordinationTimeoutError(LightGBMError):
+    """A training-fleet barrier/exchange missed its deadline: some rank
+    is stalled (alive, renewing nothing) or dead.  The cycle that hit it
+    is ABORTABLE, never a hang — prepared segments stay journaled (or
+    are re-queued), the serving registry keeps the last gated model, and
+    either the quorum degraded path or a supervised relaunch finishes
+    the work.  Lives here (not in continuous/sharded.py) so the base
+    service's cycle supervision can re-raise it without a circular
+    import."""
+
+    def __init__(self, tag: str, timeout_s: float, rank: int,
+                 detail: str = ""):
+        self.tag = str(tag)
+        self.timeout_s = float(timeout_s)
+        self.rank = int(rank)
+        super().__init__(
+            f"fleet coordination timed out after {timeout_s:.1f}s at "
+            f"{tag!r} on rank {rank}"
+            + (f" ({detail})" if detail else ""))
 
 
 _VERBOSITY = 1

@@ -1,0 +1,672 @@
+"""Gray-failure hardening for the TRAINING fleet (continuous/sharded.py
++ continuous/lease.py): bounded barriers, exchange integrity, rank
+leases, quorum cycle commit, and the coordination chaos faults.
+
+Fast tests drive in-process fleets over the FORCED filesystem transport
+(``FleetComm(transport="fs")``): real token barriers, real
+sha256-sidecar exchanges, real vote/decision files — the exact code path
+a multi-process CPU fleet runs, minus the processes.  The subprocess
+e2e (stall a real worker mid-cycle) is slow-marked.
+"""
+
+import ast
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.continuous import (CoordinationTimeoutError, DataTail,
+                                     FleetComm, LeaseMonitor, PublishGate,
+                                     RankLease, ShardedContinuousService,
+                                     ShardedContinuousTrainer,
+                                     classify_age, shard_of)
+from lightgbm_tpu.log import LightGBMError
+
+NF = 6
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "min_data_in_leaf": 5, "max_bin": 31, "seed": 3}
+
+
+def _xy(n, seed=0, shift=0.0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, NF) + shift
+    y = (r.rand(n) < 1 / (1 + np.exp(-(2 * X[:, 0] + X[:, 1])))
+         ).astype(float)
+    return X, y
+
+
+def _write_segment(src, name, X, y):
+    lines = [",".join([f"{y[i]:.0f}"] + [f"{v:.6f}" for v in X[i]])
+             for i in range(len(y))]
+    tmp = os.path.join(src, f"_{name}.part")
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _seg_name(i, want_rank, num_shards=2):
+    j = 0
+    while True:
+        name = f"seg{i:03d}_{j}.csv"
+        if shard_of(name, num_shards) == want_rank:
+            return name
+        j += 1
+
+
+def _run_ranks(size, fn):
+    """fn(rank) concurrently on ``size`` threads; re-raises the first
+    failure, returns per-rank results."""
+    errs = [None] * size
+    outs = [None] * size
+
+    def wrap(r):
+        try:
+            outs[r] = fn(r)
+        except BaseException as exc:   # noqa: BLE001 - test harness
+            errs[r] = exc
+    ts = [threading.Thread(target=wrap, args=(r,)) for r in range(size)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    for e in errs:
+        if e is not None:
+            raise e
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# lease state machine: clock-injected, zero wall-clock sleeps
+# ---------------------------------------------------------------------------
+def test_classify_age_transitions():
+    assert classify_age(None, 5.0, 20.0) == "missing"
+    assert classify_age(0.0, 5.0, 20.0) == "fresh"
+    assert classify_age(4.99, 5.0, 20.0) == "fresh"
+    assert classify_age(5.0, 5.0, 20.0) == "slow"
+    assert classify_age(19.99, 5.0, 20.0) == "slow"
+    assert classify_age(20.0, 5.0, 20.0) == "stalled"
+    assert classify_age(1e9, 5.0, 20.0) == "stalled"
+
+
+def test_lease_renew_and_monitor_states(tmp_path):
+    now = [1000.0]
+    clock = lambda: now[0]                                  # noqa: E731
+    fleet = str(tmp_path / "fleet")
+    lease = RankLease(fleet, 0, min_interval_s=0.5, clock=clock)
+    mon = LeaseMonitor(fleet, 2, slow_after_s=5.0,
+                       stalled_after_s=20.0, clock=clock)
+    # rank 1 never writes: missing from the very first read
+    assert mon.states() == ["missing", "missing"]
+    assert lease.renew("poll", cycle=3, iteration=-1)
+    assert mon.states()[0] == "fresh"
+    row = mon.summary()[0]
+    assert row["phase"] == "poll" and row["cycle"] == 3
+    assert row["state"] == "fresh" and row["age_s"] == 0.0
+    # rate limit: a renewal inside min_interval_s writes nothing
+    now[0] += 0.1
+    assert not lease.renew("train", cycle=3, iteration=0)
+    assert mon.summary()[0]["phase"] == "poll"
+    # force bypasses the rate limit
+    assert lease.renew("train", cycle=3, iteration=1, force=True)
+    assert mon.summary()[0]["phase"] == "train"
+    # age walks the machine: fresh -> slow -> stalled
+    now[0] += 6.0
+    assert mon.states()[0] == "slow"
+    assert mon.stalled_ranks() == []
+    now[0] += 30.0
+    assert mon.states()[0] == "stalled"
+    assert mon.stalled_ranks() == [0]
+    # a renewal brings it straight back to fresh
+    assert lease.renew("ingest", cycle=4, force=True)
+    assert mon.states()[0] == "fresh"
+
+
+# ---------------------------------------------------------------------------
+# new fault switches parse + fire
+# ---------------------------------------------------------------------------
+def test_gray_fault_specs_and_env_table(monkeypatch):
+    from lightgbm_tpu.checkpoint.fault import (FAULT_ENV_VARS,
+                                               barrier_fault_spec,
+                                               exchange_torn_spec,
+                                               fault_fired_count,
+                                               maybe_inject_rank_stall,
+                                               rank_stall_spec)
+    for var in ("LGBM_TPU_FAULT_BARRIER", "LGBM_TPU_FAULT_RANK_STALL",
+                "LGBM_TPU_FAULT_EXCHANGE_TORN", "LGBM_TPU_FAULT_STALL_S",
+                "LGBM_TPU_FAULT_TORN_DELAY_S"):
+        assert var in FAULT_ENV_VARS
+    assert barrier_fault_spec() is None
+    assert rank_stall_spec() is None
+    assert exchange_torn_spec() is None
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK_STALL", "2")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_STALL_S", "0.01")
+    spec = rank_stall_spec()
+    assert spec["cycle"] == 2 and spec["rank"] == 1
+    assert spec["stall_s"] == 0.01
+    slept = []
+    maybe_inject_rank_stall(1, rank=1, sleep_fn=slept.append)
+    maybe_inject_rank_stall(2, rank=0, sleep_fn=slept.append)
+    assert slept == []                       # wrong cycle / wrong rank
+    n0 = fault_fired_count("rank_stall")
+    maybe_inject_rank_stall(2, rank=1, sleep_fn=slept.append)
+    assert slept == [0.01]
+    assert fault_fired_count("rank_stall") == n0 + 1
+    monkeypatch.setenv("LGBM_TPU_FAULT_BARRIER", "3")
+    monkeypatch.setenv("LGBM_TPU_FAULT_EXCHANGE_TORN", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_TORN_DELAY_S", "0.2")
+    assert barrier_fault_spec()["barrier"] == 3
+    assert exchange_torn_spec() == {"exchange": 1, "rank": 1,
+                                    "delay_s": 0.2}
+
+
+# ---------------------------------------------------------------------------
+# bounded barriers + verified exchanges over the forced-fs transport
+# ---------------------------------------------------------------------------
+def test_fs_barrier_and_allgather_roundtrip(tmp_path):
+    xdir = str(tmp_path / "xchg")
+
+    def rank_fn(rank):
+        comm = FleetComm(rank, 2, exchange_dir=xdir, transport="fs",
+                         barrier_timeout_s=10.0)
+        comm.barrier("warm", timeout_s=10.0)
+        out = comm.allgather(np.asarray([rank * 10], np.int64),
+                             timeout_s=10.0)
+        red = comm.allreduce(np.asarray([rank + 1], np.int64),
+                             timeout_s=10.0)
+        cat, sizes = comm.allgather_blocks(
+            np.arange(rank + 1, dtype=np.int64), timeout_s=10.0)
+        return out.reshape(-1).tolist(), int(red[0]), cat.tolist(), \
+            sizes.tolist()
+
+    r0, r1 = _run_ranks(2, rank_fn)
+    assert r0 == r1 == ([0, 10], 3, [0, 0, 1], [1, 2])
+
+
+def test_fs_barrier_timeout_raises_typed_error(tmp_path):
+    comm = FleetComm(0, 2, exchange_dir=str(tmp_path / "x"),
+                     transport="fs", barrier_timeout_s=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(CoordinationTimeoutError) as ei:
+        comm.barrier("lonely", timeout_s=0.2)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.tag == "barrier:lonely"
+    assert ei.value.rank == 0
+    assert "waiting on ranks [1]" in str(ei.value)
+
+
+def test_exchange_torn_file_skip_and_retry(tmp_path, monkeypatch):
+    """The injected torn write (correct sidecar over truncated payload)
+    must be skipped and re-read once the good bytes land — never a
+    BadZipFile crash, never silent acceptance of torn bytes."""
+    from lightgbm_tpu.checkpoint.fault import fault_fired_count
+    monkeypatch.setenv("LGBM_TPU_FAULT_EXCHANGE_TORN", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "0")
+    monkeypatch.setenv("LGBM_TPU_FAULT_TORN_DELAY_S", "0.15")
+    xdir = str(tmp_path / "xchg")
+    n0 = fault_fired_count("exchange_torn")
+
+    comms = {}
+
+    def rank_fn(rank):
+        comm = FleetComm(rank, 2, exchange_dir=xdir, transport="fs",
+                         barrier_timeout_s=10.0)
+        comms[rank] = comm
+        payload = np.arange(64, dtype=np.float64) + rank
+        return comm.allgather(payload, timeout_s=10.0)
+
+    r0, r1 = _run_ranks(2, rank_fn)
+    np.testing.assert_array_equal(r0, r1)
+    np.testing.assert_array_equal(r0[0], np.arange(64, dtype=np.float64))
+    assert fault_fired_count("exchange_torn") == n0 + 1
+    # at least one reader saw the torn bytes and retried
+    retries = sum(c.m_exchange_retries.value for c in comms.values())
+    assert retries >= 1
+
+
+def test_exchange_unparsable_payload_times_out_typed(tmp_path):
+    """Garbage bytes under a MATCHING sidecar (sha of the garbage) get
+    past the integrity check but fail np.load: still a bounded typed
+    timeout, never an escaped BadZipFile."""
+    import hashlib
+    comm = FleetComm(0, 2, exchange_dir=str(tmp_path / "x"),
+                     transport="fs", barrier_timeout_s=0.3)
+    path = str(tmp_path / "x" / "bogus.npz")
+    os.makedirs(str(tmp_path / "x"))
+    garbage = b"this is not an npz archive at all"
+    with open(path, "wb") as fh:
+        fh.write(garbage)
+    with open(path + ".sha256", "w") as fh:
+        json.dump({"sha256": hashlib.sha256(garbage).hexdigest(),
+                   "size": len(garbage)}, fh)
+    with pytest.raises(CoordinationTimeoutError, match="unreadable"):
+        comm._read_exchange_payload(path, time.monotonic() + 0.25, 0.25)
+    assert comm.m_exchange_retries.value >= 1
+
+
+def test_barrier_stall_fault_fires_inside_barrier(tmp_path, monkeypatch):
+    """LGBM_TPU_FAULT_BARRIER stalls the fault rank's n-th barrier: its
+    peer's bounded barrier must time out (the gray contract: the stalled
+    process is alive the whole time)."""
+    monkeypatch.setenv("LGBM_TPU_FAULT_BARRIER", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_STALL_S", "1.0")
+    xdir = str(tmp_path / "x")
+    outcomes = {}
+
+    def rank_fn(rank):
+        comm = FleetComm(rank, 2, exchange_dir=xdir, transport="fs",
+                         barrier_timeout_s=0.25)
+        try:
+            comm.barrier("b1", timeout_s=0.25)
+            outcomes[rank] = "ok"
+        except CoordinationTimeoutError:
+            outcomes[rank] = "timeout"
+
+    _run_ranks(2, rank_fn)
+    from lightgbm_tpu.checkpoint.fault import fault_fired_count
+    assert fault_fired_count("barrier_stall") >= 1
+    # rank 0 timed out waiting on the stalled rank 1; rank 1 slept
+    # through the deadline and found nobody (or its own late token)
+    assert outcomes[0] == "timeout"
+
+
+# ---------------------------------------------------------------------------
+# quorum vote over the shared filesystem
+# ---------------------------------------------------------------------------
+def test_quorum_vote_excludes_silent_rank(tmp_path):
+    vote_dir = str(tmp_path / "q")
+    xdir = str(tmp_path / "x")
+
+    def rank_fn(rank):
+        comm = FleetComm(rank, 3, exchange_dir=xdir, transport="fs",
+                         barrier_timeout_s=5.0)
+        if rank == 2:
+            return None            # stalled: never votes
+        return comm.quorum_vote(vote_dir, cycle=4, window_s=0.4,
+                                decision_timeout_s=2.0,
+                                evidence=[{"rank": rank}])
+
+    d0, d1, _ = _run_ranks(3, rank_fn)
+    assert d0["members"] == d1["members"] == [0, 1]
+    assert d0["excluded"] == [2]
+    assert d0["epoch"] == 1
+    # the decision file is a tombstone: a late waker adopts it verbatim
+    late = FleetComm(2, 3, exchange_dir=xdir, transport="fs",
+                     barrier_timeout_s=5.0)
+    dl = late.quorum_vote(vote_dir, cycle=4, window_s=0.4,
+                          decision_timeout_s=2.0)
+    assert dl["members"] == [0, 1] and 2 in dl["excluded"]
+
+
+def test_quorum_vote_busy_rank_is_not_excluded(tmp_path):
+    """A rank absent from the vote whose lease is still fresh/slow is
+    BUSY (mid-training past the deadline), not stalled: the vote is
+    inconclusive (None) and the caller retries the collective — the
+    stalled-vs-slow distinction the leases exist for."""
+    vote_dir = str(tmp_path / "q")
+    xdir = str(tmp_path / "x")
+
+    def rank_fn(rank):
+        comm = FleetComm(rank, 3, exchange_dir=xdir, transport="fs",
+                         barrier_timeout_s=5.0)
+        if rank == 2:
+            return "busy"          # never votes, but lease says fresh
+        return comm.quorum_vote(
+            vote_dir, cycle=7, window_s=0.3, decision_timeout_s=0.5,
+            lease_states=lambda: ["fresh", "fresh", "fresh"])
+
+    d0, d1, _ = _run_ranks(3, rank_fn)
+    assert d0 is None and d1 is None
+    assert not os.path.exists(
+        os.path.join(vote_dir, "decision_a0_e0_c7.json"))
+    # once the lease actually ages to stalled, the same vote excludes
+    comm = FleetComm(0, 3, exchange_dir=xdir, transport="fs",
+                     barrier_timeout_s=5.0)
+    d = comm.quorum_vote(
+        vote_dir, cycle=7, window_s=0.2, decision_timeout_s=0.5,
+        lease_states=lambda: ["fresh", "fresh", "stalled"])
+    assert d is not None and d["excluded"] == [2]
+
+
+def test_quorum_vote_no_quorum_fails_fast(tmp_path):
+    comm = FleetComm(0, 3, exchange_dir=str(tmp_path / "x"),
+                     transport="fs", barrier_timeout_s=5.0)
+    with pytest.raises(LightGBMError, match="no quorum"):
+        comm.quorum_vote(str(tmp_path / "q"), cycle=0, window_s=0.2,
+                         decision_timeout_s=0.5)
+
+
+def test_degraded_roster_rejected_on_other_transports():
+    comm = FleetComm(0, 2, allgather_fn=lambda a: np.stack([a, a]),
+                     barrier_fn=lambda t: None)
+    assert not comm.supports_membership()
+    comm.members = [0]
+    comm.members = [0, 1]
+    with pytest.raises(LightGBMError, match="filesystem"):
+        comm.quorum_vote("/nowhere", cycle=0, window_s=0.1,
+                         decision_timeout_s=0.1)
+
+
+# ---------------------------------------------------------------------------
+# the full degraded cycle: stall -> vote -> quorum commit -> requeue ->
+# rejoin -> replay (in-process 2-rank fleet over the fs transport)
+# ---------------------------------------------------------------------------
+def _build_fleet(tmp_path, rank_timeout_s, barrier_timeout_s):
+    from lightgbm_tpu.serving.server import ServingApp
+    src = str(tmp_path / "src")
+    os.makedirs(src, exist_ok=True)
+    work = str(tmp_path / "work")
+    fleet_dir = f"{work}/fleet"
+    svcs = [None, None]
+    apps = [None, None]
+
+    def build(rank):
+        app = ServingApp()
+        apps[rank] = app
+        comm = FleetComm(rank, 2, exchange_dir=f"{fleet_dir}/exchange",
+                         transport="fs",
+                         barrier_timeout_s=barrier_timeout_s)
+        tr = ShardedContinuousTrainer(
+            dict(PARAMS), work, comm, fleet_dir=fleet_dir,
+            rounds_per_cycle=3)
+        gate = PublishGate(app.registry, "m", min_auc=0.55)
+        tail = DataTail(src, num_features=NF, shard_rank=rank,
+                        num_shards=2)
+        svcs[rank] = ShardedContinuousService(
+            tail, tr, gate, poll_s=0.0,
+            rank_timeout_s=rank_timeout_s,
+            lease_interval_s=0.05)
+
+    _run_ranks(2, build)
+    return src, svcs, apps
+
+
+def test_quorum_commit_requeue_and_rejoin(tmp_path, monkeypatch):
+    # generous deadline for the compile-heavy warm-up cycle (thread
+    # skew between ranks counts against the barrier wait), tightened
+    # only around the injected stall
+    src, svcs, apps = _build_fleet(tmp_path, rank_timeout_s=0.5,
+                                   barrier_timeout_s=60.0)
+    # cycle 0: both shards contribute, both publish
+    Xa, ya = _xy(300, seed=10)
+    Xb, yb = _xy(300, seed=11)
+    _write_segment(src, _seg_name(0, 0), Xa, ya)
+    _write_segment(src, _seg_name(1, 1), Xb, yb)
+    r0 = _run_ranks(2, lambda r: svcs[r].step())
+    assert all(s["decision"]["action"] == "publish" for s in r0)
+    assert svcs[0].trainer.model_str == svcs[1].trainer.model_str
+
+    # cycle 1: rank 1 stalls mid-cycle AFTER journaling its prepare
+    Xc, yc = _xy(300, seed=12)
+    Xd, yd = _xy(300, seed=13)
+    seg_r0 = _seg_name(2, 0)
+    seg_r1 = _seg_name(3, 1)
+    _write_segment(src, seg_r0, Xc, yc)
+    _write_segment(src, seg_r1, Xd, yd)
+    for svc in svcs:
+        svc.comm.barrier_timeout_s = 1.5
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK_STALL", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_STALL_S", "4.0")
+    r1 = _run_ranks(2, lambda r: svcs[r].step())
+    monkeypatch.delenv("LGBM_TPU_FAULT_RANK_STALL")
+    for svc in svcs:
+        svc.comm.barrier_timeout_s = 60.0
+    # rank 0 completed the cycle on the surviving quorum
+    assert r1[0]["trained"] and r1[0]["decision"] is not None
+    assert svcs[0].trainer.cycle == 2
+    assert svcs[0].comm.members == [0]
+    assert svcs[0].m_rank_excluded.value >= 1
+    assert svcs[0].m_cycle_aborts.value >= 1
+    # rank 1 was excluded: its prepared segment is re-queued, not lost
+    assert r1[1].get("excluded") is True
+    assert r1[1]["requeued_segments"] == [seg_r1]
+    assert svcs[1]._awaiting_rejoin
+    journal1 = svcs[1]._read_journal()
+    assert any(e.get("phase") == "requeue" and e["segments"] == [seg_r1]
+               for e in journal1)
+    # the commit record carries the roster + exclusion evidence
+    state = json.load(open(str(
+        tmp_path / "work" / "fleet" / "commit_state.json")))
+    assert state["cycle"] == 1 and state["members"] == [0]
+    assert state["excluded_history"].get("1") == [1]
+
+    # recovery: free-running steps until rank 1 rejoins and its segment
+    # replays into a fleet-wide committed cycle
+    def drive(rank):
+        svc = svcs[rank]
+        for _ in range(120):
+            svc.step()
+            if (svc.trainer.cycle >= 3 and not svc._awaiting_rejoin
+                    and not svc._carry_prepare):
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"rank {rank} never converged")
+
+    _run_ranks(2, drive)
+    assert svcs[0].comm.members == [0, 1]
+    assert svcs[0].trainer.model_str == svcs[1].trainer.model_str
+    # exactly-once ingest accounting: the requeued segment appears in a
+    # fresh prepare AFTER its requeue marker, and rank 1's pool holds
+    # every row of both its segments exactly once
+    journal1 = svcs[1]._read_journal()
+    phases = [(e.get("phase", "prepare"), e["segments"])
+              for e in journal1 if seg_r1 in e["segments"]]
+    assert [p for p, _ in phases].count("requeue") == 1
+    assert [p for p, _ in phases].count("prepare") == 2
+    n_train = svcs[1].trainer.num_train_rows
+    n_hold = sum(len(h) for h in svcs[1].trainer._hold_y)
+    assert n_train + n_hold == 600           # both shard-1 segments, once
+    # both registries serve the fleet's committed model
+    v0 = apps[0].registry.current_version("m")
+    assert v0 >= 2 and apps[1].registry.current_version("m") >= 2
+
+
+def test_timeout_without_quorum_aborts_cleanly(tmp_path, monkeypatch):
+    """rank_timeout_s=0 (quorum off): a coordination timeout raises the
+    typed error out of step() — the fail-fast path a supervisor answers
+    with a whole-fleet relaunch — and the registry keeps serving."""
+    src, svcs, apps = _build_fleet(tmp_path, rank_timeout_s=0.0,
+                                   barrier_timeout_s=60.0)
+    Xa, ya = _xy(250, seed=20)
+    Xb, yb = _xy(250, seed=21)
+    _write_segment(src, _seg_name(0, 0), Xa, ya)
+    _write_segment(src, _seg_name(1, 1), Xb, yb)
+    r0 = _run_ranks(2, lambda r: svcs[r].step())
+    assert all(s["decision"]["action"] == "publish" for s in r0)
+    v_before = apps[0].registry.current_version("m")
+
+    _write_segment(src, _seg_name(2, 0), *_xy(250, seed=22))
+    _write_segment(src, _seg_name(3, 1), *_xy(250, seed=23))
+    for svc in svcs:
+        svc.comm.barrier_timeout_s = 0.3
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK_STALL", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_RANK", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_STALL_S", "1.0")
+
+    outcomes = {}
+
+    def step_rank(rank):
+        try:
+            svcs[rank].step()
+            outcomes[rank] = "ok"
+        except CoordinationTimeoutError:
+            outcomes[rank] = "timeout"
+
+    _run_ranks(2, step_rank)
+    assert outcomes[0] == "timeout"
+    assert svcs[0].m_cycle_aborts.value >= 1
+    # no torn commit state: the record still describes cycle 0, and the
+    # registry still serves the gated model
+    state = json.load(open(str(
+        tmp_path / "work" / "fleet" / "commit_state.json")))
+    assert state["cycle"] == 0
+    assert apps[0].registry.current_version("m") == v_before
+
+
+# ---------------------------------------------------------------------------
+# static guard: no unbounded barrier/exchange call sites in lightgbm_tpu/
+# ---------------------------------------------------------------------------
+def test_no_unbounded_coordination_call_sites():
+    """Every FleetComm-style barrier/exchange call in lightgbm_tpu/
+    (attribute calls named barrier/allgather/allreduce/allgather_blocks)
+    must pass an explicit ``timeout_s`` — an unbounded coordination wait
+    is exactly the gray-failure hang this PR removes.  Same pattern as
+    the check_vma and README-knob guards."""
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu")
+    names = {"barrier", "allgather", "allreduce", "allgather_blocks"}
+    offenders = []
+    checked = 0
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in names):
+                    continue
+                checked += 1
+                if not any(kw.arg == "timeout_s"
+                           for kw in node.keywords):
+                    rel = os.path.relpath(path, pkg)
+                    offenders.append(
+                        f"{rel}:{node.lineno}: .{node.func.attr}(...) "
+                        "without timeout_s=")
+    assert checked >= 15          # the guard guards something real
+    assert not offenders, (
+        "unbounded barrier/exchange call sites in lightgbm_tpu/ "
+        "(pass an explicit timeout_s):\n" + "\n".join(offenders))
+
+
+def test_fault_env_vars_documented_in_readme():
+    """Every LGBM_TPU_FAULT_* env var must appear in the README fault
+    table, and the README must not advertise switches fault.py no
+    longer implements — chaos knobs that exist only as test folklore
+    rot."""
+    from lightgbm_tpu.checkpoint.fault import FAULT_ENV_VARS
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    mentioned = set(re.findall(r"LGBM_TPU_FAULT_[A-Z_0-9]+\b", readme))
+    # the greppable fired-marker log line is documented too, but it is
+    # a stderr prefix, not an env var
+    mentioned.discard("LGBM_TPU_FAULT_FIRED")
+    declared = set(FAULT_ENV_VARS)
+    assert len(declared) >= 10
+    missing = sorted(declared - mentioned)
+    assert not missing, (
+        f"fault env vars not documented in README.md: {missing}")
+    stale = sorted(mentioned - declared)
+    assert not stale, (
+        f"README.md documents fault env vars fault.py does not define: "
+        f"{stale}")
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: a REAL worker stalls mid-cycle; the surviving quorum
+# commits, and the stalled worker's segments replay byte-equal
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_two_worker_fleet_stall_quorum_and_replay(tmp_path):
+    import hashlib
+
+    from lightgbm_tpu.cluster import continuous_distributed
+    src = os.path.join(str(tmp_path), "src")
+    work = os.path.join(str(tmp_path), "work")
+    logs = os.path.join(str(tmp_path), "logs")
+    os.makedirs(src)
+    os.makedirs(work)
+    Xa, ya = _xy(300, seed=10)
+    Xb, yb = _xy(300, seed=11)
+    seg_r1 = _seg_name(3, 1)
+    _write_segment(src, _seg_name(0, 0), Xa, ya)
+    _write_segment(src, _seg_name(1, 1), Xb, yb)
+    # cycle-1 segments land only after cycle 0 commits, so the stall
+    # hits a cycle with REAL prepared segments on rank 1
+    stop_writer = threading.Event()
+
+    def writer():
+        state_path = os.path.join(work, "fleet", "commit_state.json")
+        deadline = time.time() + 240
+        while not stop_writer.is_set() and time.time() < deadline:
+            try:
+                if json.load(open(state_path))["cycle"] >= 0:
+                    break
+            except (OSError, ValueError, KeyError):
+                pass
+            time.sleep(0.5)
+        # the stall target lands FIRST: if rank 0's segment landed
+        # alone, the fleet could commit cycle 1 without rank 1's shard
+        # and the cycle-keyed stall would never fire
+        _write_segment(src, seg_r1, *_xy(300, seed=13))
+        _write_segment(src, _seg_name(2, 0), *_xy(300, seed=12))
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    params = dict(PARAMS)
+    params.update({
+        "continuous_source": src, "continuous_dir": work,
+        "continuous_rounds": 3, "continuous_poll_s": 0.2,
+        "continuous_min_auc": 0.55,
+        "continuous_max_idle_polls": 150,
+        "fleet_train_barrier_timeout_s": 6.0,
+        "fleet_train_rank_timeout_s": 4.0,
+    })
+    env = {"LGBM_TPU_FAULT_RANK_STALL": "1", "LGBM_TPU_FAULT_RANK": "1",
+           "LGBM_TPU_FAULT_STALL_S": "60"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        bst = continuous_distributed(params, num_workers=2,
+                                     platform="cpu", timeout=420,
+                                     log_dir=logs)
+    finally:
+        stop_writer.set()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert bst is not None
+    seg_bytes = open(os.path.join(src, seg_r1), "rb").read()
+    state = json.load(open(os.path.join(work, "fleet",
+                                        "commit_state.json")))
+    # the quorum excluded rank 1 at some cycle and kept committing
+    assert any(rs == [1]
+               for rs in state["excluded_history"].values()), state
+    assert state["cycle"] >= 1
+    # rank 1's stalled-cycle segment was re-prepared at a LATER cycle
+    # than its first prepare (requeue marker or excluded-cycle rule)
+    jp = os.path.join(work, "fleet", "journal_rank1.jsonl")
+    entries = [json.loads(l) for l in open(jp) if l.strip()]
+    touching = [(e.get("phase", "prepare"), int(e["cycle"]))
+                for e in entries if seg_r1 in e["segments"]]
+    prepares = [c for p, c in touching if p == "prepare"]
+    assert len(prepares) >= 2 and max(prepares) > min(prepares), touching
+    # ...and the replay cycle actually TRAINED it (rank-1 events show a
+    # trained cycle consuming the segment after the exclusion)
+    ep = os.path.join(work, "fleet", "events_rank1.jsonl")
+    events = [json.loads(l) for l in open(ep) if l.strip()]
+    replayed = [e for e in events if seg_r1 in (e.get("segments") or [])]
+    assert replayed, events
+    # byte-equal replay: the re-consumed segment is the identical bytes
+    # the first prepare read (immutable tmp+rename segment contract)
+    assert hashlib.sha256(
+        open(os.path.join(src, seg_r1), "rb").read()).hexdigest() == \
+        hashlib.sha256(seg_bytes).hexdigest()
+    # the stall fault demonstrably fired in worker 1's log
+    log1 = ""
+    for fn in sorted(os.listdir(logs)):
+        if fn.startswith("worker_1_"):
+            log1 += open(os.path.join(logs, fn),
+                         errors="replace").read()
+    assert "LGBM_TPU_FAULT_FIRED rank_stall" in log1
