@@ -105,6 +105,21 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  never spent on doomed work).  CPU by design: topology
                  claims.  Knobs: BENCH_GRAY_{THREADS,SECONDS,TREES,
                  TRAIN_ROWS,STORM_THREADS,STORM_SECONDS,FACTOR}.
+- multitenant    multi-tenant control-plane soak (run_multitenant): a
+                 few trained boosters published under 100+ tenant names
+                 onto 2 supervised replica PROCESSES behind an
+                 in-process router, zipf traffic from concurrent client
+                 threads.  Mid-soak the placement controller
+                 consolidates the hottest tenant onto one replica and
+                 then migrates it to the other (token publish -> warm
+                 probe -> widen -> drain -> narrow -> unpublish), live.
+                 Bars (vs_baseline 1.0 iff all hold): ZERO failed
+                 requests across the migration and ZERO predict
+                 compiles after the publish warmups — the tree-bucket
+                 program ladder serves every tenant from shared
+                 executables.  CPU by design: topology claims.  Knobs:
+                 BENCH_MT_{REPLICAS,MODELS,BOOSTERS,THREADS,SECONDS,
+                 TREES,TRAIN_ROWS,MAX_REQ_ROWS,ZIPF_A}.
 - continuous     train→serve chaos soak (run_continuous): one in-process
                  continuous-boosting service (lightgbm_tpu/continuous/)
                  with ALL persistence on the chaosio:// fault injector,
@@ -937,6 +952,243 @@ def run_fleet():
             "kill": kill,
             "cold_start_compiles": cold_compiles,
             "per_replica": per_replica,
+            "soak_s": round(elapsed, 1),
+            "setup_s": round(setup_s, 1),
+            "backend": backend,
+        }
+        if failures:
+            result["first_failures"] = failures[:3]
+    finally:
+        try:
+            if router is not None:
+                router.close()
+            sup.stop_all()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
+def run_multitenant():
+    """Child body for BENCH_STAGE=multitenant: the multi-tenant control
+    plane soak (lightgbm_tpu/fleet/placement/ + the tree-bucket ladder).
+
+    Topology: a handful of trained boosters published under 100+ tenant
+    names onto N supervised replica PROCESSES behind an in-process
+    router, zipf-distributed traffic from concurrent client threads.
+    Mid-soak the placement controller consolidates the hottest tenant
+    onto one replica and then MIGRATES it to another (token publish ->
+    warm probe -> widen -> drain -> narrow -> unpublish).  Acceptance
+    bars: zero failed client requests across the migration, and zero
+    predict compiles on any replica after the publish warmups — the
+    tree-bucket program ladder serves every tenant from shared
+    executables, so the 100th model costs no compile time."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.fleet import (FleetRouter, FleetSupervisor,
+                                    HttpReplica, PlacementController,
+                                    SLOPolicy, default_replica_argv)
+
+    n_replicas = max(2, int(os.environ.get("BENCH_MT_REPLICAS", 2)))
+    n_models = int(os.environ.get("BENCH_MT_MODELS", 100))
+    n_boosters = int(os.environ.get("BENCH_MT_BOOSTERS", 3))
+    n_threads = int(os.environ.get("BENCH_MT_THREADS", 6))
+    rounds = int(os.environ.get("BENCH_MT_TREES", 16))
+    train_rows = int(os.environ.get("BENCH_MT_TRAIN_ROWS", 4_000))
+    max_req = int(os.environ.get("BENCH_MT_MAX_REQ_ROWS", 64))
+    zipf_a = float(os.environ.get("BENCH_MT_ZIPF_A", 1.1))
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_mt_")
+    params = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+              "verbosity": -1, "max_bin": MAX_BIN, "min_data_in_leaf": 20}
+    # a few DISTINCT boosters (same geometry family, different data) —
+    # the 100+ tenants cycle over them, which is exactly the ladder's
+    # claim: distinct models, shared programs
+    files = []
+    for b in range(n_boosters):
+        X, y = synth_binary(train_rows, seed=11 + b)
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+        path = os.path.join(tmp, f"booster{b}.txt")
+        bst.save_model(path)
+        files.append(path)
+    names = [f"t{i:03d}" for i in range(n_models)]
+
+    # the argv-seeded model is NOT a tenant: its boot warmup compiles
+    # the shared tree-bucket ladder once per replica process, so the
+    # entire tenant catalog below publishes against warm rungs — the
+    # ladder's claim is that those 100 publishes compile NOTHING
+    ports = find_open_ports(n_replicas)
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(
+            {"input_model": files[0], "serving_model_name": "seed",
+             "serving_max_wait_ms": "2", "verbosity": "-1"}, port),
+        ports, log_dir=os.path.join(tmp, "logs"),
+        max_restarts=2, restart_backoff_s=0.5)
+    router = None
+    result = {}
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=min(
+            180.0, max(deadline - time.time() - 60.0, 30.0)))
+        sup.start_watching(interval_s=0.2)
+
+        replicas = [HttpReplica(u) for u in sup.urls]
+        router = FleetRouter(
+            replicas,
+            policy=SLOPolicy(p99_ms=0, queue_rows=0, recover_polls=1),
+            poll_interval_ms=50)
+        ctl = PlacementController(router, drain_ms=300.0, poll_ms=0,
+                                  registry=router.registry)
+
+        def fleet_compiles():
+            """Per-replica {model: compile_count} maps."""
+            out = {}
+            for rep in replicas:
+                _, metrics = rep.request("GET", "/v1/metrics")
+                out[rep.name] = {
+                    name: m.get("compile_count", 0)
+                    for name, m in metrics.items() if isinstance(m, dict)}
+            return out
+
+        def compile_delta(before, after):
+            """New compiles per replica since `before`.  Only increases
+            for models still present count — an unpublished model takes
+            its (already-paid) attributed counts with it, which is not
+            a new compile."""
+            return {
+                rep: sum(max(0, cnt - before.get(rep, {}).get(name, 0))
+                         for name, cnt in models.items())
+                for rep, models in after.items()}
+
+        boot_compiles = fleet_compiles()
+
+        # --- publish the tenant catalog (every publish warms its
+        # bucket ladder server-side pre-swap; the warm rungs from the
+        # seed model's boot mean these publishes compile nothing) ---
+        t_pub = time.time()
+        published = 0
+        for i, name in enumerate(names):
+            status, body = router.handle(
+                "POST", f"/v1/models/{name}:publish",
+                {"model_file": files[i % len(files)]})
+            if status != 200:
+                raise RuntimeError(
+                    f"publish {name} failed: {status} {body}")
+            published += 1
+            if time.time() > deadline - 90:
+                break          # honest partial catalog over a timeout
+        names = names[:published]
+        publish_s = time.time() - t_pub
+        warm_compiles = fleet_compiles()
+        publish_compiles = compile_delta(boot_compiles, warm_compiles)
+        setup_s = time.time() - t_start
+
+        pool = np.random.RandomState(1).randn(2048, N_FEATURES) \
+            .astype(np.float64)
+        max_req = min(max_req, pool.shape[0] - 1)
+        # zipf over tenant ranks: rank 0 is the hot model
+        w = 1.0 / np.arange(1, len(names) + 1) ** zipf_a
+        zipf_p = w / w.sum()
+
+        duration = min(float(os.environ.get("BENCH_MT_SECONDS", 20.0)),
+                       max(deadline - time.time() - 40.0, 4.0))
+        stop_at = time.time() + duration
+        sent = [0] * n_threads
+        failures = []
+        hot = names[0]
+
+        def client(i):
+            r = np.random.RandomState(100 + i)
+            while time.time() < stop_at:
+                n = int(r.randint(1, max_req + 1))
+                lo = int(r.randint(0, pool.shape[0] - n))
+                name = names[int(r.choice(len(names), p=zipf_p))]
+                status, body = router.handle(
+                    "POST", f"/v1/models/{name}:predict",
+                    {"rows": pool[lo:lo + n].tolist()})
+                if status != 200:
+                    failures.append((name, status, str(body)[:160]))
+                else:
+                    sent[i] += n
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+
+        # --- mid-soak: consolidate the hot tenant onto replica 0, then
+        # migrate it to replica 1 under full zipf load ---
+        migration = {"consolidated": False, "migrated": False}
+        time.sleep(0.2 * duration)
+        t_mv = time.time()
+        migration["consolidated"] = bool(ctl.place(hot, {0}))
+        time.sleep(0.15 * duration)
+        migration["migrated"] = bool(ctl.move(hot, 0, 1))
+        migration["move_s"] = round(time.time() - t_mv, 2)
+        for t in threads:
+            t.join(120)
+        elapsed = time.time() - t0
+
+        soak_compiles = compile_delta(warm_compiles, fleet_compiles())
+        rsnap = router.registry.snapshot()
+        rlat = router.latency.percentiles()
+        rows_s = sum(sent) / max(elapsed, 1e-9)
+        _, table = router.handle("GET", "/v1/fleet/models")
+        hot_row = table["models"].get(hot, {})
+
+        result = {
+            "metric": f"multitenant_{len(names)}models_{n_replicas}"
+                      f"replicas_{n_threads}threads",
+            "value": round(rows_s, 1),
+            "unit": "rows/s",
+            # the stage's claim is the bars, not a speed ratio: a full
+            # tenant catalog on a fixed fleet with zero failed requests
+            # across a live migration and zero post-warmup compiles
+            "vs_baseline": 1.0 if (not failures
+                                   and not any(publish_compiles.values())
+                                   and not any(soak_compiles.values())
+                                   and migration["migrated"]) else 0.0,
+            "models": len(names),
+            "boosters": len(files),
+            "zipf_a": zipf_a,
+            "publish_s": round(publish_s, 1),
+            "publishes_per_s": round((len(names) - 1)
+                                     / max(publish_s, 1e-9), 1),
+            "p50_ms": round(rlat["p50_ms"], 3),
+            "p99_ms": round(rlat["p99_ms"], 3),
+            "requests": int(rsnap["lgbm_fleet_requests_total"]["_"]),
+            "failed_requests": len(failures),
+            "migration": migration,
+            "placement_moves": int(rsnap.get(
+                "lgbm_fleet_placement_moves_total", {}).get("_", 0)),
+            "placement_failed_moves": int(rsnap.get(
+                "lgbm_fleet_placement_failed_moves_total",
+                {}).get("_", 0)),
+            "hot_model": {"name": hot,
+                          "replicas": hot_row.get("replicas"),
+                          "slo": hot_row.get("slo")},
+            # boot pays the ladder once per replica process; the 100
+            # tenant publishes and the whole soak (migration included)
+            # must then compile NOTHING
+            "boot_compiles": {rep: sum(m.values())
+                              for rep, m in boot_compiles.items()},
+            "publish_compiles": publish_compiles,
+            "compiles_after_warmup": soak_compiles,
             "soak_s": round(elapsed, 1),
             "setup_s": round(setup_s, 1),
             "backend": backend,
@@ -2540,6 +2792,8 @@ if __name__ == "__main__":
             run_fleet()
         elif stage == "fleet_gray":
             run_fleet_gray()
+        elif stage == "multitenant":
+            run_multitenant()
         elif stage == "continuous":
             run_continuous()
         elif stage == "continuous_sharded":

@@ -363,6 +363,53 @@ _PARAMS: List[ParamSpec] = [
             "slow-but-alive gray replica is organically drained and — "
             "once its stale evidence ages out — re-admitted for a "
             "probe; off restores pure least-loaded ranking"),
+    # ---- Multi-tenant placement + autoscaling (fleet_placement_*,
+    # fleet_autoscale_*; lightgbm_tpu/fleet/placement/) ----
+    _p("fleet_placement", bool, False, (),
+       desc="run the placement controller: a router-side loop that "
+            "bin-packs models onto replicas by recent goodput (sticky, "
+            "with headroom; hot models spread over two replicas) and "
+            "converges the fleet with token-idempotent per-replica "
+            "publishes, an atomic routing-table flip per move, and a "
+            "drain window — hundreds of models per fleet instead of "
+            "every model on every replica"),
+    _p("fleet_placement_poll_ms", float, 2000.0, (), ">=0",
+       "placement controller loop interval (0 = no loop; drive "
+       "poll_once externally)"),
+    _p("fleet_max_models_per_replica", int, 64, (), ">0",
+       "bin-packing cap: the placement controller assigns at most this "
+       "many models to one replica (overflow falls back to the "
+       "least-loaded replica — availability beats the cap)"),
+    _p("fleet_placement_headroom", float, 0.2, (), ">=0",
+       "fraction of each replica's capacity the packer holds back for "
+       "traffic growth between placement polls"),
+    _p("fleet_placement_capacity_rows_s", float, 50000.0, (), ">0",
+       "estimated goodput capacity of one replica in rows/s — the "
+       "bin-packing denominator and the autoscaler's sizing unit"),
+    _p("fleet_placement_spread_rows_s", float, 0.0, (), ">=0",
+       "goodput above which a model is 'hot' and placed on two "
+       "replicas (0 = auto: half of one replica's usable capacity)"),
+    _p("fleet_placement_drain_ms", float, 500.0, (), ">=0",
+       "drain window of a placement move: after the new replica "
+       "answers its warmup probe, the routing table serves old AND new "
+       "for this long before the old replica is unpublished, so "
+       "in-flight requests finish where they were routed"),
+    _p("fleet_autoscale_min_replicas", int, 1, (), ">0",
+       "autoscaler floor: never retire below this many live replicas"),
+    _p("fleet_autoscale_max_replicas", int, 0, (), ">=0",
+       "autoscaler ceiling; 0 disables autoscaling entirely (the "
+       "launch-time fleet_replicas set is never grown or shrunk)"),
+    _p("fleet_autoscale_miss_ratio", float, 0.05, (), ">=0",
+       "scale up when the fleet's aggregate deadline-miss ratio stays "
+       "above this for fleet_autoscale_polls consecutive polls; scale "
+       "down only while it is below a quarter of this AND one fewer "
+       "replica still fits the load under the placement headroom"),
+    _p("fleet_autoscale_polls", int, 3, (), ">0",
+       "consecutive agreeing autoscaler polls (hysteresis) before any "
+       "scale action"),
+    _p("fleet_autoscale_cooldown_s", float, 30.0, (), ">=0",
+       "minimum wall-clock between autoscale actions, so one burst "
+       "cannot flap the fleet up and down"),
     # ---- Continuous boosting service (task=continuous;
     # lightgbm_tpu/continuous/) ----
     _p("continuous_source", str, "",
@@ -731,6 +778,14 @@ class Config:
             raise ValueError(
                 f"fleet_hedge_quantile={self.fleet_hedge_quantile} must "
                 "be in [0, 1] (a fraction, e.g. 0.95 — not a percent)")
+        if (self.fleet_autoscale_max_replicas > 0
+                and self.fleet_autoscale_max_replicas
+                < self.fleet_autoscale_min_replicas):
+            raise ValueError(
+                f"fleet_autoscale_max_replicas="
+                f"{self.fleet_autoscale_max_replicas} must be >= "
+                f"fleet_autoscale_min_replicas="
+                f"{self.fleet_autoscale_min_replicas}")
         if self.monotone_constraints_method == "advanced":
             # the reference's AdvancedLeafConstraints is not implemented; it
             # silently aliasing the intermediate path was VERDICT weak #7 —

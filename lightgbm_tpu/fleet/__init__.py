@@ -28,6 +28,7 @@ from typing import Optional
 
 from .breaker import CircuitBreaker, LatencyDigest, RetryBudget
 from .chaosnet import ChaosReplica
+from .placement import FleetAutoscaler, PlacementController
 from .router import FleetRouter, HttpReplica, ReplicaTransportError
 from .slo import DOWN, HEALTHY, SHED, ReplicaSLO, SLOPolicy
 from .supervisor import FleetSupervisor, default_replica_argv
@@ -36,6 +37,8 @@ __all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError",
            "SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN",
            "CircuitBreaker", "LatencyDigest", "RetryBudget",
            "ChaosReplica", "FleetSupervisor", "default_replica_argv",
+           "PlacementController", "FleetAutoscaler",
+           "placement_from_config", "autoscaler_from_config",
            "policy_from_config", "serve_fleet", "serve_router"]
 
 
@@ -61,6 +64,31 @@ def _make_router(config, urls, registry=None, supervisor=None) -> FleetRouter:
                        breaker_probes=config.fleet_breaker_probes,
                        latency_routing=bool(config.fleet_latency_routing),
                        default_deadline_ms=config.fleet_deadline_ms)
+
+
+def placement_from_config(config, router) -> PlacementController:
+    return PlacementController(
+        router,
+        max_models_per_replica=config.fleet_max_models_per_replica,
+        headroom=config.fleet_placement_headroom,
+        capacity_rows_s=config.fleet_placement_capacity_rows_s,
+        spread_rows_s=config.fleet_placement_spread_rows_s,
+        drain_ms=config.fleet_placement_drain_ms,
+        poll_ms=config.fleet_placement_poll_ms)
+
+
+def autoscaler_from_config(config, supervisor, router,
+                           controller=None) -> FleetAutoscaler:
+    return FleetAutoscaler(
+        supervisor, router, controller=controller,
+        min_replicas=config.fleet_autoscale_min_replicas,
+        max_replicas=config.fleet_autoscale_max_replicas,
+        miss_ratio_high=config.fleet_autoscale_miss_ratio,
+        capacity_rows_s=config.fleet_placement_capacity_rows_s,
+        headroom=config.fleet_placement_headroom,
+        polls=config.fleet_autoscale_polls,
+        cooldown_s=config.fleet_autoscale_cooldown_s,
+        ready_timeout_s=config.fleet_ready_timeout_s)
 
 
 def serve_router(config, urls: Optional[list] = None) -> None:
@@ -106,14 +134,24 @@ def serve_fleet(raw_params: dict, config) -> None:
         max_restarts=config.fleet_max_restarts,
         restart_backoff_s=config.fleet_restart_backoff_s,
         metrics_registry=registry)
+    controller = autoscaler = None
     try:
         sup.spawn_all()
         sup.wait_ready(timeout_s=config.fleet_ready_timeout_s)
         sup.start_watching()
         router = _make_router(config, sup.urls, registry=registry,
                               supervisor=sup)
+        if config.fleet_placement:
+            controller = placement_from_config(config, router).start()
+        if config.fleet_autoscale_max_replicas > 0:
+            autoscaler = autoscaler_from_config(
+                config, sup, router, controller=controller).start()
         log_info(f"fleet: {n} replicas ready on ports {ports}; router on "
                  f"http://{config.serving_host}:{config.serving_port}")
         serve(router, host=config.serving_host, port=config.serving_port)
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
+        if controller is not None:
+            controller.close()
         sup.stop_all()

@@ -1,0 +1,32 @@
+"""Multi-tenant control plane: placement + autoscaling over the fleet.
+
+The data plane (router + replicas) can host hundreds of models per
+replica because the serving tier's predict programs are shared through
+the tree-bucket ladder (serving/compiled.py): executables are keyed by
+bucketed geometry, never by one model's weights, so publishing model
+number 300 — or a continuation delta of model 3 — costs zero compiles.
+This package is the control plane on top of that substrate:
+
+- ``PlacementController`` (controller.py) — reads the router's
+  per-model SLO gauges and replica capacity, computes a target
+  model->replica assignment (bin-pack by goodput with headroom, spread
+  hot models), and converges the fleet to it with idempotent
+  token-carrying per-replica publishes, an atomic routing-table flip
+  per move, and a drain window so the old replica serves until the new
+  one has proven it can.
+- ``FleetAutoscaler`` (autoscale.py) — grows/shrinks the supervised
+  replica set against aggregate deadline-miss ratio and fleet goodput,
+  with consecutive-poll hysteresis and a cooldown, reusing
+  ``FleetSupervisor``'s slot machinery for spawn/retire.
+
+CLI: ``fleet_placement=true`` wires the controller into ``serve_fleet``;
+``fleet_autoscale_max_replicas>0`` wires the autoscaler (see config.py
+for the full ``fleet_placement_*`` / ``fleet_autoscale_*`` knob table).
+"""
+
+from __future__ import annotations
+
+from .autoscale import FleetAutoscaler
+from .controller import PlacementController
+
+__all__ = ["PlacementController", "FleetAutoscaler"]

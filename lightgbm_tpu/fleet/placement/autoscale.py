@@ -1,0 +1,217 @@
+"""FleetAutoscaler: grow/shrink the supervised replica set under load.
+
+Signals (read per poll from the router's recent-evidence windows — the
+same feed the placement controller uses):
+
+- **aggregate deadline-miss ratio** — the fraction of recent requests
+  across ALL models that ended 504.  Sustained misses mean the fleet
+  cannot meet its deadlines at the current size: scale UP.
+- **fleet goodput vs. capacity** — rows/s answered 200, against the
+  configured per-replica capacity.  When one fewer replica would still
+  carry the load with the placement headroom intact AND nothing is
+  missing deadlines: scale DOWN.
+
+Both directions use consecutive-poll hysteresis (``polls`` agreeing
+polls before any action) and a shared cooldown, so one burst cannot
+flap the fleet.  Scale-up reuses ``FleetSupervisor.add_slot`` (same
+argv, same restart budget), waits for the new replica's /healthz,
+registers it with the router, and replays the fleet's published models
+to it (placement-filtered) so it can serve before the controller ever
+touches it.  Scale-down drains the victim through the placement
+controller first (every placed model moved off), then retires the slot
+on both the router (out of rotation, atomically) and the supervisor
+(process terminated, never respawned).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+from ...log import log_info, log_warning
+from ..router import HttpReplica, ReplicaTransportError
+
+__all__ = ["FleetAutoscaler"]
+
+
+class FleetAutoscaler:
+    def __init__(self, supervisor, router, controller=None,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 miss_ratio_high: float = 0.05,
+                 capacity_rows_s: float = 50_000.0,
+                 headroom: float = 0.2,
+                 polls: int = 3, cooldown_s: float = 30.0,
+                 poll_ms: float = 2000.0,
+                 ready_timeout_s: float = 180.0,
+                 registry=None):
+        self.supervisor = supervisor
+        self.router = router
+        self.controller = controller   # optional: drains before retire
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+        self.miss_ratio_high = float(miss_ratio_high)
+        self.capacity_rows_s = max(float(capacity_rows_s), 1.0)
+        self.headroom = min(max(float(headroom), 0.0), 0.95)
+        self.polls = max(int(polls), 1)
+        self.cooldown_s = max(float(cooldown_s), 0.0)
+        self.poll_interval_s = max(float(poll_ms), 0.0) / 1e3
+        self.ready_timeout_s = float(ready_timeout_s)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown_until = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        reg = registry if registry is not None else router.registry
+        self._m_up = reg.counter(
+            "lgbm_fleet_autoscale_up_total",
+            "replica slots added by the autoscaler")
+        self._m_down = reg.counter(
+            "lgbm_fleet_autoscale_down_total",
+            "replica slots drained and retired by the autoscaler")
+        self._m_failed = reg.counter(
+            "lgbm_fleet_autoscale_failed_total",
+            "autoscale actions that did not complete (spawn never became "
+            "ready, or the drain could not move every placed model)")
+        self._g_replicas = reg.gauge(
+            "lgbm_fleet_replicas",
+            "live (non-retired) replica slots")
+        self._g_replicas.set(len(router.live_indices()))
+
+    # ------------------------------------------------------------------
+    def signals(self) -> Tuple[float, float]:
+        """(aggregate deadline-miss ratio, fleet goodput rows/s) over the
+        router's recent-evidence windows."""
+        miss_num = miss_den = goodput = 0.0
+        for mm in list(self.router._per_model.values()):
+            miss_num += mm.outcomes.window_sum()
+            miss_den += mm.outcomes.window_count()
+            goodput += mm.rows.window_sum() / (mm.rows.window_s or 1.0)
+        return (miss_num / miss_den if miss_den else 0.0), goodput
+
+    def poll_once(self) -> str:
+        """One hysteresis step.  Returns the action taken:
+        'up' / 'down' / 'hold'."""
+        live = self.router.live_indices()
+        self._g_replicas.set(len(live))
+        if time.time() < self._cooldown_until:
+            return "hold"
+        miss, goodput = self.signals()
+        usable = self.capacity_rows_s * (1.0 - self.headroom)
+        want_up = miss > self.miss_ratio_high and len(live) < \
+            self.max_replicas
+        # scale down only when the fleet is comfortably meeting
+        # deadlines AND one fewer replica still fits the load under the
+        # same headroom the packer plans with
+        want_down = (miss <= self.miss_ratio_high / 4.0
+                     and len(live) > self.min_replicas
+                     and goodput < usable * (len(live) - 1))
+        self._up_streak = self._up_streak + 1 if want_up else 0
+        self._down_streak = self._down_streak + 1 if want_down else 0
+        if self._up_streak >= self.polls:
+            self._up_streak = self._down_streak = 0
+            self._cooldown_until = time.time() + self.cooldown_s
+            return "up" if self.scale_up() else "hold"
+        if self._down_streak >= self.polls:
+            self._up_streak = self._down_streak = 0
+            self._cooldown_until = time.time() + self.cooldown_s
+            return "down" if self.scale_down() else "hold"
+        return "hold"
+
+    # ------------------------------------------------------------------
+    def scale_up(self) -> bool:
+        """Spawn one replica slot, wait for /healthz, register it with
+        the router, replay published models to it."""
+        sup = self.supervisor
+        try:
+            slot = sup.add_slot()
+        except Exception as exc:
+            log_warning(f"autoscale: spawn failed: {exc!r}")
+            self._m_failed.inc()
+            return False
+        url = f"{sup.host}:{sup.replicas[slot].port}"
+        ep = HttpReplica(url)
+        deadline = time.time() + self.ready_timeout_s
+        ready = False
+        while time.time() < deadline and not self._stop.is_set():
+            try:
+                status, _ = ep.request("GET", "/healthz", timeout_s=2.0)
+                if status == 200:
+                    ready = True
+                    break
+            except ReplicaTransportError:
+                pass
+            if not sup.replicas[slot].alive and sup.replicas[slot].gave_up:
+                break
+            time.sleep(0.25)
+        if not ready:
+            log_warning(f"autoscale: new replica {url} never became "
+                        f"ready; retiring the slot")
+            sup.retire_slot(slot)
+            self._m_failed.inc()
+            return False
+        router = self.router
+        idx = router.add_replica(ep)
+        # the new replica spawned from the ORIGINAL argv: hot-swaps it
+        # never saw must be replayed (placement-filtered — models placed
+        # on other replicas stay off this one) before it takes traffic
+        # for them; unplaced models route here immediately
+        with router._lock:
+            published = {n: dict(b) for n, b in router._published.items()
+                         if router._placement.get(n) is None}
+        if published:
+            router._replay_publishes(router._replicas[idx], published)
+        self._m_up.inc()
+        self._g_replicas.set(len(router.live_indices()))
+        log_info(f"autoscale: scaled up — replica {url} is slot {idx}")
+        return True
+
+    def scale_down(self) -> bool:
+        """Drain and retire the highest-index live slot."""
+        router = self.router
+        live = router.live_indices()
+        if len(live) <= self.min_replicas:
+            return False
+        victim = max(live)
+        if self.controller is not None:
+            if not self.controller.drain_replica(victim):
+                log_warning(f"autoscale: drain of slot {victim} "
+                            f"incomplete; holding")
+                self._m_failed.inc()
+                return False
+        router.retire_replica(victim)
+        sup = self.supervisor
+        if victim < len(sup.replicas):
+            sup.retire_slot(victim)
+        self._m_down.inc()
+        self._g_replicas.set(len(router.live_indices()))
+        log_info(f"autoscale: scaled down — slot {victim} retired")
+        return True
+
+    # ------------------------------------------------------------------
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is None and self.poll_interval_s > 0:
+            def _loop():
+                while not self._stop.wait(self.poll_interval_s):
+                    try:
+                        self.poll_once()
+                    except Exception as exc:   # control loop never dies
+                        log_warning(f"autoscale: poll failed: {exc!r}")
+
+            self._thread = threading.Thread(
+                target=_loop, name="lgbm-tpu-fleet-autoscale",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetAutoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,338 @@
+"""PlacementController: converge the fleet to a model->replica target.
+
+The router owns the placement TABLE (model -> replica indices, consulted
+per request); this controller owns the placement DECISION and the
+migration PROTOCOL:
+
+- **decide** (``compute_target``): bin-pack models onto live replicas by
+  recent goodput with configurable headroom, cap models per replica, and
+  spread hot models (goodput above the spread threshold) across two
+  replicas.  The packing is sticky — a model keeps its current replicas
+  whenever they still fit — so a stable fleet sees zero moves per poll.
+- **converge** (``place`` / ``poll_once``): for each model whose current
+  set differs from the target, publish it to the missing replicas using
+  the registry's idempotent publish tokens (a move interrupted anywhere
+  re-sends the SAME token on retry, so the destination can never
+  double-apply), verify each destination answers a warmup probe, flip
+  the router's table atomically to the union (old AND new serve), wait
+  out a drain window, flip to the target, and only then unpublish the
+  surplus replicas.  A failed step leaves the table untouched — the next
+  poll retries from wherever the move died.
+
+Every move is a traced span plus
+``lgbm_fleet_placement_{moves,failed_moves}_total``; the controller runs
+on its own daemon thread (``start``), or tests drive ``poll_once``
+directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Set
+
+from ...log import log_info, log_warning
+from ...telemetry import trace as _trace
+from ..router import ReplicaTransportError
+
+__all__ = ["PlacementController"]
+
+
+class PlacementController:
+    def __init__(self, router, max_models_per_replica: int = 64,
+                 headroom: float = 0.2,
+                 capacity_rows_s: float = 50_000.0,
+                 spread_rows_s: float = 0.0,
+                 drain_ms: float = 500.0,
+                 poll_ms: float = 2000.0,
+                 max_moves_per_poll: int = 4,
+                 registry=None, tracer=None):
+        self.router = router
+        self.max_models_per_replica = max(int(max_models_per_replica), 1)
+        self.headroom = min(max(float(headroom), 0.0), 0.95)
+        self.capacity_rows_s = max(float(capacity_rows_s), 1.0)
+        # a model whose goodput exceeds this is "hot" and spread across
+        # two replicas; 0 = auto (half of one replica's usable capacity)
+        usable = self.capacity_rows_s * (1.0 - self.headroom)
+        self.spread_rows_s = (float(spread_rows_s) if spread_rows_s > 0
+                              else usable / 2.0)
+        self.drain_s = max(float(drain_ms), 0.0) / 1e3
+        self.poll_interval_s = max(float(poll_ms), 0.0) / 1e3
+        self.max_moves_per_poll = max(int(max_moves_per_poll), 1)
+        self.tracer = tracer if tracer is not None else _trace.TRACER
+        reg = registry if registry is not None else router.registry
+        self._m_moves = reg.counter(
+            "lgbm_fleet_placement_moves_total",
+            "placement convergence steps that fully landed (publish to "
+            "new replicas, drained table flip, surplus unpublished)")
+        self._m_failed = reg.counter(
+            "lgbm_fleet_placement_failed_moves_total",
+            "placement moves abandoned mid-protocol (routing table left "
+            "untouched; retried with the same publish token next poll)")
+        self._g_placed = reg.gauge(
+            "lgbm_fleet_placement_placed_models",
+            "models with an explicit placement entry (narrowed from the "
+            "broadcast-everywhere default)")
+        # (model, dst_idx) -> publish token: a move that died after its
+        # publish may have landed on the destination — the retry MUST
+        # re-send the same token so the registry replays the version it
+        # already minted instead of installing a duplicate
+        self._move_tokens: Dict[tuple, str] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # decide
+    # ------------------------------------------------------------------
+    def compute_target(self, table: Optional[Dict] = None,
+                       live: Optional[List[int]] = None
+                       ) -> Dict[str, Set[int]]:
+        """Pure assignment pass: {model: target replica indices}.
+
+        Models are packed hottest-first onto the live replicas; each
+        placement charges the replica the model's per-replica goodput
+        share.  Stickiness: a replica already hosting the model wins
+        ties, so the target only differs from the current table when
+        load or topology actually changed."""
+        router = self.router
+        live = sorted(live if live is not None else router.live_indices())
+        if not live:
+            return {}
+        table = table if table is not None else router.model_table()
+        usable = self.capacity_rows_s * (1.0 - self.headroom)
+        load = {i: 0.0 for i in live}
+        count = {i: 0 for i in live}
+
+        def goodput(row):
+            slo = row.get("slo") or {}
+            return float(slo.get("goodput_rows_per_s") or 0.0)
+
+        target: Dict[str, Set[int]] = {}
+        for name, row in sorted(table.items(),
+                                key=lambda kv: -goodput(kv[1])):
+            g = goodput(row)
+            want_n = min(2 if g >= self.spread_rows_s else 1, len(live))
+            cur = router.placement(name) & set(live)
+            share = g / want_n
+
+            def cost(i):
+                # sticky first, then least goodput-loaded, then fewest
+                # models; index last for determinism
+                return (i not in cur, load[i], count[i], i)
+
+            chosen: List[int] = []
+            for i in sorted(live, key=cost):
+                if count[i] >= self.max_models_per_replica:
+                    continue
+                if load[i] + share > usable:
+                    continue
+                chosen.append(i)
+                load[i] += share
+                count[i] += 1
+                if len(chosen) >= want_n:
+                    break
+            if not chosen:
+                # everything is over capacity: availability beats the
+                # packing constraint — place on the least-loaded replica
+                i = min(live, key=lambda j: (load[j], count[j], j))
+                chosen = [i]
+                load[i] += share
+                count[i] += 1
+            target[name] = set(chosen)
+        return target
+
+    # ------------------------------------------------------------------
+    # converge
+    # ------------------------------------------------------------------
+    def _endpoint(self, idx: int):
+        return self.router._replicas[idx].endpoint
+
+    def _publish_to(self, name: str, dst: int, body: dict) -> Optional[int]:
+        """Idempotent targeted publish + warmup probe.  Returns the
+        installed version, or None on failure (token retained for the
+        retry)."""
+        token = self._move_tokens.setdefault((name, dst),
+                                             uuid.uuid4().hex)
+        body = dict(body)
+        body["publish_token"] = token
+        ep = self._endpoint(dst)
+        try:
+            status, payload = ep.request(
+                "POST", f"/v1/models/{name}:publish", body,
+                timeout_s=self.router.request_timeout_s)
+        except ReplicaTransportError as exc:
+            log_warning(f"placement: publish of {name!r} to {ep.name} "
+                        f"failed: {exc}")
+            return None
+        if status != 200:
+            log_warning(f"placement: publish of {name!r} to {ep.name} "
+                        f"refused (status {status})")
+            return None
+        # warmup probe: the destination must ANSWER for the model before
+        # any traffic shifts — publish warms the ladder pre-swap, so the
+        # registry listing doubles as "loaded, warmed, current"
+        try:
+            st, listing = ep.request("GET", "/v1/models", None,
+                                     timeout_s=self.router.health_timeout_s)
+        except ReplicaTransportError:
+            st, listing = 0, {}
+        if st != 200 or name not in (listing.get("models") or {}):
+            log_warning(f"placement: {ep.name} does not list {name!r} "
+                        f"after publish — move aborted")
+            return None
+        return payload.get("version")
+
+    def place(self, name: str, want, drain: bool = True) -> bool:
+        """Converge one model to replica set ``want``: publish where
+        missing (probed), atomically widen the routing table to old+new,
+        drain, narrow to ``want``, then unpublish the surplus.  Returns
+        False (and counts a failed move) the moment any destination
+        cannot be brought up — with the table untouched, so in-flight
+        and future requests keep landing on replicas that have the
+        model."""
+        router = self.router
+        want = {int(i) for i in want}
+        live = set(router.live_indices())
+        want &= live
+        if not want:
+            return False
+        have = router.placement(name) & live
+        if want == have:
+            return True
+        tspan = self.tracer.start_request(
+            "placement.move", model=name, src=sorted(have),
+            dst=sorted(want))
+        try:
+            missing = want - have
+            if missing:
+                body = router.published_body(name)
+                if body is None:
+                    # nothing to replay: the model was never published
+                    # through this router (or was rolled back) — narrowing
+                    # is still legal, widening is not
+                    if tspan is not None:
+                        tspan.event("placement.no_publish_body")
+                    self._m_failed.inc()
+                    return False
+                for dst in sorted(missing):
+                    version = self._publish_to(name, dst, body)
+                    if version is None:
+                        if tspan is not None:
+                            tspan.event("placement.publish_failed",
+                                        replica=self._endpoint(dst).name)
+                        self._m_failed.inc()
+                        return False
+                    if isinstance(version, int):
+                        router.note_version(name, version)
+                    if tspan is not None:
+                        tspan.event("placement.published",
+                                    replica=self._endpoint(dst).name,
+                                    version=version)
+            # both old and new serve during the drain: requests already
+            # routed to the old set finish there, new ones spread
+            router.set_placement(name, want | have)
+            if drain and (have - want) and self.drain_s > 0:
+                if tspan is not None:
+                    tspan.event("placement.drain",
+                                ms=round(self.drain_s * 1e3, 1))
+                time.sleep(self.drain_s)
+            router.set_placement(name, want)
+            for src in sorted(have - want):
+                ep = self._endpoint(src)
+                try:
+                    ep.request("POST", f"/v1/models/{name}:unpublish",
+                               None, timeout_s=router.request_timeout_s)
+                    if tspan is not None:
+                        tspan.event("placement.unpublished",
+                                    replica=ep.name)
+                except ReplicaTransportError as exc:
+                    # non-fatal: the model stays resident but unrouted
+                    # on src; the rejoin replay is placement-filtered so
+                    # it can never come back through that path
+                    log_warning(f"placement: unpublish of {name!r} on "
+                                f"{ep.name} failed: {exc}")
+            for dst in sorted(missing if missing else set()):
+                self._move_tokens.pop((name, dst), None)
+            self._m_moves.inc()
+            log_info(f"placement: {name!r} moved {sorted(have)} -> "
+                     f"{sorted(want)}")
+            return True
+        finally:
+            if tspan is not None:
+                tspan.finish_request(status=200)
+
+    def move(self, name: str, src: int, dst: int) -> bool:
+        """One-model migration convenience: replace ``src`` with ``dst``
+        in the model's replica set (the bench's mid-soak hot-model
+        move)."""
+        have = self.router.placement(name)
+        return self.place(name, (have - {int(src)}) | {int(dst)})
+
+    def drain_replica(self, idx: int) -> bool:
+        """Move every model placed on ``idx`` elsewhere (scale-down
+        preamble).  Models still on the broadcast-everywhere default are
+        untouched — retiring the slot removes it from their route set
+        automatically.  Returns False if any move failed."""
+        idx = int(idx)
+        ok = True
+        live = [i for i in self.router.live_indices() if i != idx]
+        if not live:
+            return False
+        for name, row in self.router.model_table().items():
+            if not row.get("placed"):
+                continue
+            have = self.router.placement(name)
+            if idx not in have:
+                continue
+            want = have - {idx}
+            if not want:
+                want = {min(live, key=lambda j: (
+                    self.router._replicas[j].load_rows, j))}
+            ok = self.place(name, want) and ok
+        return ok
+
+    def poll_once(self) -> int:
+        """One control-loop step: recompute the target and apply up to
+        ``max_moves_per_poll`` convergence moves.  Returns the number of
+        models moved."""
+        target = self.compute_target()
+        with self.router._lock:
+            self._g_placed.set(len(self.router._placement))
+        moved = 0
+        for name, want in target.items():
+            if moved >= self.max_moves_per_poll:
+                break
+            if want != self.router.placement(name):
+                if self.place(name, want):
+                    moved += 1
+        return moved
+
+    # ------------------------------------------------------------------
+    def start(self) -> "PlacementController":
+        if self._thread is None and self.poll_interval_s > 0:
+            def _loop():
+                while not self._stop.wait(self.poll_interval_s):
+                    try:
+                        self.poll_once()
+                    except Exception as exc:   # control loop never dies
+                        log_warning(
+                            f"placement: poll failed: {exc!r}")
+
+            self._thread = threading.Thread(
+                target=_loop, name="lgbm-tpu-fleet-placement",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "PlacementController":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -303,6 +303,11 @@ class _Replica:
         # requests than this high-water mark was genuinely restarted.
         self.boot_s: Optional[float] = None
         self.requests_high = 0
+        # set by retire_replica (autoscale scale-down): permanently out
+        # of rotation — never ranked, never polled, never broadcast to.
+        # The slot stays in the list so every index-parallel structure
+        # (metric lists, placement sets, supervisor slots) stays valid
+        self.retired = False
 
 
 class FleetRouter:
@@ -326,12 +331,14 @@ class FleetRouter:
         if not replicas:
             raise LightGBMError("FleetRouter needs at least one replica")
         policy = policy or SLOPolicy()
+        # kept for add_replica: a scaled-up replica gets the same breaker
+        # tuning as the launch-time set
+        self._breaker_args = dict(failures=breaker_failures,
+                                  cooldown_s=breaker_cooldown_s,
+                                  probes=breaker_probes)
         self._replicas = [
             _Replica(ep, ReplicaSLO(policy),
-                     CircuitBreaker(failures=breaker_failures,
-                                    cooldown_s=breaker_cooldown_s,
-                                    probes=breaker_probes),
-                     LatencyDigest())
+                     CircuitBreaker(**self._breaker_args), LatencyDigest())
             for ep in replicas]
         self.policy = policy
         self.registry = registry or MetricsRegistry()
@@ -366,6 +373,17 @@ class FleetRouter:
         # respawns it from its ORIGINAL argv — without the replay it
         # would rejoin serving the pre-hot-swap model indefinitely
         self._published: Dict[str, dict] = {}
+        # placement table (the multi-tenant control plane's output):
+        # model name -> frozenset of replica indices that host it.  A
+        # model with NO entry is "everywhere" — the broadcast-publish
+        # default — so the table only constrains models the placement
+        # controller has narrowed.  Flipped atomically per move (one
+        # dict store under the lock); _ranked consults it per request
+        self._placement: Dict[str, frozenset] = {}
+        # last fleet-confirmed version per model (broadcast publishes
+        # and controller moves both maintain it) — the version column
+        # of GET /v1/fleet/models
+        self._model_versions: Dict[str, int] = {}
         from concurrent.futures import ThreadPoolExecutor
         # SEPARATE pools for health sweeps and publish broadcasts: a
         # publish occupies a worker for up to request_timeout_s per
@@ -494,10 +512,14 @@ class FleetRouter:
         up to health_timeout_s, and one hung replica must not stretch
         every other replica's detection/recovery hysteresis by its
         timeout."""
-        futures = [self._health_pool.submit(rep.endpoint.health,
-                                            self.health_timeout_s)
-                   for rep in self._replicas]
-        for i, rep in enumerate(self._replicas):
+        reps = list(self._replicas)    # autoscale may append concurrently
+        futures = [None if rep.retired
+                   else self._health_pool.submit(rep.endpoint.health,
+                                                 self.health_timeout_s)
+                   for rep in reps]
+        for i, rep in enumerate(reps):
+            if futures[i] is None:
+                continue
             try:
                 gauges = futures[i].result(self.health_timeout_s + 5.0)
             except Exception:
@@ -519,7 +541,15 @@ class FleetRouter:
                     restarted = requests < rep.requests_high
                 replay = (before == "down" and gauges is not None
                           and bool(self._published) and restarted)
-                published = dict(self._published) if replay else None
+                # placement-filtered: a rejoining replica only gets the
+                # models PLACED on it (or unplaced ones — broadcast
+                # default); replaying a model placed elsewhere would
+                # undo the controller's unpublish on this replica
+                published = ({n: dict(b)
+                              for n, b in self._published.items()
+                              if self._placement.get(n) is None
+                              or i in self._placement[n]}
+                             if replay else None)
                 if gauges is None or restarted:
                     # every pooled keep-alive socket predating a death /
                     # restart is stale; reconnect lazily (publishes are
@@ -665,7 +695,7 @@ class FleetRouter:
                     else min(max(c / best, 1.0), self._LATENCY_WEIGHT_CAP))
                 for i, c in cost.items()}
 
-    def _ranked(self) -> List[int]:
+    def _ranked(self, model: Optional[str] = None) -> List[int]:
         """Routable replica indices, cheapest first (round-robin among
         equals so idle replicas share traffic).  Cost is the replica's
         last-polled queue+in-flight rows PLUS rows this router has
@@ -674,14 +704,24 @@ class FleetRouter:
         continuous latency weight, so a slow-but-alive replica needs to
         be proportionally idler before it wins a request.  Replicas whose
         circuit breaker is open (and not yet due a half-open probe) are
-        excluded outright."""
+        excluded outright, as are retired (scaled-down) slots.
+
+        With ``model``, candidates are further gated by the placement
+        table: a placed model routes ONLY to its assigned replicas (the
+        others unpublished it — forwarding there would 404, a verdict
+        the retry loop treats as final).  A model without a placement
+        entry routes fleet-wide, the broadcast-publish default."""
         self._maybe_poll_inline()
         with self._lock:
             self._rr += 1
+            placed = (self._placement.get(model)
+                      if model is not None else None)
             candidates = [(i, rep.load_rows + rep.router_inflight_rows,
                            rep.breaker.wants_probe())
                           for i, rep in enumerate(self._replicas)
-                          if rep.slo.routable and rep.breaker.admits()]
+                          if not rep.retired and rep.slo.routable
+                          and rep.breaker.admits()
+                          and (placed is None or i in placed)]
         weights = self._latency_weights([i for i, _, _ in candidates])
         # probe priority: a half-open replica with free probe slots must
         # actually RECEIVE a request to prove itself, and a slow/drained
@@ -934,7 +974,7 @@ class FleetRouter:
             # saturated hedge pool makes queued primaries "outlive" any
             # delay, and duplicating load precisely when the system is
             # saturated would amplify the overload, not relieve it
-            alt = next((i for i in self._ranked() if i not in tried),
+            alt = next((i for i in self._ranked(name) if i not in tried),
                        None)
         if alt is not None:
             alt_p50 = self._replicas[alt].digest.quantile(0.5)
@@ -1096,7 +1136,7 @@ class FleetRouter:
                           t0: float, mm: _ModelStats,
                           tspan) -> Tuple[int, dict]:
         attempts = 0
-        candidates = self._ranked()
+        candidates = self._ranked(name)
         tried: set = set()
         race_retried: set = set()
         last_err: Optional[str] = None
@@ -1200,7 +1240,7 @@ class FleetRouter:
                     tspan.mark("rerouted")
                     tspan.event("router.reroute", attempt=attempts,
                                 last_error=last_err)
-            candidates = [i for i in self._ranked() if i not in tried]
+            candidates = [i for i in self._ranked(name) if i not in tried]
         if last_err is None:
             # nothing was routable to begin with: SLO shedding
             self._m_shed.inc()
@@ -1241,6 +1281,9 @@ class FleetRouter:
             body = dict(body or {})
             if not body.get("publish_token"):
                 body["publish_token"] = uuid.uuid4().hex
+        # retired (scaled-down) slots take no publishes: their processes
+        # are gone, and counting them unreachable would be noise
+        reps = [rep for rep in self._replicas if not rep.retired]
 
         def _one(rep):
             try:
@@ -1268,9 +1311,9 @@ class FleetRouter:
         # of leaking one fresh socket per replica per publish (and it is
         # NOT the health pool — see __init__ on starvation)
         futures = [self._bcast_pool.submit(_one, rep)
-                   for rep in self._replicas]
+                   for rep in reps]
         results: Dict[str, Dict] = {}
-        for rep, fut in zip(self._replicas, futures):
+        for rep, fut in zip(reps, futures):
             try:
                 results[rep.endpoint.name] = fut.result(
                     self.request_timeout_s + 5.0)
@@ -1289,7 +1332,7 @@ class FleetRouter:
             # applies now), so one resolution round turns most UNKNOWNs
             # into a definite success/refusal; a replica that times out
             # AGAIN stays -1 and fails the broadcast as before.
-            unknown = [rep for rep in self._replicas
+            unknown = [rep for rep in reps
                        if results[rep.endpoint.name]["status"] == -1]
             if unknown:
                 log_warning(
@@ -1338,11 +1381,11 @@ class FleetRouter:
             self._m_publish_partial.inc()
             self.tracer.maybe_dump("publish_partial")
             base_path = path[:path.rfind(":")]
-            to_undo = [rep for rep in self._replicas
+            to_undo = [rep for rep in reps
                        if results[rep.endpoint.name]["status"] == 200]
             log_warning(
                 f"fleet: partial publish of {name!r} ({ok}/"
-                f"{len(self._replicas)} replicas) — rolling back the "
+                f"{len(reps)} replicas) — rolling back the "
                 f"{len(to_undo)} that succeeded")
 
             def _undo(rep):
@@ -1385,13 +1428,156 @@ class FleetRouter:
             # replica would resurrect the withdrawn version on one
             # replica only
             if verb == "publish":
+                versions = [r.get("version") for r in results.values()
+                            if r["status"] == 200
+                            and isinstance(r.get("version"), int)]
                 with self._lock:
                     self._published[name] = dict(body)
+                    if versions:
+                        self._model_versions[name] = max(versions)
             elif verb == "rollback":
                 with self._lock:
                     self._published.pop(name, None)
+                    versions = [r.get("version") for r in results.values()
+                                if r["status"] == 200
+                                and isinstance(r.get("version"), int)]
+                    if versions:
+                        self._model_versions[name] = max(versions)
         return (200 if all_ok else 502), {"replicas": results,
                                           "succeeded": ok}
+
+    # ------------------------------------------------------------------
+    # Placement + scale API (consumed by fleet/placement/): the router
+    # owns the model->replica table; the controller computes it and the
+    # autoscaler grows/shrinks the replica set under it.
+    # ------------------------------------------------------------------
+    def live_indices(self) -> List[int]:
+        """Non-retired replica slots (routable or not)."""
+        with self._lock:
+            return [i for i, rep in enumerate(self._replicas)
+                    if not rep.retired]
+
+    def placement(self, name: str) -> set:
+        """Replica indices currently hosting ``name``: the table entry,
+        or every live slot for an unplaced (broadcast-published) model."""
+        with self._lock:
+            placed = self._placement.get(name)
+            if placed is not None:
+                return set(placed)
+            return {i for i, rep in enumerate(self._replicas)
+                    if not rep.retired}
+
+    def set_placement(self, name: str, indices) -> None:
+        """Atomically flip ``name``'s model->replica table entry (one
+        dict store under the lock — requests in flight either see the
+        old set or the new one, never a partial).  ``None`` clears the
+        entry, restoring fleet-wide routing."""
+        with self._lock:
+            if indices is None:
+                self._placement.pop(name, None)
+            else:
+                self._placement[name] = frozenset(int(i) for i in indices)
+
+    def note_version(self, name: str, version: int) -> None:
+        """Record a fleet-confirmed version (controller moves maintain
+        the same column broadcast publishes do)."""
+        with self._lock:
+            self._model_versions[name] = max(
+                int(version), self._model_versions.get(name, 0))
+
+    def published_body(self, name: str) -> Optional[dict]:
+        """The last fleet-confirmed publish body for ``name`` — what a
+        targeted (per-replica) re-publish must send so the destination
+        installs the same model the fleet serves."""
+        with self._lock:
+            body = self._published.get(name)
+            return dict(body) if body is not None else None
+
+    def add_replica(self, endpoint) -> int:
+        """Register a scaled-up replica slot and return its index.  Every
+        index-parallel structure (per-replica metric lists, SLO/breaker
+        records) grows together under the lock; the new slot starts
+        optimistically routable, same as launch-time replicas."""
+        reg = self.registry
+        with self._lock:
+            idx = len(self._replicas)
+            rep = _Replica(endpoint, ReplicaSLO(self.policy),
+                           CircuitBreaker(**self._breaker_args),
+                           LatencyDigest())
+            self._replicas.append(rep)
+            self._m_forwarded.append(reg.counter(
+                "lgbm_fleet_forwarded_total", "predicts forwarded",
+                replica=endpoint.name))
+            self._m_up.append(reg.gauge(
+                "lgbm_fleet_replica_up",
+                "1 routable / 0 shed or down", replica=endpoint.name))
+            self._m_load.append(reg.gauge(
+                "lgbm_fleet_replica_load_rows",
+                "queued+in-flight rows at last poll",
+                replica=endpoint.name))
+            self._m_p99.append(reg.gauge(
+                "lgbm_fleet_replica_p99_ms", "replica p99 at last poll",
+                replica=endpoint.name))
+            self._m_fill.append(reg.gauge(
+                "lgbm_fleet_replica_batch_fill",
+                "replica in-flight batch fill at last poll",
+                replica=endpoint.name))
+            self._m_breaker.append(reg.gauge(
+                "lgbm_fleet_replica_breaker_state",
+                "data-path circuit breaker: 0 closed / 1 half-open / 2 "
+                "open", replica=endpoint.name))
+            self._m_up[idx].set(1)
+            return idx
+
+    def retire_replica(self, idx: int) -> None:
+        """Take slot ``idx`` permanently out of rotation (scale-down).
+        The slot is flagged, not removed — indices stay stable — and it
+        is stripped from every placement entry so placement() snapshots
+        stay truthful.  The caller is responsible for having moved the
+        slot's placed models elsewhere first (drain-before-retire)."""
+        with self._lock:
+            rep = self._replicas[idx]
+            rep.retired = True
+            self._m_up[idx].set(0)
+            for name, placed in list(self._placement.items()):
+                if idx in placed:
+                    self._placement[name] = placed - {idx}
+        log_info(f"fleet: replica {rep.endpoint.name} retired "
+                 f"(scale-down)")
+
+    def model_table(self) -> Dict[str, Dict]:
+        """GET /v1/fleet/models: per-model placement row — replica set,
+        fleet-confirmed version, and the SLO gauge snapshot the placement
+        controller feeds on."""
+        with self._lock:
+            names = (set(self._published) | set(self._placement)
+                     | set(self._model_versions)
+                     | (set(self._per_model) - {"_other"}))
+            out: Dict[str, Dict] = {}
+            for name in sorted(names):
+                placed = self._placement.get(name)
+                idxs = (sorted(placed) if placed is not None
+                        else [i for i, rep in enumerate(self._replicas)
+                              if not rep.retired])
+                mm = self._per_model.get(name)
+                row = {
+                    "replicas": [self._replicas[i].endpoint.name
+                                 for i in idxs],
+                    "placed": placed is not None,
+                    "version": self._model_versions.get(name),
+                }
+                if mm is not None:
+                    n = mm.outcomes.window_count()
+                    row["slo"] = {
+                        "p99_ms": mm.window.percentiles()["p99_ms"],
+                        "deadline_miss_ratio": (
+                            mm.outcomes.window_sum() / n if n else 0.0),
+                        "goodput_rows_per_s": (
+                            mm.rows.window_sum()
+                            / (mm.rows.window_s or 1.0)),
+                    }
+                out[name] = row
+            return out
 
     # ------------------------------------------------------------------
     def _trace_detail(self, trace_id: str) -> Tuple[int, dict]:
@@ -1450,7 +1636,7 @@ class FleetRouter:
             for i, rep in enumerate(self._replicas):
                 p50 = rep.digest.quantile(0.5)
                 entry = {
-                    "state": rep.slo.state,
+                    "state": "retired" if rep.retired else rep.slo.state,
                     "load_rows": rep.load_rows,
                     "reasons": list(rep.slo.last_reasons),
                     "transitions": rep.slo.transitions,
@@ -1500,6 +1686,8 @@ class FleetRouter:
                          "replicas": states}
         if method == "GET" and path == "/v1/fleet/replicas":
             return 200, {"replicas": self.replica_states()}
+        if method == "GET" and path == "/v1/fleet/models":
+            return 200, {"models": self.model_table()}
         if method == "GET" and path == "/v1/metrics":
             self.refresh_model_gauges()
             out = {"router": self.registry.snapshot(),

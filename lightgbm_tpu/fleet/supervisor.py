@@ -232,6 +232,38 @@ class FleetSupervisor:
         return self
 
     # ------------------------------------------------------------------
+    def add_slot(self, port: Optional[int] = None) -> int:
+        """Scale-up: append one replica slot, spawn its process, return
+        the new index.  The slot gets the full restart budget and the
+        same make_argv; no fault env (scale-up is not a chaos event).
+        The caller (fleet/placement/autoscale.py) waits for /healthz and
+        registers the endpoint with the router."""
+        if port is None:
+            from ..cluster import find_open_ports
+            port = find_open_ports(1, host=self.host)[0]
+        rep = ReplicaProc(len(self.replicas), int(port))
+        # append BEFORE spawn: watch() iterates self.replicas, and a
+        # spawned-but-untracked process would leak if spawn raced a stop
+        self.replicas.append(rep)
+        self._spawn(rep)
+        return rep.idx
+
+    def retire_slot(self, idx: int) -> None:
+        """Scale-down: kill slot ``idx`` and mark it given-up so watch()
+        never respawns it.  The slot object stays (indices are shared
+        with the router's replica list)."""
+        rep = self.replicas[idx]
+        rep.gave_up = True            # watch() skips given-up slots
+        if rep.alive:
+            rep.proc.terminate()
+            try:
+                rep.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait()
+        log_info(f"fleet: replica slot {idx} (port {rep.port}) retired")
+
+    # ------------------------------------------------------------------
     def kill(self, idx: int) -> None:
         """SIGKILL one replica (chaos switch for tests/benches that want
         an external kill instead of env-driven fault injection)."""

@@ -12,7 +12,7 @@ semantics to the reference's FindInBitset (tree.h:52).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +21,8 @@ import numpy as np
 __all__ = ["StackedTrees", "stack_trees", "predict_trees",
            "predict_leaf_indices", "row_bucket", "pad_rows",
            "pad_rows_to_bucket", "predict_trees_padded",
-           "DEFAULT_BUCKET_LADDER"]
+           "tree_bucket", "pad_stacked_trees",
+           "DEFAULT_BUCKET_LADDER", "DEFAULT_TREE_BUCKET_LADDER"]
 
 _K_ZERO = 1e-35
 
@@ -44,6 +45,89 @@ def row_bucket(n: int, ladder=None) -> int:
             return int(b)
     bucket = 1 << (n - 1).bit_length()
     return int(bucket)
+
+
+# Power-of-two TREE buckets (in iterations, not raw trees): the stacked
+# tree axis is padded up to the next rung with single-leaf null trees
+# whose only leaf value is 0.0, so a padded tree contributes an exact
+# +0.0 to every row's sum and the padded program is bit-identical to the
+# exact-shape one.  This is what turns the predict executable cache into
+# a LADDER shared across models: a continuation publish that grows the
+# model within its rung — or any other model landing on the same rung —
+# reuses the already-compiled program with zero compiles.
+DEFAULT_TREE_BUCKET_LADDER = (8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                              4096)
+
+
+def tree_bucket(n: int, ladder=None) -> int:
+    """Smallest tree bucket >= n (power-of-two rungs, doubling past the
+    ladder's top rung, same shape contract as ``row_bucket``)."""
+    n = max(int(n), 1)
+    for b in (ladder or DEFAULT_TREE_BUCKET_LADDER):
+        if n <= b:
+            return int(b)
+    return int(1 << (n - 1).bit_length())
+
+
+def pad_stacked_trees(stacked: "StackedTrees", tree_count: int,
+                      node_count: Optional[int] = None,
+                      cat_count: Optional[int] = None,
+                      word_count: Optional[int] = None,
+                      max_depth: Optional[int] = None) -> "StackedTrees":
+    """Pad a StackedTrees pack out to a bucketed geometry.
+
+    - the TREE axis grows to ``tree_count`` with single-leaf null trees
+      (``root = ~0``, all leaf values 0.0): traversal resolves them to
+      leaf 0 immediately, so each contributes an exact +0.0 to the sum
+      and the padded predictions are byte-equal to the exact-shape ones;
+    - the NODE axis (and the categorical boundary/bitset widths) grows
+      with zero columns real trees never index;
+    - ``max_depth`` may be raised: extra traversal steps on a resolved
+      leaf are no-ops (``internal`` is already False).
+
+    Bucketing every axis is what lets DIFFERENT models share one
+    compiled program: the executable is keyed by array shapes, and two
+    models whose geometry rounds to the same buckets hand the same
+    shapes to the same program."""
+    t = int(stacked.root.shape[0])
+    m = int(stacked.left_child.shape[1])
+    cw = int(stacked.cat_boundaries.shape[1])
+    ww = int(stacked.cat_threshold.shape[1])
+    tree_count = int(tree_count)
+    node_count = m if node_count is None else int(node_count)
+    cat_count = cw if cat_count is None else int(cat_count)
+    word_count = ww if word_count is None else int(word_count)
+    depth = stacked.max_depth if max_depth is None else int(max_depth)
+    if tree_count < t or node_count < m or cat_count < cw or word_count < ww:
+        raise ValueError(
+            f"pad_stacked_trees cannot shrink: trees {t}->{tree_count}, "
+            f"nodes {m}->{node_count}, cat {cw}->{cat_count}, "
+            f"words {ww}->{word_count}")
+    if depth < stacked.max_depth:
+        raise ValueError(f"pad_stacked_trees cannot lower max_depth "
+                         f"({stacked.max_depth}->{depth})")
+    if (tree_count == t and node_count == m and cat_count == cw
+            and word_count == ww and depth == stacked.max_depth):
+        return stacked
+
+    def grow(a, rows, cols):
+        out = np.zeros((rows, cols), np.asarray(a).dtype)
+        out[:t, :a.shape[1]] = np.asarray(a)
+        return jnp.asarray(out)
+
+    root = np.full(tree_count, ~0, np.int32)
+    root[:t] = np.asarray(stacked.root)
+    return StackedTrees(
+        grow(stacked.left_child, tree_count, node_count),
+        grow(stacked.right_child, tree_count, node_count),
+        grow(stacked.split_feature, tree_count, node_count),
+        grow(stacked.threshold, tree_count, node_count),
+        grow(stacked.decision_type, tree_count, node_count),
+        grow(stacked.leaf_value, tree_count, node_count + 1),
+        jnp.asarray(root),
+        grow(stacked.cat_boundaries, tree_count, cat_count),
+        grow(stacked.cat_threshold, tree_count, word_count),
+        depth)
 
 
 def pad_rows(X: np.ndarray, bucket: int) -> np.ndarray:
@@ -75,10 +159,25 @@ class StackedTrees(NamedTuple):
     max_depth: int
 
 
-def stack_trees(trees, dtype=jnp.float32) -> StackedTrees:
-    """Pack a list of tree.Tree into padded device arrays."""
-    t = len(trees)
-    m = max(max(tr.num_leaves - 1 for tr in trees), 1)
+def stack_trees(trees, dtype=jnp.float32, tree_count: Optional[int] = None,
+                node_count: Optional[int] = None,
+                min_depth: int = 0) -> StackedTrees:
+    """Pack a list of tree.Tree into padded device arrays.
+
+    ``tree_count``/``node_count`` pad the tree and node axes out to a
+    bucketed geometry at packing time (see ``tree_bucket`` /
+    ``pad_stacked_trees``): padded trees are single-leaf nulls
+    (``root = ~0``, leaf value 0.0) contributing an exact +0.0, padded
+    node columns are never indexed.  ``min_depth`` floors the traversal
+    depth so models whose trees happen to be shallower still share the
+    bucketed program."""
+    nt = len(trees)
+    nm = max(max(tr.num_leaves - 1 for tr in trees), 1)
+    t = nt if tree_count is None else int(tree_count)
+    m = nm if node_count is None else int(node_count)
+    if t < nt or m < nm:
+        raise ValueError(f"stack_trees cannot shrink: trees {nt}->{t}, "
+                         f"nodes {nm}->{m}")
     num_cat = max(max(tr.num_cat for tr in trees), 0)
     n_words = max(max(len(tr.cat_threshold) for tr in trees), 1)
     lc = np.zeros((t, m), np.int32)
@@ -87,10 +186,11 @@ def stack_trees(trees, dtype=jnp.float32) -> StackedTrees:
     th = np.zeros((t, m), np.float64)
     dt = np.zeros((t, m), np.int32)
     lv = np.zeros((t, m + 1), np.float64)
-    root = np.zeros(t, np.int32)
+    # padded slots (past len(trees)) are single-leaf null trees
+    root = np.full(t, ~0, np.int32)
     cb = np.zeros((t, num_cat + 2), np.int32)
     ct = np.zeros((t, n_words), np.uint32)
-    depth = 1
+    depth = max(1, int(min_depth))
     for i, tr in enumerate(trees):
         ni = tr.num_leaves - 1
         lc[i, :ni] = tr.left_child[:ni]

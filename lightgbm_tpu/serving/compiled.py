@@ -14,16 +14,24 @@ This module freezes the model once and compiles on a grid:
 - incoming batches are zero-padded up to a power-of-two row bucket
   (``ops.predict.row_bucket``), so the space of input shapes is a small
   ladder rather than the naturals;
-- executables are AOT-compiled (``jax.jit(...).lower(...).compile()``) and
-  cached under the key ``(batch_bucket, num_features, dtype,
-  start_iteration, num_iteration, output_kind)``;
-- ``compile_count`` increments only when a key misses, which is what the
-  zero-recompile-after-warmup tests assert on.
+- the TREE axis is padded the same way (``ops.predict.tree_bucket``):
+  the iteration range in use is sliced out and padded up to a
+  power-of-two tree bucket with single-leaf null trees contributing an
+  exact +0.0, so the executable is keyed by **(row bucket, tree bucket,
+  features, dtype, output kind)** — never by a model's exact tree count;
+- executables are AOT-compiled (``jax.jit(...).lower(...).compile()``),
+  take the padded trees and the live iteration count as ARGUMENTS, and
+  live in a PROCESS-GLOBAL program cache shared by every predictor:
+  a published continuation model (same buckets, more trees) — or the
+  200th model hosted on the same replica — warms with ZERO compiles;
+- ``compile_count`` increments only when a program is genuinely built,
+  which is what the zero-recompile-after-warmup tests assert on.
 
 Tree traversal is row-independent (each row's leaf sum never reads another
 row), so bucket padding cannot change the first-n results — the serving
 path returns the same numbers whether a row arrived alone or coalesced
-into a 4096-row batch.
+into a 4096-row batch, and whether the tree axis carries 60 real trees or
+60 real + 68 null ones.
 """
 
 from __future__ import annotations
@@ -39,11 +47,46 @@ import numpy as np
 
 from ..log import LightGBMError
 from ..objectives import output_transform
-from ..ops.predict import (DEFAULT_BUCKET_LADDER, StackedTrees, pad_rows,
-                           predict_trees, row_bucket)
+from ..ops.predict import (DEFAULT_BUCKET_LADDER, DEFAULT_TREE_BUCKET_LADDER,
+                           StackedTrees, pad_rows, pad_stacked_trees,
+                           predict_trees, row_bucket, tree_bucket)
 from ..timer import timed
 
-__all__ = ["CompiledPredictor"]
+__all__ = ["CompiledPredictor", "clear_shared_programs",
+           "shared_program_count"]
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    """Next power of two >= n, floored — the bucketing rule for the
+    secondary geometry axes (nodes, depth, categorical widths) that must
+    also be shape-stable for two models to share one program."""
+    n = max(int(n), 1)
+    return max(int(floor), 1 << (n - 1).bit_length())
+
+
+# Process-global program cache.  Predict programs take the (padded)
+# StackedTrees and the live iteration count as ARGUMENTS, so an
+# executable is tied to bucketed geometry + output semantics — never to
+# one model's weights.  Keyed by the full shared geometry (row bucket,
+# tree bucket, node/depth/cat buckets, features, dtypes, output kind,
+# num_class, objective, average flag), it is what hundreds of models on
+# one replica share: after the first model warms a rung, every later
+# publish that lands on the same rung compiles nothing.
+_SHARED_LOCK = threading.Lock()
+_SHARED_PROGRAMS: "OrderedDict[tuple, object]" = OrderedDict()
+_SHARED_MAX_PROGRAMS = 4096
+
+
+def clear_shared_programs() -> None:
+    """Drop the process-global program cache (tests; never needed in
+    production — the cache is LRU-bounded)."""
+    with _SHARED_LOCK:
+        _SHARED_PROGRAMS.clear()
+
+
+def shared_program_count() -> int:
+    with _SHARED_LOCK:
+        return len(_SHARED_PROGRAMS)
 
 
 class CompiledPredictor:
@@ -56,8 +99,15 @@ class CompiledPredictor:
     """
 
     def __init__(self, booster, buckets=None, dtype=None,
-                 metrics=None, max_programs: int = 256):
+                 metrics=None, max_programs: int = 256,
+                 tree_buckets=None):
         self.buckets: Tuple[int, ...] = tuple(buckets or DEFAULT_BUCKET_LADDER)
+        # tree_buckets=() disables tree-axis padding (exact shapes) — the
+        # reference arm of the bit-identity tests, and an escape hatch
+        # for callers that want one range compiled tight
+        self.tree_buckets: Tuple[int, ...] = (
+            DEFAULT_TREE_BUCKET_LADDER if tree_buckets is None
+            else tuple(tree_buckets))
         self.dtype = np.dtype(dtype or np.float32)
         self.metrics = metrics
         self._lock = threading.Lock()
@@ -97,9 +147,35 @@ class CompiledPredictor:
                 "use Booster.predict for linear-leaf inference")
         n_trees = len(trees)
         self.n_iterations = n_trees // max(self.num_class, 1)
-        # one stacking for the whole model; per-range programs slice the
-        # packed arrays statically inside jit (no re-pack per range)
+        # one stacking for the whole model; per-range programs receive a
+        # sliced-and-bucket-padded view of the packed arrays (see
+        # _padded_range — the padding happens OUTSIDE the program, so the
+        # program itself is range-agnostic)
         self._stacked: Optional[StackedTrees] = booster.stacked_trees(0, -1)
+        # per-range padded sub-stacks, LRU-bounded like the booster's own
+        # stacked cache (serving traffic uses one or two ranges)
+        self._subs: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._subs_cap = 8
+        # secondary geometry buckets: every axis an executable's shape
+        # depends on is rounded up, so models whose exact geometry
+        # differs within a rung still share programs
+        if self._stacked is not None and self.tree_buckets:
+            st = self._stacked
+            self._node_bucket = _pow2(int(st.left_child.shape[1]), floor=8)
+            self._cat_bucket = _pow2(int(st.cat_boundaries.shape[1]),
+                                     floor=2)
+            self._word_bucket = _pow2(int(st.cat_threshold.shape[1]),
+                                      floor=1)
+            # traversal depth is a STATIC loop bound, so it must bucket
+            # too.  Floor 8 (extra steps on a resolved leaf are no-ops):
+            # any model whose trees are at most 8 deep shares a rung no
+            # matter what depth its data happened to grow, which is what
+            # makes same-config small models share deterministically.
+            # Capped at the node bucket — depth can never exceed the
+            # node count, so the cap costs nothing and keeps a degenerate
+            # deep tree from padding the loop past its own node axis.
+            self._depth_bucket = min(self._node_bucket,
+                                     _pow2(int(st.max_depth), floor=8))
 
     # ------------------------------------------------------------------
     def is_stale(self) -> bool:
@@ -130,30 +206,113 @@ class CompiledPredictor:
         return start_iteration, max(end, start_iteration)
 
     # ------------------------------------------------------------------
-    def _build(self, key):
-        bucket, nfeat, dtype_str, s, e, kind = key
-        k = self.num_class
+    def _tree_bucket_for(self, s: int, e: int) -> int:
+        """Tree bucket (in iterations) for a range; exact count when the
+        tree ladder is disabled."""
+        n = max(int(e) - int(s), 1)
+        if not self.tree_buckets:
+            return n
+        return tree_bucket(n, self.tree_buckets)
+
+    def _cache_key(self, bucket: int, s: int, e: int, kind: str) -> tuple:
+        """The executable cache key.  It ALWAYS carries the tree bucket
+        (index 1 — a static guard in tests/test_fleet_gray.py enforces
+        this): the bucket, not the exact tree count, is what names the
+        program, so every range/model on the same rung shares one."""
+        return (int(bucket), self._tree_bucket_for(s, e), self.num_feature,
+                str(self.dtype), int(s), int(e), kind)
+
+    def _padded_range(self, s: int, e: int):
+        """(padded sub-stack, live iteration count, tree bucket) for a
+        range: the model's [s, e) trees sliced from the full pack and
+        padded out to the bucketed geometry with exact-zero null trees.
+        Cached per range — the padding is a one-time host-side cost per
+        (model, range), never a per-request one."""
+        keyr = (int(s), int(e))
+        with self._lock:
+            hit = self._subs.get(keyr)
+            if hit is not None:
+                self._subs.move_to_end(keyr)
+                return hit
+        k = max(self.num_class, 1)
         lo, hi = s * k, e * k
-        n_used = e - s
+        st = self._stacked
+        sub = StackedTrees(*[a[lo:hi] for a in st[:9]], st.max_depth)
+        n_used = max(int(e) - int(s), 1)
+        tb = self._tree_bucket_for(s, e)
+        if self.tree_buckets:
+            sub = pad_stacked_trees(
+                sub, tree_count=tb * k, node_count=self._node_bucket,
+                cat_count=self._cat_bucket, word_count=self._word_bucket,
+                max_depth=self._depth_bucket)
+        hit = (sub, n_used, tb)
+        with self._lock:
+            cur = self._subs.get(keyr)
+            if cur is not None:
+                return cur
+            self._subs[keyr] = hit
+            while len(self._subs) > self._subs_cap:
+                self._subs.popitem(last=False)
+        return hit
+
+    def _shared_key(self, key: tuple) -> tuple:
+        """Identity of a program in the process-global cache: everything
+        the compiled artifact depends on EXCEPT one model's weights and
+        exact iteration range — argument shapes/dtypes (bucketed), the
+        static traversal depth, and the output semantics."""
+        bucket, tb, nfeat, dtype_str, s, e, kind = key
+        padded, _, _ = self._padded_range(s, e)
+        geo = tuple((tuple(map(int, a.shape)), str(a.dtype))
+                    for a in padded[:9])
+        return (int(bucket), int(tb), int(nfeat), dtype_str, kind,
+                int(self.num_class), self._objective,
+                bool(self._average_output), int(padded.max_depth), geo)
+
+    # ------------------------------------------------------------------
+    def _predict_fn(self, key):
+        """The traceable predict program for ``key`` plus its example
+        arguments, exactly as ``_build`` lowers them.  Exposed (rather
+        than inlined in _build) so the jaxpr-consts guard in
+        tests/test_placement.py can trace the REAL production program
+        and assert no array rides it as an HLO constant."""
+        bucket, tb, nfeat, dtype_str, s, e, kind = key
+        padded, _, _ = self._padded_range(s, e)
+        k = self.num_class
+        n_rows = int(padded.root.shape[0])
+        iters = n_rows // max(k, 1)
         # raw is [N] single-class / [K, N] multiclass -> class_axis=0
         transform = output_transform(self._objective, xp=jnp, class_axis=0)
         average = self._average_output
 
-        def fn(st: StackedTrees, X):
-            sub = StackedTrees(*[a[lo:hi] for a in st[:9]], st.max_depth)
+        def fn(st: StackedTrees, n_live, X):
+            # st already carries the range: sliced + bucket-padded with
+            # null trees outside the program, so the executable never
+            # bakes a model's tree count or range offsets.  n_live (the
+            # REAL iteration count) is a runtime scalar: the null trees
+            # contribute exact zeros to the sums, but an average_output
+            # model must divide by the live count, not the bucket.
             if k == 1:
-                raw = predict_trees(sub, X, output="sum")          # [N]
+                raw = predict_trees(st, X, output="sum")           # [N]
             else:
-                per_tree = predict_trees(sub, X, output="per_tree")
-                raw = per_tree.reshape(n_used, k, -1).sum(axis=0)  # [K, N]
+                per_tree = predict_trees(st, X, output="per_tree")
+                # per-class regrouping stays aligned under padding: null
+                # trees are appended in whole per-class groups (bucket is
+                # in iterations), so row i*k + c is iteration i of class
+                # c for live iterations and an all-zero row past them
+                raw = per_tree.reshape(iters, k, -1).sum(axis=0)   # [K, N]
             if average:
-                raw = raw / n_used
+                raw = raw / n_live
             if kind == "prob":
                 raw = transform(raw)
             return raw
 
         x_spec = jax.ShapeDtypeStruct((bucket, nfeat), np.dtype(dtype_str))
-        return jax.jit(fn).lower(self._stacked, x_spec).compile()
+        n_spec = jax.ShapeDtypeStruct((), np.float32)
+        return fn, (padded, n_spec, x_spec)
+
+    def _build(self, key):
+        fn, args = self._predict_fn(key)
+        return jax.jit(fn).lower(*args).compile()
 
     def _get_compiled(self, key):
         with self._lock:
@@ -161,42 +320,61 @@ class CompiledPredictor:
             if fn is not None:
                 self._cache.move_to_end(key)  # LRU touch
                 return fn
-        # build OUTSIDE the lock: an XLA compile can take seconds and must
-        # not stall concurrent cache-hit traffic; a rare duplicate build on
-        # a concurrent first hit of the same key is harmless (one wins, and
-        # compile_count counts only the inserted one)
-        with timed("serving::compile"):
-            fn = self._build(key)
+        skey = self._shared_key(key)
+        with _SHARED_LOCK:
+            fn = _SHARED_PROGRAMS.get(skey)
+            if fn is not None:
+                _SHARED_PROGRAMS.move_to_end(skey)
+        built = False
+        if fn is None:
+            # build OUTSIDE the locks: an XLA compile can take seconds and
+            # must not stall concurrent cache-hit traffic; a rare duplicate
+            # build on a concurrent first hit of the same key is harmless
+            # (one wins the insert, both count the compile they each paid)
+            with timed("serving::compile"):
+                fn = self._build(key)
+            built = True
+            with _SHARED_LOCK:
+                cur = _SHARED_PROGRAMS.get(skey)
+                if cur is not None:
+                    fn = cur          # a concurrent build won: converge
+                else:
+                    _SHARED_PROGRAMS[skey] = fn
+                    while len(_SHARED_PROGRAMS) > _SHARED_MAX_PROGRAMS:
+                        _SHARED_PROGRAMS.popitem(last=False)
         with self._lock:
             cur = self._cache.get(key)
             if cur is not None:
                 self._cache.move_to_end(key)
                 return cur
             self._cache[key] = fn
-            self.compile_count += 1
+            if built:
+                self.compile_count += 1
             while len(self._cache) > self.max_programs:
                 self._cache.popitem(last=False)
         return fn
 
     # ------------------------------------------------------------------
     # AOT bundles (lightgbm_tpu/aot/): the executable cache as an artifact.
-    # Predict programs take the StackedTrees as an ARGUMENT, so a bundled
-    # executable is tied to tree-array shapes + config, not to one model's
-    # weights — any model with the same (padded) tree geometry reuses it.
+    # Predict programs take the padded StackedTrees + live iteration count
+    # as ARGUMENTS, so a bundled executable is tied to bucketed tree
+    # geometry + config, not to one model's weights — any model landing on
+    # the same (row bucket, tree bucket) rung reuses it.
     def _program_name(self, key) -> str:
-        bucket, nfeat, dtype_str, s, e, kind = key
-        return f"serve_predict_{kind}_b{bucket}_f{nfeat}_{dtype_str}_i{s}-{e}"
+        bucket, tb, nfeat, dtype_str, s, e, kind = key
+        return f"serve_predict_{kind}_b{bucket}_t{tb}_f{nfeat}_{dtype_str}"
 
     def _program_signature(self, key):
         from ..aot.bundle import runtime_signature
-        bucket, nfeat, dtype_str, s, e, kind = key
+        bucket, tb, nfeat, dtype_str, s, e, kind = key
+        padded, _, _ = self._padded_range(s, e)
         st_avals = [[list(map(int, a.shape)), str(a.dtype)]
                     if hasattr(a, "shape") else ["static", repr(a)]
-                    for a in jax.tree_util.tree_leaves(self._stacked)]
+                    for a in jax.tree_util.tree_leaves(padded)]
         return {"kind": "serve_predict", "bucket": int(bucket),
+                "tree_bucket": int(tb),
                 "num_feature": int(nfeat), "dtype": dtype_str,
-                "start": int(s), "end": int(e), "output": kind,
-                "num_class": int(self.num_class),
+                "output": kind, "num_class": int(self.num_class),
                 "objective": self._objective,
                 "average_output": bool(self._average_output),
                 "stacked_avals": st_avals,
@@ -236,7 +414,9 @@ class CompiledPredictor:
         Signature-mismatched or missing entries are skipped (reason logged
         once) and fall back to normal lazy compilation; ``compile_count``
         is untouched, so a replica started from a complete bundle reports
-        zero compiles in steady state."""
+        zero compiles in steady state.  Loaded programs also land in the
+        process-global cache, so they warm every OTHER model on the same
+        geometry rung too."""
         from ..aot.bundle import ProgramBundle
         from ..log import log_info
         bundle = ProgramBundle(str(bundle_dir))
@@ -250,8 +430,7 @@ class CompiledPredictor:
         loaded, misses = 0, []
         for bucket in (buckets or self.buckets):
             for kind in kinds:
-                key = (int(bucket), self.num_feature, str(self.dtype),
-                       s, e, kind)
+                key = self._cache_key(bucket, s, e, kind)
                 with self._lock:
                     if key in self._cache:
                         continue
@@ -261,6 +440,10 @@ class CompiledPredictor:
                 if fn is None:
                     misses.append(reason)
                     continue
+                skey = self._shared_key(key)
+                with _SHARED_LOCK:
+                    if skey not in _SHARED_PROGRAMS:
+                        _SHARED_PROGRAMS[skey] = fn
                 with self._lock:
                     if key not in self._cache:
                         self._cache[key] = fn
@@ -278,19 +461,22 @@ class CompiledPredictor:
     # ------------------------------------------------------------------
     def warmup(self, kinds=("prob",), start_iteration: int = 0,
                num_iteration: int = -1, buckets=None) -> int:
-        """Pre-compile the bucket ladder for the given output kinds.
+        """Pre-compile (or shared-cache-adopt) the bucket ladder for the
+        given output kinds.
 
-        Returns the number of executables compiled; after this, steady
-        traffic of any row count <= max(bucket ladder) with the same
-        iteration range runs with zero new compiles."""
+        Returns the number of executables genuinely compiled; after this,
+        steady traffic of any row count <= max(bucket ladder) with the
+        same iteration range runs with zero new compiles.  On a replica
+        whose process-global program cache already covers this model's
+        geometry rung (any earlier model on the same rung), warmup
+        compiles NOTHING — the multi-tenant zero-compile publish path."""
         s, e = self._iter_range(start_iteration, num_iteration)
         if e <= s:
             return 0
         before = self.compile_count
         for bucket in (buckets or self.buckets):
             for kind in kinds:
-                self._get_compiled((int(bucket), self.num_feature,
-                                    str(self.dtype), s, e, kind))
+                self._get_compiled(self._cache_key(bucket, s, e, kind))
         return self.compile_count - before
 
     def predict(self, data, start_iteration: int = 0,
@@ -322,10 +508,11 @@ class CompiledPredictor:
                                        class_axis=0)(raw)
             return raw if k == 1 else raw.T
         bucket = row_bucket(n, self.buckets)
-        key = (bucket, X.shape[1], str(self.dtype), s, e, kind)
-        fn = self._get_compiled(key)
+        fn = self._get_compiled(self._cache_key(bucket, s, e, kind))
+        padded, n_used, _ = self._padded_range(s, e)
         with timed("serving::predict"):
-            out = fn(self._stacked, jnp.asarray(pad_rows(X, bucket)))
+            out = fn(padded, np.float32(n_used),
+                     jnp.asarray(pad_rows(X, bucket)))
             out = np.asarray(out, np.float64)
         if self.metrics is not None:
             self.metrics.record_device(n)

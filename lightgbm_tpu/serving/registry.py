@@ -67,6 +67,11 @@ class _Model:
 
 
 _MAX_PUBLISH_TOKENS = 16
+# per-model audit-log cap: at hundreds of models x a continuous-boosting
+# publish cadence the history would otherwise grow without bound.  256
+# events is weeks of publishes for any one model; evictions are counted
+# so an operator can see when the log started dropping its head
+_MAX_HISTORY = 256
 
 
 class ModelRegistry:
@@ -76,6 +81,23 @@ class ModelRegistry:
         self._metrics = metrics
         self._buckets = buckets
         self._dtype = dtype
+        from ..telemetry.registry import REGISTRY
+        reg = (metrics.registry if metrics is not None
+               and hasattr(metrics, "registry") else REGISTRY)
+        self._m_history_evicted = reg.counter(
+            "lgbm_serving_registry_history_evicted_total",
+            "oldest publish/rollback audit events dropped past the "
+            "per-model history cap")
+        self._m_tokens_evicted = reg.counter(
+            "lgbm_serving_registry_tokens_evicted_total",
+            "oldest publish-idempotency tokens dropped past the "
+            "per-model token cap")
+
+    def _append_history_locked(self, model: _Model, event: Dict) -> None:
+        model.history.append(event)
+        while len(model.history) > _MAX_HISTORY:
+            model.history.pop(0)
+            self._m_history_evicted.inc()
 
     # ------------------------------------------------------------------
     def publish(self, name: str, booster=None, predictor=None,
@@ -145,15 +167,16 @@ class ModelRegistry:
                 model.tokens[token] = version
                 while len(model.tokens) > _MAX_PUBLISH_TOKENS:
                     model.tokens.pop(next(iter(model.tokens)))
+                    self._m_tokens_evicted.inc()
             model.versions[version] = _Entry(predictor, version)
             # retire the old "previous"; keep the old "current" for rollback
             if model.previous is not None:
                 self._retire_locked(model, model.previous)
             model.previous = model.current
             model.current = version
-            model.history.append({"action": "publish", "version": version,
-                                  "previous": model.previous,
-                                  "t": time.time()})
+            self._append_history_locked(
+                model, {"action": "publish", "version": version,
+                        "previous": model.previous, "t": time.time()})
             return version
 
     def rollback(self, name: str) -> int:
@@ -171,10 +194,9 @@ class ModelRegistry:
             model.tokens = {t: v for t, v in model.tokens.items()
                             if v != model.current}
             model.current, model.previous = model.previous, model.current
-            model.history.append({"action": "rollback",
-                                  "version": model.current,
-                                  "previous": model.previous,
-                                  "t": time.time()})
+            self._append_history_locked(
+                model, {"action": "rollback", "version": model.current,
+                        "previous": model.previous, "t": time.time()})
             return model.current
 
     def unpublish(self, name: str) -> None:

@@ -307,7 +307,11 @@ def test_precompiled_cold_start_zero_compiles(xy, tmp_path):
 
 def test_predictor_bundle_cold_start(xy, tmp_path):
     """Serve half: warmup -> save_bundle -> a fresh predictor loads the
-    ladder with compile_count == 0 and serves identical outputs."""
+    ladder with compile_count == 0 and serves identical outputs.  The
+    process-global program ladder is cleared first so the warmup below
+    genuinely compiles instead of adopting earlier tests' programs."""
+    from lightgbm_tpu.serving.compiled import clear_shared_programs
+    clear_shared_programs()
     X, y = xy
     bst = lgb.train(BASE, lgb.Dataset(X, y), num_boost_round=5)
     bundle = str(tmp_path / "serve_bundle")

@@ -862,7 +862,12 @@ def test_fleet_and_serving_params_documented():
         text = fh.read()
     scoped = [p for p in _PARAMS
               if p.name.startswith(("fleet_", "serving_"))]
-    assert len(scoped) >= 20      # the guard guards something real
+    assert len(scoped) >= 31      # the guard guards something real
+    # ISSUE-16: the multi-tenant control plane shipped its own knob
+    # families — placement + autoscaling must stay covered by this guard
+    ctrl = [p.name for p in scoped if p.name.startswith(
+        ("fleet_placement", "fleet_autoscale", "fleet_max_models"))]
+    assert len(ctrl) >= 12, ctrl
     missing_desc = [p.name for p in scoped if not (p.desc or "").strip()]
     assert not missing_desc, (
         f"fleet_*/serving_* params without a desc: {missing_desc}")
@@ -870,6 +875,33 @@ def test_fleet_and_serving_params_documented():
     assert not missing_doc, (
         f"fleet_*/serving_* params not mentioned in README.md: "
         f"{missing_doc}")
+
+
+def test_compiled_predictor_cache_key_carries_tree_bucket():
+    """ISSUE-16 static guard: the tree-bucket program ladder only
+    deduplicates (and only hot-swaps with zero compiles) if every
+    executable-cache key carries the tree bucket.  Enforce the two
+    halves structurally: _cache_key derives a tree bucket, and every
+    _get_compiled callsite goes through _cache_key — a hand-rolled key
+    at any callsite could silently drop the bucket axis."""
+    import inspect
+
+    from lightgbm_tpu.serving import compiled
+    from lightgbm_tpu.serving.compiled import CompiledPredictor
+
+    src = inspect.getsource(CompiledPredictor._cache_key)
+    assert "_tree_bucket_for" in src, (
+        "CompiledPredictor._cache_key no longer derives the tree "
+        "bucket — the executable cache would collide across rungs")
+    import re
+    module_src = inspect.getsource(compiled)
+    calls = module_src.count("self._get_compiled(")
+    assert calls >= 1
+    keyed = len(re.findall(
+        r"self\._get_compiled\(\s*self\._cache_key\(", module_src))
+    assert calls == keyed, (
+        "a _get_compiled callsite is not fed by _cache_key: its "
+        "hand-rolled key may omit the tree bucket")
 
 
 def test_metric_families_and_trace_params_documented():

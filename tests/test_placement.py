@@ -1,0 +1,439 @@
+"""Multi-tenant control plane (ISSUE 16): tree-bucket ladder identity,
+placement controller, autoscaler, and registry bounds.
+
+The ladder tests pin the substrate's contract — padded-bucket programs
+are BYTE-equal to exact-shape ones across output kinds and across a
+continuation publish that crosses a bucket rung, and a same-rung second
+model warms with zero compiles (the multi-tenant publish path).  The
+control-plane tests drive the router with transport-free fake replicas
+(test_fleet_gray.FakeReplica style): placement narrowing, the
+token-idempotent migration protocol, drain semantics, the
+/v1/fleet/models table, autoscaler hysteresis, and scale-down drain.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import (FleetAutoscaler, PlacementController,
+                                ReplicaTransportError, SLOPolicy)
+from lightgbm_tpu.ops.predict import (pad_stacked_trees, predict_leaf_indices,
+                                      predict_trees, tree_bucket)
+from lightgbm_tpu.serving.compiled import (CompiledPredictor,
+                                           clear_shared_programs)
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from test_fleet_gray import OK, FakeReplica, _gauges, _router  # noqa: E402
+
+BASE = dict(objective="binary", num_leaves=7, learning_rate=0.2,
+            deterministic=True, verbose=-1)
+
+
+def _xy(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] - X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = _xy()
+    return lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+
+
+# ---------------------------------------------------------------------------
+# Tree-bucket ladder: bit identity + zero-compile continuation
+# ---------------------------------------------------------------------------
+def test_tree_bucket_ladder_and_pad_helpers(booster):
+    assert tree_bucket(1) == 8 and tree_bucket(8) == 8
+    assert tree_bucket(9) == 16 and tree_bucket(4096) == 4096
+    assert tree_bucket(5000) == 8192          # doubles past the top rung
+    st = booster.stacked_trees(0, -1)
+    t = int(st.root.shape[0])
+    padded = pad_stacked_trees(st, tree_count=t + 3)
+    assert int(padded.root.shape[0]) == t + 3
+    assert pad_stacked_trees(st, tree_count=t) is st     # no-op
+    with pytest.raises(ValueError):
+        pad_stacked_trees(st, tree_count=t - 1)          # shrink
+
+
+def test_padded_ops_bit_identity_all_output_kinds(booster):
+    """Null-tree padding contributes exact +0.0: sum, per-tree, and
+    leaf-index outputs over the live trees are byte-equal to the
+    unpadded stack."""
+    X, _ = _xy(33, seed=1)
+    X = np.asarray(X, np.float32)
+    st = booster.stacked_trees(0, -1)
+    t = int(st.root.shape[0])
+    padded = pad_stacked_trees(st, tree_count=tree_bucket(t),
+                               node_count=64, max_depth=16)
+    exact_sum = np.asarray(predict_trees(st, X, output="sum"))
+    pad_sum = np.asarray(predict_trees(padded, X, output="sum"))
+    assert exact_sum.tobytes() == pad_sum.tobytes()
+    exact_pt = np.asarray(predict_trees(st, X, output="per_tree"))
+    pad_pt = np.asarray(predict_trees(padded, X, output="per_tree"))
+    assert exact_pt.tobytes() == pad_pt[:t].tobytes()
+    assert not np.asarray(pad_pt[t:]).any()       # null trees: exact zeros
+    exact_leaf = np.asarray(predict_leaf_indices(st, X))
+    pad_leaf = np.asarray(predict_leaf_indices(padded, X))
+    assert exact_leaf.tobytes() == pad_leaf[:t].tobytes()
+
+
+def test_padded_predictor_bit_identity_raw_and_prob(booster):
+    """The padded-ladder CompiledPredictor is byte-equal to the
+    exact-shape arm (tree_buckets=()) for raw scores and transformed
+    probabilities, full range and sub-ranges."""
+    X, _ = _xy(50, seed=2)
+    pad = CompiledPredictor(booster, buckets=(8, 64))
+    exact = CompiledPredictor(booster, buckets=(8, 64), tree_buckets=())
+    for kw in (dict(), dict(raw_score=True),
+               dict(start_iteration=1, num_iteration=3)):
+        a = pad.predict(X, **kw)
+        b = exact.predict(X, **kw)
+        assert a.tobytes() == b.tobytes(), kw
+
+
+def test_continuation_across_bucket_boundary(booster):
+    """A continuation publish that crosses a tree-bucket rung (5 -> 12
+    iterations crosses the 8-rung; in this engine continued training
+    bakes the old model into init scores and the new booster carries the
+    new trees) compiles only the NEW rung's programs and stays
+    byte-identical to the exact arm; a second model landing on an
+    already-warm rung compiles nothing at all."""
+    clear_shared_programs()
+    X, y = _xy()
+    Xq, _ = _xy(20, seed=3)
+    cont = lgb.train(BASE, lgb.Dataset(X, label=y, free_raw_data=False),
+                     num_boost_round=12, init_model=booster)
+    assert cont.num_trees() == 12
+    p1 = CompiledPredictor(booster, buckets=(8,))
+    assert p1.warmup(kinds=("prob", "raw")) > 0     # rung 8 compiles
+    p2 = CompiledPredictor(cont, buckets=(8,))
+    compiled = p2.warmup(kinds=("prob", "raw"))     # rung 16: new programs
+    assert compiled > 0
+    exact = CompiledPredictor(cont, buckets=(8,), tree_buckets=())
+    assert p2.predict(Xq).tobytes() == exact.predict(Xq).tobytes()
+    assert (p2.predict(Xq, raw_score=True).tobytes()
+            == exact.predict(Xq, raw_score=True).tobytes())
+    # the zero-compile multi-tenant path: a DIFFERENT model on the same
+    # rungs (same config, different data) adopts every program
+    X3, y3 = _xy(seed=7)
+    other = lgb.train(BASE, lgb.Dataset(X3, label=y3), num_boost_round=12)
+    p3 = CompiledPredictor(other, buckets=(8,))
+    assert p3.warmup(kinds=("prob", "raw")) == 0
+    assert p3.compile_count == 0
+    np.testing.assert_allclose(p3.predict(Xq), other.predict(Xq),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_padded_predict_program_carries_no_array_consts(booster):
+    """jaxpr-consts guard (the PR 6 HLO-inlining class) on the padded
+    predict program: the sliced+bucket-padded stack, the live count, and
+    the rows all ride as ARGUMENTS — a capture would bloat every program
+    on the shared ladder and bake one model's weights into it."""
+    import jax
+
+    pred = CompiledPredictor(booster, buckets=(8,))
+    key = pred._cache_key(8, 0, pred.n_iterations, "prob")
+    fn, (padded, n_spec, x_spec) = pred._predict_fn(key)
+    example = (padded, np.float32(pred.n_iterations),
+               np.zeros((8, pred.num_feature), np.float32))
+    closed = jax.make_jaxpr(fn)(*example)
+    sizes = [int(np.asarray(c).size) for c in closed.consts
+             if hasattr(c, "shape")]
+    assert max(sizes, default=0) <= 64, (
+        "the padded predict trace captured an array constant instead of "
+        "taking it as an argument")
+
+
+def test_cache_key_carries_tree_bucket(booster):
+    """Functional half of the tree-bucket cache-key guard (the static
+    half lives in test_fleet_gray.py): key index 1 IS the tree bucket,
+    for the padded and the exact arm."""
+    pad = CompiledPredictor(booster, buckets=(8,))
+    key = pad._cache_key(8, 0, pad.n_iterations, "raw")
+    assert key[1] == tree_bucket(pad.n_iterations)
+    exact = CompiledPredictor(booster, buckets=(8,), tree_buckets=())
+    key = exact._cache_key(8, 0, exact.n_iterations, "raw")
+    assert key[1] == exact.n_iterations
+
+
+# ---------------------------------------------------------------------------
+# Control plane: fakes, no sockets
+# ---------------------------------------------------------------------------
+class TenantReplica(FakeReplica):
+    """FakeReplica with a real per-name model map: publish installs,
+    unpublish removes, predicts 404 for absent names, GET /v1/models
+    lists — the surface the placement protocol exercises."""
+
+    def __init__(self, name, gauges=None):
+        super().__init__(name, gauges)
+        self.models = {}
+        self.unpublished = []
+
+    def request(self, method, path, body=None, timeout_s=None):
+        if self.dead:
+            raise ReplicaTransportError(f"replica {self.name}: dead")
+        if method == "GET" and path == "/v1/models":
+            return 200, {"models": {n: {"current": v}
+                                    for n, v in self.models.items()}}
+        if path.startswith("/v1/models/") and ":" in path:
+            name, _, verb = path[len("/v1/models/"):].rpartition(":")
+            if verb == "predict":
+                if name not in self.models:
+                    return 404, {"error": f"no model {name!r}"}
+                self.served += 1
+                self.bodies.append(dict(body or {}))
+                return 200, {"name": name, "version": self.models[name],
+                             "predictions": [0.0] * len(body["rows"])}
+            if verb == "publish":
+                self.models[name] = self.models.get(name, 0) + 1
+                self.published.append({"name": name, **dict(body or {})})
+                return 200, {"name": name, "version": self.models[name]}
+            if verb == "unpublish":
+                self.models.pop(name, None)
+                self.unpublished.append(name)
+                return 200, {"name": name, "version": None}
+        return 404, {"error": "no route"}
+
+
+def _fleet(n=3):
+    reps = [TenantReplica(chr(ord("a") + i)) for i in range(n)]
+    r = _router(reps)
+    r.poll_once()
+    return reps, r
+
+
+def _controller(r, **kw):
+    kw.setdefault("drain_ms", 5.0)
+    kw.setdefault("capacity_rows_s", 1000.0)
+    return PlacementController(r, **kw)
+
+
+def test_placement_narrows_routing_and_replay(monkeypatch):
+    reps, r = _fleet(3)
+    try:
+        assert r.handle("POST", "/v1/models/m1:publish",
+                        {"model_str": "x"})[0] == 200
+        ctl = _controller(r)
+        assert ctl.place("m1", {1})
+        assert r.placement("m1") == {1}
+        assert "m1" not in reps[0].models and "m1" not in reps[2].models
+        for _ in range(6):
+            st, out = r.handle("POST", "/v1/models/m1:predict",
+                               {"rows": [[1.0]]})
+            assert st == 200 and out["replica"] == "b"
+        # rejoin replay is placement-filtered: replica a restarts and
+        # gets NO m1 replay (it is placed on b)
+        reps[0].dead = True
+        r.poll_once()
+        assert r.replica_states()["a"]["state"] == "down"
+        reps[0].dead = False
+        reps[0].boot = 2.0                   # fresh process, new boot_s
+        before = len(reps[0].published)
+        r.poll_once()
+        import time
+        time.sleep(0.3)                      # replay thread settles
+        assert len(reps[0].published) == before
+    finally:
+        r.close()
+
+
+def test_migration_is_token_idempotent_and_drained():
+    reps, r = _fleet(2)
+    try:
+        assert r.handle("POST", "/v1/models/m:publish",
+                        {"model_str": "x"})[0] == 200
+        ctl = _controller(r)
+        assert ctl.place("m", {0})
+        # destination refuses the first publish: the move fails, the
+        # routing table is untouched, and the retained token makes the
+        # retry re-send the SAME publish (registry replay contract)
+        real = reps[1].request
+        state = {"fail": 1}
+
+        def flaky(method, path, body=None, timeout_s=None):
+            if path.endswith(":publish") and state["fail"]:
+                state["fail"] -= 1
+                return 503, {"error": "injected"}
+            return real(method, path, body, timeout_s)
+
+        reps[1].request = flaky
+        assert not ctl.move("m", 0, 1)
+        assert r.placement("m") == {0}
+        failed = r.registry.snapshot()[
+            "lgbm_fleet_placement_failed_moves_total"]["_"]
+        assert failed == 1
+        token = ctl._move_tokens[("m", 1)]
+        assert ctl.move("m", 0, 1)           # retry converges
+        assert r.placement("m") == {1}
+        # b saw the original broadcast publish plus exactly ONE move
+        # publish — the retry re-sent the token minted for the failed
+        # first attempt, so the registry replays instead of double-apply
+        sent = [b["publish_token"] for b in reps[1].published
+                if b["name"] == "m"]
+        assert sent[-1] == token and sent.count(token) == 1
+        assert reps[0].unpublished == ["m"]
+        assert ("m", 1) not in ctl._move_tokens      # token released
+        st, out = r.handle("POST", "/v1/models/m:predict",
+                           {"rows": [[1.0]]})
+        assert st == 200 and out["replica"] == "b"
+    finally:
+        r.close()
+
+
+def test_compute_target_packs_spreads_and_caps():
+    reps, r = _fleet(3)
+    try:
+        ctl = _controller(r, capacity_rows_s=1000.0, headroom=0.0,
+                          spread_rows_s=600.0, max_models_per_replica=2)
+
+        def row(g):
+            return {"slo": {"goodput_rows_per_s": g}, "placed": False}
+
+        table = {"hot": row(700.0), "warm": row(300.0),
+                 "cool": row(200.0), "cold": row(10.0)}
+        # pin current placement so stickiness is deterministic
+        r.set_placement("hot", {0})
+        r.set_placement("warm", {1})
+        r.set_placement("cool", {1})
+        r.set_placement("cold", {2})
+        target = ctl.compute_target(table=table, live=[0, 1, 2])
+        assert len(target["hot"]) == 2 and 0 in target["hot"]  # spread
+        assert target["warm"] == {1}                 # sticky
+        # replica 1 now holds hot+warm = the 2-model cap, so "cool" is
+        # cap-evicted off its current home to the emptiest replica
+        assert target["cool"] == {2}
+        assert target["cold"] == {2}                 # sticky
+        # per-replica model cap: nobody exceeds 2
+        counts = {}
+        for want in target.values():
+            for i in want:
+                counts[i] = counts.get(i, 0) + 1
+        assert max(counts.values()) <= 2
+    finally:
+        r.close()
+
+
+def test_fleet_models_table_route():
+    reps, r = _fleet(2)
+    try:
+        assert r.handle("POST", "/v1/models/m1:publish",
+                        {"model_str": "x"})[0] == 200
+        ctl = _controller(r)
+        assert ctl.place("m1", {1})
+        for _ in range(3):
+            r.handle("POST", "/v1/models/m1:predict", {"rows": [[1.0]]})
+        st, out = r.handle("GET", "/v1/fleet/models")
+        assert st == 200
+        row = out["models"]["m1"]
+        assert row["replicas"] == ["b"] and row["placed"] is True
+        assert row["version"] == 1
+        assert row["slo"]["goodput_rows_per_s"] > 0
+        assert row["slo"]["deadline_miss_ratio"] == 0.0
+    finally:
+        r.close()
+
+
+class _StubSupervisor:
+    def __init__(self, n):
+        class _Slot:
+            def __init__(self):
+                self.alive = True
+                self.gave_up = False
+                self.port = 0
+        self.host = "127.0.0.1"
+        self.replicas = [_Slot() for _ in range(n)]
+        self.retired = []
+
+    def retire_slot(self, idx):
+        self.retired.append(idx)
+        self.replicas[idx].gave_up = True
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    reps, r = _fleet(2)
+    try:
+        scaler = FleetAutoscaler(_StubSupervisor(2), r, polls=3,
+                                 max_replicas=4, cooldown_s=60.0,
+                                 miss_ratio_high=0.05, poll_ms=0)
+        actions = []
+        scaler.scale_up = lambda: actions.append("up") or True
+        scaler.scale_down = lambda: actions.append("down") or True
+        mm = r._model_stats("m")
+        for _ in range(200):
+            mm.outcomes.observe(1.0)         # 100% deadline misses
+        assert scaler.poll_once() == "hold"  # hysteresis: 1 of 3
+        assert scaler.poll_once() == "hold"
+        assert scaler.poll_once() == "up"
+        assert actions == ["up"]
+        for _ in range(8192):                # evict every miss from the
+            mm.outcomes.observe(0.0)         # capacity-bounded window
+        for _ in range(10):
+            scaler.poll_once()               # cooldown blocks everything
+        assert actions == ["up"]
+        scaler._cooldown_until = 0.0
+        assert scaler.poll_once() == "hold"
+        assert scaler.poll_once() == "hold"
+        assert scaler.poll_once() == "down"
+        assert actions == ["up", "down"]
+    finally:
+        r.close()
+
+
+def test_scale_down_drains_placed_models_first():
+    reps, r = _fleet(3)
+    sup = _StubSupervisor(3)
+    try:
+        assert r.handle("POST", "/v1/models/m:publish",
+                        {"model_str": "x"})[0] == 200
+        ctl = _controller(r)
+        assert ctl.place("m", {2})           # placed on the victim
+        scaler = FleetAutoscaler(sup, r, controller=ctl, min_replicas=1,
+                                 max_replicas=3, poll_ms=0)
+        assert scaler.scale_down()
+        assert r.live_indices() == [0, 1]
+        assert sup.retired == [2]
+        placed = r.placement("m")
+        assert placed and 2 not in placed    # drained before retirement
+        dst = sorted(placed)[0]
+        assert "m" in reps[dst].models and "m" not in reps[2].models
+        st, out = r.handle("POST", "/v1/models/m:predict",
+                           {"rows": [[1.0]]})
+        assert st == 200
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# Registry bounds (satellite): history + token caps with eviction counters
+# ---------------------------------------------------------------------------
+def test_registry_history_and_token_bounds():
+    from lightgbm_tpu.serving.registry import (_MAX_HISTORY,
+                                               _MAX_PUBLISH_TOKENS,
+                                               ModelRegistry)
+    from lightgbm_tpu.telemetry.registry import MetricsRegistry
+
+    class _M:
+        registry = MetricsRegistry()
+
+    metrics = _M()
+    reg = ModelRegistry(metrics=metrics)
+    n = _MAX_HISTORY + 40
+    for i in range(n):
+        reg.publish("m", predictor=object(), warmup=False,
+                    token=f"tok{i}")
+    hist = reg.history("m")
+    assert len(hist) == _MAX_HISTORY
+    # oldest evicted, newest kept
+    assert hist[-1]["version"] == n
+    assert hist[0]["version"] == n - _MAX_HISTORY + 1
+    snap = metrics.registry.snapshot()
+    assert snap["lgbm_serving_registry_history_evicted_total"]["_"] == 40
+    assert snap["lgbm_serving_registry_tokens_evicted_total"]["_"] == (
+        n - _MAX_PUBLISH_TOKENS)
+    # the token map stayed bounded and the newest token still replays
+    assert reg.publish("m", token=f"tok{n - 1}") == n
