@@ -105,6 +105,31 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  never spent on doomed work).  CPU by design: topology
                  claims.  Knobs: BENCH_GRAY_{THREADS,SECONDS,TREES,
                  TRAIN_ROWS,STORM_THREADS,STORM_SECONDS,FACTOR}.
+- cascade        early-exit cascade soak (run_cascade): in-process
+                 correctness probes first — band=infinity (epsilon=0)
+                 must be np.array_equal to plain serving for raw AND
+                 prob, and at a 75% prefix every exited row's served
+                 answer must sit within cascade_epsilon of the
+                 full-forest answer — then an A/B fleet comparison:
+                 two replica PROCESSES behind an in-process router,
+                 deadline-carrying foreground clients, a mid-soak
+                 overload brownout (background storm threads saturate
+                 the replica queues).  Arm A is refuse-only (cascade
+                 off): brownout foreground requests burn their budget
+                 in the queue and fail 504.  Arm B runs
+                 cascade_mode=deadline: the router flips degrade=true
+                 when the remaining budget cannot afford the per-model
+                 p99 and the replica serves every row from the
+                 calibrated prefix, bypassing the queue.  Bars
+                 (vs_baseline 1.0 iff all hold): band=infinity
+                 bit-identical, exits within epsilon, ZERO failed
+                 foreground requests in arm B across the brownout,
+                 arm B p99 strictly better than arm A, degrades
+                 counted on router AND replicas, ZERO predict compiles
+                 after warmup (prefix rung + full rung are both warm
+                 ladder programs).  CPU by design: topology claims.
+                 Knobs: BENCH_CASCADE_{TREES,THREADS,SECONDS,
+                 STORM_THREADS,STORM_ROWS,TRAIN_ROWS,EPSILON}.
 - multitenant    multi-tenant control-plane soak (run_multitenant): a
                  few trained boosters published under 100+ tenant names
                  onto 2 supervised replica PROCESSES behind an
@@ -1695,6 +1720,285 @@ def run_fleet_gray():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_cascade():
+    """Child body for BENCH_STAGE=cascade: the early-exit cascade proof.
+
+    Correctness first, in-process on the parent's compiled predictor:
+    band=infinity (epsilon=0) must be bit-identical to plain serving
+    (completion re-runs the full-range warm program, never resumes a
+    partial f32 sum), and at a 75% prefix every exited row's served
+    answer must sit within epsilon of the full-forest answer (the f64
+    suffix tail bound pushed through the objective link).
+
+    Then the behavioral A/B: two replica processes behind the router,
+    foreground clients carrying a deadline sized from the healthy p50,
+    and a mid-soak overload brownout (storm threads shoving large
+    no-deadline requests through the same queues).  The refuse-only arm
+    must shed foreground traffic 504 while the queues are saturated;
+    the cascade arm must flip degrade=true at the router on p99
+    evidence and answer every foreground request 200 from the
+    calibrated prefix via the queue-bypassing direct path — zero
+    failures, strictly better p99, degrades counted on both sides, and
+    zero predict compiles after warmup (both rungs are warm ladder
+    programs).  The brownout's first moments are an unmeasured
+    learning window, fleet_gray-style: the router needs a few slow
+    observations before its p99 evidence reflects the storm, and that
+    bounded one-off discovery cost is excluded from the steady-state
+    claim."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.fleet import (FleetRouter, FleetSupervisor,
+                                    HttpReplica, SLOPolicy,
+                                    default_replica_argv)
+
+    n_threads = int(os.environ.get("BENCH_CASCADE_THREADS", 3))
+    rounds = int(os.environ.get("BENCH_CASCADE_TREES", 256))
+    train_rows = int(os.environ.get("BENCH_CASCADE_TRAIN_ROWS", 8_000))
+    phase_s = float(os.environ.get("BENCH_CASCADE_SECONDS", 4.0))
+    storm_threads = int(os.environ.get("BENCH_CASCADE_STORM_THREADS", 6))
+    storm_rows = int(os.environ.get("BENCH_CASCADE_STORM_ROWS", 256))
+    epsilon = float(os.environ.get("BENCH_CASCADE_EPSILON", 5e-3))
+
+    # strongly separable task: most rows sit far from the boundary, so
+    # the 75% prefix already pins their probability within epsilon —
+    # the traffic regime the band exit is built for (the in-process
+    # probe reports the honest exit fraction)
+    rng = np.random.RandomState(3)
+    X = rng.randn(train_rows, N_FEATURES).astype(np.float32)
+    y = (2.5 * X[:, 0] + 1.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+              "verbosity": -1, "max_bin": MAX_BIN, "min_data_in_leaf": 20}
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_cascade_")
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+    model_path = os.path.join(tmp, "model.txt")
+    bst.save_model(model_path)
+    pred = bst.to_compiled()
+    pred.warmup()
+    bundle = os.path.join(tmp, "bundle")
+    pred.save_bundle(bundle)
+    prefix_trees = (3 * rounds) // 4
+
+    # --- in-process probe 1: band=infinity is bit-identical ----------
+    probe = rng.randn(512, N_FEATURES).astype(np.float64)
+    identical = True
+    for raw in (False, True):
+        plain = np.asarray(pred.predict(probe, raw_score=raw))
+        casc, info = pred.predict_cascade(probe, epsilon=0.0, raw_score=raw)
+        identical = (identical and np.array_equal(plain, np.asarray(casc))
+                     and info["n_exited"] == 0)
+
+    # --- in-process probe 2: exits honor epsilon at the 75% prefix ---
+    out_b, info_b = pred.predict_cascade(
+        probe, prefix_iterations=prefix_trees, epsilon=epsilon)
+    full = np.asarray(pred.predict(probe), np.float64)
+    served_delta = float(np.max(np.abs(np.asarray(out_b, np.float64)
+                                       - full))) if probe.size else 0.0
+    band = {
+        "prefix_trees": prefix_trees,
+        "epsilon": epsilon,
+        "n_exited": int(info_b["n_exited"]),
+        "exit_fraction": round(info_b["n_exited"] / probe.shape[0], 4),
+        "max_served_delta": served_delta,
+        "within_epsilon": bool(served_delta <= epsilon + 1e-12),
+        "tail_bound": float(pred.tail_bound(prefix_trees, rounds).max()),
+    }
+
+    pool = np.random.RandomState(1).randn(4096, N_FEATURES).astype(np.float64)
+
+    def drive(router, seconds, seed0, threads, max_rows=8,
+              deadline_ms=None):
+        stop = time.time() + seconds
+        lat = [[] for _ in range(threads)]
+        stat = [{} for _ in range(threads)]
+        degraded = [0] * threads
+
+        def client(i):
+            r = np.random.RandomState(seed0 + i)
+            while time.time() < stop:
+                n = int(r.randint(1, max_rows + 1))
+                lo = int(r.randint(0, pool.shape[0] - n))
+                body = {"rows": pool[lo:lo + n].tolist()}
+                if deadline_ms is not None:
+                    body["deadline_ms"] = deadline_ms
+                t0 = time.perf_counter()
+                status, resp = router.handle(
+                    "POST", "/v1/models/default:predict", body)
+                lat[i].append(time.perf_counter() - t0)
+                stat[i][status] = stat[i].get(status, 0) + 1
+                if status == 200 and resp.get("degraded"):
+                    degraded[i] += 1
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(seconds + 120)
+        statuses: dict = {}
+        for s in stat:
+            for k, v in s.items():
+                statuses[k] = statuses.get(k, 0) + v
+        return statuses, sorted(x for part in lat for x in part), \
+            sum(degraded)
+
+    def p99_ms(lat):
+        if not lat:
+            return 0.0
+        return lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+
+    def replica_argv(extra):
+        base = {"input_model": model_path, "aot_bundle_dir": bundle,
+                "serving_max_wait_ms": "2", "verbosity": "-1",
+                "serving_max_queue_rows": "2048",
+                "serving_max_batch": "256"}
+        base.update(extra)
+        return base
+
+    def fleet_compiles(replicas):
+        total = 0
+        for rep in replicas:
+            _, metrics = rep.request("GET", "/v1/metrics")
+            total += sum(m.get("compile_count", 0)
+                         for m in metrics.values() if isinstance(m, dict))
+        return total
+
+    def soak(extra_params, router_kw, arm_seed):
+        """One arm: healthy phase, overload brownout (unmeasured
+        learning window first), recovery.  Returns measured stats."""
+        ports = find_open_ports(2)
+        sup = FleetSupervisor(
+            lambda idx, port: default_replica_argv(
+                replica_argv(extra_params), port),
+            ports, log_dir=os.path.join(tmp, f"logs{arm_seed}"),
+            max_restarts=2, restart_backoff_s=0.5)
+        try:
+            sup.spawn_all()
+            sup.wait_ready(timeout_s=min(
+                180.0, max(deadline - time.time() - 90.0, 30.0)))
+            sup.start_watching(interval_s=0.2)
+            replicas = [HttpReplica(u) for u in sup.urls]
+            with FleetRouter(replicas, policy=SLOPolicy(recover_polls=1),
+                             poll_interval_ms=50, **router_kw) as r:
+                # warm connections/paths, size the foreground deadline
+                # from the healthy p50, and pin the compile baseline
+                _, lat_w, _ = drive(r, 1.5, arm_seed, n_threads)
+                p50 = (lat_w[len(lat_w) // 2] * 1e3) if lat_w else 10.0
+                fg_deadline = max(8.0 * p50, 80.0)
+                compiles0 = fleet_compiles(replicas)
+
+                stat_h, lat_h, deg_h = drive(
+                    r, phase_s, arm_seed + 10, n_threads,
+                    deadline_ms=fg_deadline)
+
+                storm_s = 1.5 + phase_s + 1.0
+                storm = threading.Thread(
+                    target=drive, args=(r, storm_s, arm_seed + 20,
+                                        storm_threads, storm_rows))
+                storm.start()
+                # unmeasured learning window: the router's p99 evidence
+                # catches up to the storm here (bounded one-off cost)
+                drive(r, 1.5, arm_seed + 30, n_threads,
+                      deadline_ms=fg_deadline)
+                stat_b, lat_b, deg_b = drive(
+                    r, phase_s, arm_seed + 40, n_threads,
+                    deadline_ms=fg_deadline)
+                storm.join(storm_s + 120)
+
+                stat_r, lat_r, deg_r = drive(
+                    r, phase_s / 2, arm_seed + 50, n_threads,
+                    deadline_ms=fg_deadline)
+
+                statuses: dict = {}
+                for s in (stat_h, stat_b, stat_r):
+                    for k, v in s.items():
+                        statuses[k] = statuses.get(k, 0) + v
+                all_lat = sorted(lat_h + lat_b + lat_r)
+                snap = r.registry.snapshot()
+                degraded_router = int(
+                    snap.get("lgbm_fleet_degraded_total", {}).get("_", 0))
+                degraded_replicas = early_exits = 0
+                for rep in replicas:
+                    _, metrics = rep.request("GET", "/v1/metrics")
+                    for m in metrics.values():
+                        if isinstance(m, dict):
+                            degraded_replicas += m.get("degraded", 0)
+                            early_exits += m.get("early_exits", 0)
+                return {
+                    "statuses": {str(k): v for k, v in statuses.items()},
+                    "failed_requests": sum(v for k, v in statuses.items()
+                                           if k != 200),
+                    "p99_ms": round(p99_ms(all_lat), 1),
+                    "p99_brownout_ms": round(p99_ms(lat_b), 1),
+                    "deadline_ms": round(fg_deadline, 1),
+                    "degraded_responses": deg_h + deg_b + deg_r,
+                    "degraded_router": degraded_router,
+                    "degraded_replicas": degraded_replicas,
+                    "early_exits": early_exits,
+                    "compiles_after_warmup":
+                        fleet_compiles(replicas) - compiles0,
+                }
+        finally:
+            sup.stop_all()
+
+    try:
+        setup_s = time.time() - t_start
+        # --- arm A: refuse-only (cascade off everywhere) -------------
+        arm_a = soak({}, {}, 1000)
+        # --- arm B: deadline cascade, band exits on the batched path -
+        arm_b = soak({"cascade_mode": "deadline",
+                      "cascade_prefix_trees": str(prefix_trees),
+                      "cascade_epsilon": str(epsilon)},
+                     {"cascade_mode": "deadline"}, 2000)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    bars = {
+        "band_infinity_bit_identical": bool(identical),
+        "exits_within_epsilon": bool(band["within_epsilon"]
+                                     and band["n_exited"] > 0),
+        "refuse_arm_fails_under_brownout": bool(
+            arm_a["failed_requests"] > 0),
+        "zero_failed_degrade_arm": bool(arm_b["failed_requests"] == 0),
+        "p99_strictly_better": bool(arm_b["p99_ms"] < arm_a["p99_ms"]),
+        "degrades_counted": bool(arm_b["degraded_router"] > 0
+                                 and arm_b["degraded_replicas"] > 0),
+        "zero_post_warmup_compiles": bool(
+            arm_b["compiles_after_warmup"] == 0),
+    }
+    result = {
+        "metric": f"cascade_2replicas_{rounds}trees_{n_threads}threads",
+        "value": arm_b["p99_ms"],
+        "unit": "ms_p99_with_deadline_cascade",
+        "vs_baseline": 1.0 if all(bars.values()) else 0.0,
+        "p99_ratio_refuse_over_cascade": (
+            round(arm_a["p99_ms"] / arm_b["p99_ms"], 3)
+            if arm_b["p99_ms"] else None),
+        "bars": bars,
+        "band_infinity_bit_identical": bool(identical),
+        "band": band,
+        "refuse_arm": arm_a,
+        "degrade_arm": arm_b,
+        "setup_s": round(setup_s, 1),
+        "backend": backend,
+    }
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def _continuous_incremental_phase(params, tmp):
     """Growing-pool probe for the incremental dataset pipeline (ISSUE 10):
     N stationary cycles, each ingesting one fresh segment into the
@@ -2794,6 +3098,8 @@ if __name__ == "__main__":
             run_fleet_gray()
         elif stage == "multitenant":
             run_multitenant()
+        elif stage == "cascade":
+            run_cascade()
         elif stage == "continuous":
             run_continuous()
         elif stage == "continuous_sharded":

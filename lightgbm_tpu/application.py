@@ -253,7 +253,10 @@ class Application:
                          max_wait_ms=cfg.serving_max_wait_ms,
                          max_queue_rows=cfg.serving_max_queue_rows,
                          continuous=bool(cfg.serving_continuous_batching),
-                         default_deadline_ms=cfg.serving_default_deadline_ms)
+                         default_deadline_ms=cfg.serving_default_deadline_ms,
+                         cascade_mode=cfg.cascade_mode,
+                         cascade_prefix_trees=cfg.cascade_prefix_trees,
+                         cascade_epsilon=cfg.cascade_epsilon)
         models = [m for m in str(cfg.input_model).split(",") if m]
         names = [n for n in str(cfg.serving_model_name).split(",") if n]
         if len(names) > len(models):
@@ -348,7 +351,10 @@ class Application:
                          max_wait_ms=cfg.serving_max_wait_ms,
                          max_queue_rows=cfg.serving_max_queue_rows,
                          continuous=bool(cfg.serving_continuous_batching),
-                         default_deadline_ms=cfg.serving_default_deadline_ms)
+                         default_deadline_ms=cfg.serving_default_deadline_ms,
+                         cascade_mode=cfg.cascade_mode,
+                         cascade_prefix_trees=cfg.cascade_prefix_trees,
+                         cascade_epsilon=cfg.cascade_epsilon)
         name = str(cfg.serving_model_name).split(",")[0] or "default"
         bundle = cfg.aot_bundle_dir or None
         shards = int(cfg.continuous_shards or 0)

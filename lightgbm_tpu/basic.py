@@ -646,6 +646,10 @@ class Booster:
         # on device
         self._stacked_cache: "OrderedDict" = OrderedDict()
         self._stacked_cache_cap = 8
+        # cascade tail bounds (ops.predict.tree_tail_bounds) for the FULL
+        # model, invalidated with the stacked cache under _model_version —
+        # the serving predictor snapshots it next to stacked_trees()
+        self._tail_bounds_cache = None
         # dedicated mutex for the cache dict itself: stacked_trees runs
         # under the shared READ lock (predict) or no lock (to_compiled),
         # so LRU mutation must not race concurrent readers or a writer's
@@ -844,6 +848,7 @@ class Booster:
         with self._stacked_lock:
             self._model_version += 1
             self._stacked_cache.clear()
+            self._tail_bounds_cache = None
 
     def stacked_trees(self, start_iteration: int = 0,
                       num_iteration: int = -1):
@@ -885,6 +890,26 @@ class Booster:
             while len(self._stacked_cache) > self._stacked_cache_cap:
                 self._stacked_cache.popitem(last=False)
         return hit
+
+    def tail_bounds(self) -> "np.ndarray":
+        """Cached per-class cascade tail bounds for the full model
+        (ops.predict.tree_tail_bounds): row t bounds |sum of leaf values
+        of iterations t..end| per class, so ``tail[K] - tail[e]`` is the
+        exact uncertainty half-width of a K-iteration prefix score
+        against the [K, e) completion.  Invalidated with the stacked
+        cache under _model_version, same contract as stacked_trees()."""
+        from .ops.predict import tree_tail_bounds
+        with self._stacked_lock:
+            version = self._model_version
+            hit = self._tail_bounds_cache
+        if hit is not None:
+            return hit
+        out = tree_tail_bounds(self._trees_for_range(0, -1),
+                               self.num_model_per_iteration())
+        with self._stacked_lock:
+            if version == self._model_version:
+                self._tail_bounds_cache = out
+        return out
 
     def to_compiled(self, buckets=None, dtype=None, **kwargs):
         """Build a serving-grade CompiledPredictor from this model.

@@ -279,6 +279,27 @@ _PARAMS: List[ParamSpec] = [
        "work that cannot finish in time, before any device dispatch "
        "(lgbm_serving_deadline_refused_total).  0 = no default; "
        "requests wait as long as they must"),
+    _p("cascade_mode", str, "off", (), "in:off|band|deadline",
+       "early-exit cascade inference (serving/cascade.py): band = score "
+       "every row with the forest prefix and complete only rows whose "
+       "served-answer bound (prefix score ± suffix tail bound, pushed "
+       "through the objective link) exceeds cascade_epsilon; deadline = "
+       "additionally let the fleet router serve the calibrated prefix "
+       "answer with degraded=true when a request's remaining budget "
+       "cannot afford the full forest on p99 evidence, instead of a "
+       "504.  off = plain full-forest serving"),
+    _p("cascade_prefix_trees", int, 0, (), ">=0",
+       "iterations in the cascade's cheap prefix pass (clamped to the "
+       "served range; 0 = auto, a quarter of the forest).  Prefix and "
+       "completion are two programs on the standard warm "
+       "row-bucket/tree-bucket rungs — no new compile machinery"),
+    _p("cascade_epsilon", float, 0.0, (), ">=0",
+       "served-answer tolerance for early exit: a row keeps its prefix "
+       "answer only when the exact bound on how far the remaining trees "
+       "could move its SERVED output (post-link) is at most this.  "
+       "0 = band=infinity: every row completes (bit-identical answers, "
+       "cascade plumbing exercised); exits count "
+       "lgbm_serving_early_exit_total"),
     # ---- Fleet serving (task=serve + fleet_*; lightgbm_tpu/fleet/) ----
     _p("fleet_role", str, "", (), "in:|replica|router",
        "task=serve role: empty = single server (or full fleet launch "

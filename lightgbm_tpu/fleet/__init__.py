@@ -63,7 +63,8 @@ def _make_router(config, urls, registry=None, supervisor=None) -> FleetRouter:
                        breaker_cooldown_s=config.fleet_breaker_cooldown_s,
                        breaker_probes=config.fleet_breaker_probes,
                        latency_routing=bool(config.fleet_latency_routing),
-                       default_deadline_ms=config.fleet_deadline_ms)
+                       default_deadline_ms=config.fleet_deadline_ms,
+                       cascade_mode=getattr(config, "cascade_mode", "off"))
 
 
 def placement_from_config(config, router) -> PlacementController:

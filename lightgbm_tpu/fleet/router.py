@@ -63,7 +63,7 @@ from ..serving.metrics import LatencyWindow
 from ..telemetry import trace as _trace
 from ..telemetry.registry import MetricsRegistry
 from .breaker import CircuitBreaker, LatencyDigest, RetryBudget
-from .slo import ReplicaSLO, SLOPolicy
+from .slo import ReplicaSLO, SLOPolicy, full_forest_affordable
 
 __all__ = ["FleetRouter", "HttpReplica", "ReplicaTransportError"]
 
@@ -327,7 +327,8 @@ class FleetRouter:
                  latency_routing: bool = True,
                  default_deadline_ms: float = 0.0,
                  supervisor=None,
-                 tracer=None):
+                 tracer=None,
+                 cascade_mode: str = "off"):
         if not replicas:
             raise LightGBMError("FleetRouter needs at least one replica")
         policy = policy or SLOPolicy()
@@ -354,6 +355,11 @@ class FleetRouter:
         self.hedge_min_ms = float(hedge_min_ms)
         self.latency_routing = bool(latency_routing)
         self.default_deadline_ms = float(default_deadline_ms)
+        # early-exit cascade: in "deadline" mode a request whose budget
+        # cannot afford the full forest (per-model p99 evidence) is
+        # forwarded with degrade=true and served the calibrated prefix
+        # answer instead of a 504 (serving/cascade.py has the band math)
+        self.cascade_mode = str(cascade_mode or "off")
         self.retry_budget = RetryBudget(ratio=retry_budget_pct / 100.0)
         self.hedge_budget = RetryBudget(ratio=hedge_budget_pct / 100.0,
                                         cap=50.0, initial=5.0)
@@ -449,6 +455,11 @@ class FleetRouter:
             "lgbm_fleet_deadline_refused_total",
             "predicts refused 504 at the router because their deadline "
             "budget was already spent")
+        self._m_degraded = reg.counter(
+            "lgbm_fleet_degraded_total",
+            "predicts forwarded degrade=true because their remaining "
+            "budget could not afford the full forest (served the "
+            "calibrated prefix answer instead of a 504)")
         self._m_forwarded = [reg.counter(
             "lgbm_fleet_forwarded_total", "predicts forwarded",
             replica=r.endpoint.name) for r in self._replicas]
@@ -1140,6 +1151,7 @@ class FleetRouter:
         tried: set = set()
         race_retried: set = set()
         last_err: Optional[str] = None
+        degrade = bool(body.get("degrade", False))
         while candidates:
             remaining = (None if deadline_t is None
                          else deadline_t - time.perf_counter())
@@ -1154,6 +1166,24 @@ class FleetRouter:
                 return 504, {"error": "deadline exceeded at router "
                                       f"(budget {float(deadline_ms):g}ms, "
                                       f"attempts {attempts})"}
+            if (not degrade and self.cascade_mode == "deadline"
+                    and remaining is not None
+                    and not full_forest_affordable(
+                        remaining, mm.window.percentiles()["p99_ms"])):
+                # the budget is alive but (on p99 evidence) too small for
+                # a full-forest answer: ask the replica for the calibrated
+                # prefix instead of letting the deadline clock run out
+                # into a 504.  Decided once per request — the flag rides
+                # every subsequent attempt's forwarded body.
+                degrade = True
+                self._m_degraded.inc()
+                if tspan is not None:
+                    # degraded serves are always-kept by the tail sampler
+                    tspan.mark("degraded")
+                    tspan.event("router.degrade",
+                                remaining_ms=round(remaining * 1e3, 1),
+                                p99_ms=round(
+                                    mm.window.percentiles()["p99_ms"], 1))
             idx = candidates[0]
             tried.add(idx)
             token_spent = False
@@ -1187,6 +1217,10 @@ class FleetRouter:
                 # left, not the client's original figure
                 fwd_body = dict(body)
                 fwd_body["deadline_ms"] = remaining * 1e3
+            if degrade and not fwd_body.get("degrade"):
+                if fwd_body is body:
+                    fwd_body = dict(body)
+                fwd_body["degrade"] = True
             outcomes = self._attempt_maybe_hedged(
                 idx, name, fwd_body, nrows, timeout_s, tried, deadline_t,
                 tspan)

@@ -30,11 +30,28 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-__all__ = ["SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN"]
+__all__ = ["SLOPolicy", "ReplicaSLO", "HEALTHY", "SHED", "DOWN",
+           "full_forest_affordable"]
 
 HEALTHY = "healthy"   # routable
 SHED = "shed"         # reachable but over SLO: no new load until recovered
 DOWN = "down"         # unreachable: no new load until it polls ok again
+
+
+def full_forest_affordable(remaining_s: float, p99_ms: float,
+                           safety: float = 1.0) -> bool:
+    """Can a request with ``remaining_s`` of deadline budget afford a
+    FULL-forest predict, given the model's recent p99 evidence?
+
+    The early-exit cascade's deadline mode (router cascade_mode=deadline)
+    serves the calibrated prefix answer with ``degraded=true`` when this
+    says no — converting a would-be 504 into a useful response.  With no
+    latency evidence yet (p99 <= 0: cold model, idle window) the answer
+    is True: degradation must be evidence-driven, never the default.
+    ``safety`` scales the required headroom (>1 degrades earlier)."""
+    if p99_ms <= 0:
+        return True
+    return float(remaining_s) * 1e3 >= float(p99_ms) * float(safety)
 
 
 class SLOPolicy:

@@ -21,7 +21,7 @@ import numpy as np
 __all__ = ["StackedTrees", "stack_trees", "predict_trees",
            "predict_leaf_indices", "row_bucket", "pad_rows",
            "pad_rows_to_bucket", "predict_trees_padded",
-           "tree_bucket", "pad_stacked_trees",
+           "tree_bucket", "pad_stacked_trees", "tree_tail_bounds",
            "DEFAULT_BUCKET_LADDER", "DEFAULT_TREE_BUCKET_LADDER"]
 
 _K_ZERO = 1e-35
@@ -67,6 +67,35 @@ def tree_bucket(n: int, ladder=None) -> int:
         if n <= b:
             return int(b)
     return int(1 << (n - 1).bit_length())
+
+
+def tree_tail_bounds(trees, num_class: int = 1) -> np.ndarray:
+    """Per-class tail-bound array for early-exit cascade inference.
+
+    ``out[t, c]`` is an EXACT bound on |sum of class c's leaf
+    contributions over iterations t..end| for ANY input row: a tree adds
+    exactly one of its leaf values to a row's score, so the worst case
+    over rows is the suffix sum of each tree's max-|leaf| (shrinkage is
+    already baked into the stored leaf values).  A prefix score after K
+    iterations therefore carries a calibrated interval of half-width
+    ``out[K] - out[end]`` around the full-forest raw score — the margin
+    test that lets easy rows exit without running the remaining trees.
+
+    Trees interleave per class (iteration i of class c is tree i*k + c,
+    the same layout ``stack_trees`` packs), hence the [n_iterations + 1,
+    num_class] shape; the final all-zero row makes ``out[K] - out[end]``
+    valid for every 0 <= K <= end with no edge cases.  float64
+    throughout: the bound must never round BELOW the true tail.
+    """
+    k = max(int(num_class), 1)
+    n_iter = len(trees) // k
+    per_iter = np.zeros((n_iter, k), dtype=np.float64)
+    for i, tr in enumerate(trees[:n_iter * k]):
+        per_iter[i // k, i % k] = tr.max_abs_leaf()
+    out = np.zeros((n_iter + 1, k), dtype=np.float64)
+    if n_iter:
+        out[:n_iter] = np.cumsum(per_iter[::-1], axis=0)[::-1]
+    return out
 
 
 def pad_stacked_trees(stacked: "StackedTrees", tree_count: int,

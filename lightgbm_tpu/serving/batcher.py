@@ -333,14 +333,24 @@ class MicroBatcher:
         device_s = time.perf_counter() - t0
         # a predictor may return (array, meta) — meta (e.g. the registry
         # version that served this flush) is attached to every request's
-        # result, so callers learn exactly which model produced their rows
+        # result, so callers learn exactly which model produced their rows.
+        # A DICT meta may carry a "row_meta" sub-dict of per-row arrays
+        # (cascade exit masks): each request receives a copy with those
+        # arrays sliced to ITS rows, so per-row facts survive coalescing.
         meta = _NO_META
         if type(out) is tuple:
             out, meta = out
+        row_meta = (meta.get("row_meta")
+                    if isinstance(meta, dict) else None)
         lo = 0
         t_done = time.perf_counter()
         for req in batch:
             hi = lo + req.rows.shape[0]
+            req_meta = meta
+            if row_meta is not None:
+                req_meta = dict(meta)
+                req_meta["row_meta"] = {name: arr[lo:hi]
+                                        for name, arr in row_meta.items()}
             if req.trace is not None:
                 # the flush is shared; each rider's trace gets its own
                 # view of it (batch size + fill say how much of the
@@ -352,7 +362,8 @@ class MicroBatcher:
                     batch_rows=int(X.shape[0]), batch_requests=len(batch))
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(
-                    out[lo:hi] if meta is _NO_META else (out[lo:hi], meta))
+                    out[lo:hi] if meta is _NO_META
+                    else (out[lo:hi], req_meta))
             lo = hi
             if self.metrics is not None:
                 self.metrics.record_request(req.rows.shape[0],

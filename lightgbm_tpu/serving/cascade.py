@@ -1,0 +1,136 @@
+"""Early-exit cascade inference: the band math and knob resolution.
+
+A GBDT is additive, so the raw score after the first K iterations plus
+the tail bound on iterations K..end (``Booster.tail_bounds`` — suffix
+sums of per-tree max-|leaf|, shrinkage included) brackets the
+full-forest raw score exactly.  This module turns that raw-score
+interval into a per-row bound on the SERVED answer — the number the
+client actually receives, after the objective's output link — so the
+exit rule is stated in the units ``cascade_epsilon`` is configured in:
+
+- raw outputs: the served delta IS the raw delta, bounded by the tail.
+- single-output links (sigmoid, identity, exp, log1p∘exp, signed
+  square): all monotone non-decreasing, so the served answer under a
+  raw perturbation in [-t, +t] is bracketed by g(r-t) and g(r+t) — the
+  per-row bound is exact and shrinks where the link saturates, which is
+  precisely what makes confident rows cheap (a binary row at raw 6 has
+  a sigmoid delta of ~t*2e-3, far inside any practical epsilon).
+- multiclass softmax: per-class extremes are attained at d_i = +t_i,
+  d_j = -t_j (raise the class, lower all rivals), giving exact
+  componentwise probability brackets under the per-class tail bounds.
+- multiclassova: independent per-class sigmoids, scalar rule per class.
+
+A row may exit after the prefix iff its served-answer bound fits inside
+``cascade_epsilon``; everything else is gathered into a completion pass
+on the full forest.  ``cascade_epsilon`` <= 0 is the band=∞ degenerate:
+every row falls inside the band and completes (bit-identical answers,
+cascade plumbing exercised) — the correctness-reference arm of the
+bench.  The deadline path (router) instead serves the prefix for EVERY
+row with ``degraded=true``; the bound still rides the response math,
+it just no longer gates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..log import LightGBMError
+from ..objectives import output_transform
+
+__all__ = ["CascadeConfig", "resolve_prefix_iterations",
+           "served_delta_bound"]
+
+# exp() saturates float64 around 709; tails this large mean "the prefix
+# knows nothing" and must read as a ~1.0 probability bound, not an
+# inf/inf NaN that would silently exit the row
+_EXP_CAP = 500.0
+
+
+class CascadeConfig:
+    """The three cascade knobs, validated once and carried as a unit
+    (ServingApp -> ModelRegistry warmup -> per-flush dispatch)."""
+
+    __slots__ = ("mode", "prefix_trees", "epsilon")
+
+    def __init__(self, mode: str = "off", prefix_trees: int = 0,
+                 epsilon: float = 0.0):
+        mode = str(mode or "off")
+        if mode not in ("off", "band", "deadline"):
+            raise LightGBMError(
+                f"cascade_mode must be off|band|deadline, got {mode!r}")
+        self.mode = mode
+        self.prefix_trees = int(prefix_trees)
+        self.epsilon = float(epsilon)
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def __repr__(self) -> str:
+        return (f"CascadeConfig(mode={self.mode!r}, "
+                f"prefix_trees={self.prefix_trees}, "
+                f"epsilon={self.epsilon:g})")
+
+
+def resolve_prefix_iterations(n_iterations: int,
+                              prefix_trees: int = 0) -> int:
+    """Effective prefix length K for a served range of ``n_iterations``:
+    ``cascade_prefix_trees`` clamped into [1, n_iterations], with 0 =
+    auto (a quarter of the forest, at least one iteration) — the same
+    resolution warmup and the per-flush dispatch must share, or the
+    prefix program warms on one rung and serves on another."""
+    n = max(int(n_iterations), 1)
+    k = int(prefix_trees)
+    if k <= 0:
+        k = max(n // 4, 1)
+    return min(k, n)
+
+
+def _softmax_brackets(raw: np.ndarray, tail: np.ndarray):
+    """Exact componentwise softmax extremes under per-class raw
+    perturbations |d_c| <= tail_c: class i peaks at d_i = +t_i with
+    every rival at -t_j (and bottoms out at the mirror image)."""
+    z = raw - raw.max(axis=1, keepdims=True)
+    with np.errstate(over="ignore"):
+        e = np.exp(z)
+        e_hi = np.exp(np.minimum(z + tail, _EXP_CAP))
+        e_lo = np.exp(z - tail)
+    s, s_hi, s_lo = (a.sum(axis=1, keepdims=True) for a in (e, e_hi, e_lo))
+    p = e / s
+    p_max = e_hi / (e_hi + (s_lo - e_lo))
+    p_min = e_lo / (e_lo + (s_hi - e_hi))
+    return p, p_min, p_max
+
+
+def served_delta_bound(raw: np.ndarray, tail: np.ndarray, objective: str,
+                       kind: str = "prob") -> np.ndarray:
+    """Per-row bound on how much the SERVED answer can still move if the
+    remaining trees run, given prefix raw scores and the tail bound.
+
+    ``raw`` is host layout — [n] single-output or [n, k] multiclass —
+    and ``tail`` is the per-class bound array [k] (``[1]``/scalar for
+    single output).  ``kind`` matches the predictor's output kinds:
+    "raw" bounds the raw score itself, "prob" bounds the post-link
+    output.  Returns [n] float64; a row may exit iff its entry fits
+    inside the configured epsilon."""
+    raw = np.asarray(raw, dtype=np.float64)
+    tail = np.atleast_1d(np.asarray(tail, dtype=np.float64))
+    n = raw.shape[0]
+    if kind == "raw" or not str(kind):
+        return np.full(n, float(tail.max(initial=0.0)))
+    head = objective.split()[0] if objective else ""
+    if raw.ndim == 2 and head.startswith("multiclass") and "ova" not in head:
+        p, p_min, p_max = _softmax_brackets(raw, tail)
+        return np.maximum(p_max - p, p - p_min).max(axis=1)
+    # every remaining link is elementwise monotone non-decreasing, so
+    # the served answer is bracketed by the link at the raw extremes
+    axis = 1 if raw.ndim == 2 else 0
+    g = output_transform(objective, xp=np, class_axis=axis)
+    with np.errstate(over="ignore"):
+        mid = g(raw)
+        hi = g(raw + tail) - mid
+        lo = mid - g(raw - tail)
+    bound = np.maximum(hi, lo)
+    if bound.ndim == 2:
+        bound = bound.max(axis=1)
+    return np.nan_to_num(bound, nan=np.inf, posinf=np.inf)

@@ -51,6 +51,7 @@ from ..ops.predict import (DEFAULT_BUCKET_LADDER, DEFAULT_TREE_BUCKET_LADDER,
                            StackedTrees, pad_rows, pad_stacked_trees,
                            predict_trees, row_bucket, tree_bucket)
 from ..timer import timed
+from .cascade import resolve_prefix_iterations, served_delta_bound
 
 __all__ = ["CompiledPredictor", "clear_shared_programs",
            "shared_program_count"]
@@ -152,6 +153,10 @@ class CompiledPredictor:
         # _padded_range — the padding happens OUTSIDE the program, so the
         # program itself is range-agnostic)
         self._stacked: Optional[StackedTrees] = booster.stacked_trees(0, -1)
+        # cascade tail bounds ride the same snapshot: [n_iterations+1, k]
+        # suffix sums of per-tree max-|leaf| (shrinkage included), so
+        # tail_bound() never touches the (possibly mutated) booster
+        self._tail_bounds: np.ndarray = booster.tail_bounds()
         # per-range padded sub-stacks, LRU-bounded like the booster's own
         # stacked cache (serving traffic uses one or two ranges)
         self._subs: "OrderedDict[tuple, tuple]" = OrderedDict()
@@ -314,12 +319,31 @@ class CompiledPredictor:
         fn, args = self._predict_fn(key)
         return jax.jit(fn).lower(*args).compile()
 
+    def _record_lookup(self, key, hit: bool, size=None) -> None:
+        """Feed the executable-cache observability gauges (rung-labeled
+        hit/miss counters + occupancy) when a metrics sink is attached.
+        getattr-guarded: predictors are also built bare in tests and
+        one-shot tools where no ModelMetrics exists."""
+        m = self.metrics
+        if m is None:
+            return
+        rec = getattr(m, "record_program_lookup", None)
+        if rec is not None:
+            rec(key[1], hit)   # key[1] is the tree bucket — the rung
+        if size is not None:
+            setg = getattr(m, "set_programs_cached", None)
+            if setg is not None:
+                setg(size)
+
     def _get_compiled(self, key):
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
                 self._cache.move_to_end(key)  # LRU touch
-                return fn
+                size = len(self._cache)
+        if fn is not None:
+            self._record_lookup(key, True, size)
+            return fn
         skey = self._shared_key(key)
         with _SHARED_LOCK:
             fn = _SHARED_PROGRAMS.get(skey)
@@ -346,12 +370,17 @@ class CompiledPredictor:
             cur = self._cache.get(key)
             if cur is not None:
                 self._cache.move_to_end(key)
-                return cur
-            self._cache[key] = fn
-            if built:
-                self.compile_count += 1
-            while len(self._cache) > self.max_programs:
-                self._cache.popitem(last=False)
+                fn, built = cur, False   # concurrent insert won the race
+            else:
+                self._cache[key] = fn
+                if built:
+                    self.compile_count += 1
+                while len(self._cache) > self.max_programs:
+                    self._cache.popitem(last=False)
+            size = len(self._cache)
+        # a shared-cache adoption is a HIT for rung-reuse purposes — the
+        # point of the gauge is "did this lookup pay a compile"
+        self._record_lookup(key, not built, size)
         return fn
 
     # ------------------------------------------------------------------
@@ -519,5 +548,93 @@ class CompiledPredictor:
         if k > 1:
             return out[:, :n].T
         return out[:n]
+
+    # ------------------------------------------------------------------
+    def tail_bound(self, from_iteration: int,
+                   to_iteration: Optional[int] = None) -> np.ndarray:
+        """Per-class bound on |sum of leaf contributions of iterations
+        [from_iteration, to_iteration)| — the exact suffix-sum difference
+        from the snapshot's tail-bound table.  Shape [num_class]."""
+        n = self.n_iterations
+        f = min(max(int(from_iteration), 0), n)
+        t = n if to_iteration is None else min(max(int(to_iteration), f), n)
+        return self._tail_bounds[f] - self._tail_bounds[t]
+
+    def predict_cascade(self, data, prefix_iterations: int = 0,
+                        epsilon: float = 0.0, start_iteration: int = 0,
+                        num_iteration: int = -1, raw_score: bool = False,
+                        force_prefix: bool = False):
+        """Two-phase early-exit predict over the serving range.
+
+        Phase 1 scores every row with the first K iterations (K from
+        ``resolve_prefix_iterations``) as a raw-score program; the tail
+        bound on the remaining iterations then yields a per-row bound on
+        how far the SERVED answer (post-link) can still move.  Rows whose
+        bound fits inside ``epsilon`` keep the prefix answer; the rest are
+        gathered and re-run through the FULL-range program — the same
+        warm rung plain ``predict`` uses — so completed rows are
+        bit-identical to the non-cascade path (tree traversal is
+        row-independent; re-summing a K..T suffix separately would
+        re-associate float adds and break that).  ``epsilon <= 0`` is the
+        band=∞ degenerate: every row completes.  ``force_prefix=True``
+        serves the prefix answer for ALL rows regardless of epsilon — the
+        router's deadline-degrade path.
+
+        Returns ``(out, info)`` where ``out`` matches ``predict``'s shape
+        and ``info`` carries ``prefix_iterations``, the boolean ``exited``
+        mask, ``n_exited``/``completed`` counts, the per-row float64
+        ``delta_bound``, and the per-class ``tail_bound``.
+        """
+        if self._average_output:
+            raise LightGBMError(
+                "cascade inference requires an additive model; an "
+                "average_output (random forest) prefix is a mean over a "
+                "different tree count, so no suffix tail bound brackets "
+                "the final answer — use predict()")
+        X = np.atleast_2d(np.asarray(data))
+        n = X.shape[0]
+        s, e = self._iter_range(start_iteration, num_iteration)
+        kind = "raw" if raw_score else "prob"
+        if e <= s or n == 0:
+            out = self.predict(X, start_iteration=start_iteration,
+                               num_iteration=num_iteration,
+                               raw_score=raw_score)
+            return out, {"prefix_iterations": 0,
+                         "exited": np.zeros(n, dtype=bool),
+                         "n_exited": 0, "completed": n,
+                         "delta_bound": np.zeros(n),
+                         "tail_bound": np.zeros(max(self.num_class, 1))}
+        K = resolve_prefix_iterations(e - s, prefix_iterations)
+        tail = self.tail_bound(s + K, e)
+        raw_prefix = self.predict(X, start_iteration=s, num_iteration=K,
+                                  raw_score=True)
+        delta = served_delta_bound(raw_prefix, tail, self._objective, kind)
+        if force_prefix:
+            exited = np.ones(n, dtype=bool)
+        elif float(epsilon) > 0.0 and K < e - s:
+            exited = delta <= float(epsilon)
+        else:
+            # epsilon<=0 is band=∞: nothing is certain enough to exit,
+            # every row rides the completion rung (bit-identity arm)
+            exited = np.zeros(n, dtype=bool)
+        raw_prefix = np.asarray(raw_prefix, np.float64)
+        if kind == "prob":
+            out = output_transform(
+                self._objective, xp=np,
+                class_axis=1 if raw_prefix.ndim == 2 else 0)(raw_prefix)
+        else:
+            out = raw_prefix
+        need = ~exited
+        if need.any():
+            # completion = the FULL-range program on the gathered rows
+            # (already warm from normal serving), assigned verbatim —
+            # bit-identical to predict() for every completed row
+            out[need] = self.predict(
+                X[need], start_iteration=start_iteration,
+                num_iteration=num_iteration, raw_score=raw_score)
+        n_exited = int(exited.sum())
+        return out, {"prefix_iterations": int(K), "exited": exited,
+                     "n_exited": n_exited, "completed": n - n_exited,
+                     "delta_bound": delta, "tail_bound": tail}
 
     __call__ = predict

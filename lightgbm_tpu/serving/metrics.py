@@ -185,6 +185,30 @@ class ModelMetrics:
         self._compiles = reg.gauge(
             "lgbm_serving_compile_count", "XLA programs compiled for this "
             "model (all versions)", **lab)
+        # early-exit cascade observables (serving/cascade.py): exits are
+        # rows served from the forest prefix because their served-answer
+        # bound fit inside cascade_epsilon; degraded are whole REQUESTS
+        # served prefix-only because the deadline could not afford the
+        # full forest (router cascade_mode=deadline)
+        self._early_exit = reg.counter(
+            "lgbm_serving_early_exit_total",
+            "rows served from the forest prefix (served-answer bound "
+            "inside cascade_epsilon) without a completion pass", **lab)
+        self._degraded = reg.counter(
+            "lgbm_serving_degraded_total",
+            "requests served a calibrated prefix-only answer with "
+            "degraded=true instead of a deadline 504", **lab)
+        self._exit_fraction = reg.gauge(
+            "lgbm_serving_exit_fraction",
+            "last cascade flush's early-exited rows over its total rows",
+            **lab)
+        self._programs_cached = reg.gauge(
+            "lgbm_serving_programs_cached",
+            "executables resident in this model's predictor cache", **lab)
+        # per-rung program hit/miss counters are minted lazily — the rung
+        # label is the tree bucket, which depends on the model's ladder
+        self._rung_lock = threading.Lock()
+        self._rung_counters: Dict[tuple, object] = {}
         # per-model SLO gauges (the ROADMAP's router-driven-placement
         # feed): derived views over the windows below, refreshed by
         # refresh_slo_gauges() at metrics render time — gauges so any
@@ -353,6 +377,53 @@ class ModelMetrics:
     def record_rejection(self) -> None:
         self._queue_rejections.inc()
 
+    # -- cascade / program-cache observables ---------------------------
+    def record_early_exit(self, n_exited: int, n_total: int) -> None:
+        """One cascade flush: `n_exited` of `n_total` rows kept their
+        prefix answer.  Counter + last-flush fraction gauge."""
+        if n_exited:
+            self._early_exit.inc(int(n_exited))
+        if n_total:
+            self._exit_fraction.set(float(n_exited) / float(n_total))
+
+    def record_degraded(self) -> None:
+        """One request served prefix-only with degraded=true."""
+        self._degraded.inc()
+
+    def set_programs_cached(self, count: int) -> None:
+        self._programs_cached.set(int(count))
+
+    def record_program_lookup(self, rung, hit: bool) -> None:
+        """One executable-cache lookup on tree-bucket `rung` (hit = the
+        program was already resident locally or process-wide; miss = a
+        compile was paid).  Rung-labeled counters, minted on first use."""
+        key = (str(rung), bool(hit))
+        with self._rung_lock:
+            c = self._rung_counters.get(key)
+            if c is None:
+                if hit:
+                    c = self.registry.counter(
+                        "lgbm_serving_program_hits_total",
+                        "executable-cache lookups that reused a warm "
+                        "program, by tree-bucket rung",
+                        model=self.name, rung=str(rung))
+                else:
+                    c = self.registry.counter(
+                        "lgbm_serving_program_misses_total",
+                        "executable-cache lookups that paid an XLA "
+                        "compile, by tree-bucket rung",
+                        model=self.name, rung=str(rung))
+                self._rung_counters[key] = c
+        c.inc()
+
+    @property
+    def early_exits(self) -> int:
+        return int(self._early_exit.value)
+
+    @property
+    def degraded(self) -> int:
+        return int(self._degraded.value)
+
     def snapshot(self, compile_count: Optional[int] = None) -> Dict:
         with self._batch_lock:
             batches = self.batches
@@ -368,6 +439,10 @@ class ModelMetrics:
             "queue_depth": self.queue_depth,
             "queue_rejections": self.queue_rejections,
             "deadline_refused": self.deadline_refused,
+            "early_exits": self.early_exits,
+            "degraded": self.degraded,
+            "exit_fraction": round(float(self._exit_fraction.value), 4),
+            "programs_cached": int(self._programs_cached.value),
             "queue_wait_p50_ms": round(
                 self.queue_wait.percentiles()["p50_ms"], 3),
             "inflight_rows": int(self._inflight_rows.value),

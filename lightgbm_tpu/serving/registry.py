@@ -75,12 +75,17 @@ _MAX_HISTORY = 256
 
 
 class ModelRegistry:
-    def __init__(self, metrics=None, buckets=None, dtype=None):
+    def __init__(self, metrics=None, buckets=None, dtype=None,
+                 cascade=None):
         self._lock = threading.Lock()
         self._models: Dict[str, _Model] = {}
         self._metrics = metrics
         self._buckets = buckets
         self._dtype = dtype
+        # early-exit cascade config (serving/cascade.py CascadeConfig or
+        # None): publish-time warmup must pre-compile the PREFIX rung too,
+        # or the first cascade flush eats a compile in steady state
+        self._cascade = cascade
         from ..telemetry.registry import REGISTRY
         reg = (metrics.registry if metrics is not None
                and hasattr(metrics, "registry") else REGISTRY)
@@ -153,6 +158,18 @@ class ModelRegistry:
             predictor.load_bundle(aot_bundle_dir)
         if warmup:
             predictor.warmup()
+            casc = self._cascade
+            if casc is not None and getattr(casc, "enabled", False):
+                # warm the cascade's prefix rung as RAW programs (the
+                # band math needs raw scores; the link is applied on
+                # host) so prefix flushes and deadline-degrade serves
+                # compile nothing post-warmup.  Same K resolution as the
+                # dispatch — a different K here would warm a dead rung.
+                from .cascade import resolve_prefix_iterations
+                s, e = predictor._iter_range(0, -1)
+                if e > s:
+                    k = resolve_prefix_iterations(e - s, casc.prefix_trees)
+                    predictor.warmup(kinds=("raw",), num_iteration=k)
         with self._lock:
             model = self._models.get(name)
             if model is None:

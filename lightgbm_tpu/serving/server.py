@@ -49,6 +49,7 @@ from ..log import LightGBMError
 from ..telemetry import trace as _trace
 from .batcher import (DeadlineExceededError, MicroBatcher, QueueFullError,
                       ServingClosedError)
+from .cascade import CascadeConfig
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 
@@ -65,9 +66,12 @@ class _RegistryDispatch:
     publish's version (or 404 after an unpublish) for predictions that
     were in fact computed successfully."""
 
-    def __init__(self, registry: ModelRegistry, name: str):
+    def __init__(self, registry: ModelRegistry, name: str,
+                 cascade: Optional[CascadeConfig] = None, metrics=None):
         self._registry = registry
         self._name = name
+        self._cascade = cascade
+        self._metrics = metrics
         # advisory width + bucket ladder for the server's pre-coalesce
         # check and the batcher's fill gauge, refreshed at every flush so
         # the hot path never takes the registry lock just to read them;
@@ -81,6 +85,23 @@ class _RegistryDispatch:
         with self._registry.acquire(self._name) as (pred, version):
             self.num_feature = pred.num_feature
             self.buckets = pred.buckets
+            casc = self._cascade
+            # the band cascade only pays when rows can actually exit
+            # (epsilon > 0); epsilon<=0 would run prefix + completion on
+            # EVERY row, strictly more device work than one full pass.
+            # average_output models have no additive tail bound — plain
+            # path (predict_cascade would raise).
+            if (casc is not None and casc.enabled and casc.epsilon > 0
+                    and not getattr(pred, "_average_output", False)):
+                out, info = pred.predict_cascade(
+                    X, prefix_iterations=casc.prefix_trees,
+                    epsilon=casc.epsilon)
+                if self._metrics is not None:
+                    self._metrics.record_early_exit(
+                        info["n_exited"], X.shape[0])
+                return out, {"version": version,
+                             "prefix_iterations": info["prefix_iterations"],
+                             "row_meta": {"exited": info["exited"]}}
             return pred.predict(X), version
 
 
@@ -91,9 +112,19 @@ class ServingApp:
                  max_queue_rows: int = 16384, batching: bool = True,
                  continuous: bool = True,
                  default_deadline_ms: float = 0.0,
-                 tracer=None):
+                 tracer=None,
+                 cascade_mode: str = "off",
+                 cascade_prefix_trees: int = 0,
+                 cascade_epsilon: float = 0.0):
         self.metrics = metrics or ServingMetrics()
-        self.registry = registry or ModelRegistry(metrics=self.metrics)
+        # early-exit cascade (serving/cascade.py): band mode exits
+        # confident rows after the forest prefix inside coalesced
+        # flushes; any enabled mode also honors a router's degrade=true
+        # (prefix-only answer instead of a deadline 504)
+        self.cascade = CascadeConfig(cascade_mode, cascade_prefix_trees,
+                                     cascade_epsilon)
+        self.registry = registry or ModelRegistry(metrics=self.metrics,
+                                                  cascade=self.cascade)
         self.batching = batching
         # distributed tracing (telemetry/trace.py): adopts the wire
         # context a router forwarded in the request body, or roots a new
@@ -135,7 +166,11 @@ class ServingApp:
                 # distinct name (_RegistryDispatch's constructor acquire
                 # raises for unpublished names)
                 b = self._batchers[name] = MicroBatcher(
-                    _RegistryDispatch(self.registry, name),
+                    _RegistryDispatch(
+                        self.registry, name,
+                        cascade=(self.cascade if self.cascade.enabled
+                                 else None),
+                        metrics=self.metrics.model(name)),
                     metrics=self.metrics.model(name), **self._batch_cfg)
             return b
 
@@ -365,6 +400,52 @@ class ServingApp:
             kwargs["raw_score"] = bool(body["raw_score"])
         version = body.get("version")
         default_call = not kwargs and version is None
+        if (default_call and self.cascade.enabled
+                and bool(body.get("degrade", False))):
+            # deadline-degrade (router cascade_mode=deadline): the budget
+            # cannot afford the full forest, so serve the calibrated
+            # prefix answer for EVERY row, now, on the direct path — a
+            # coalescing queue is wait this request cannot pay for
+            dspan = (None if span is None
+                     else span.child("replica.device.prefix",
+                                     rows=int(rows.shape[0])))
+            try:
+                with self.registry.acquire(name) as (pred, v):
+                    served_version = v
+                    if getattr(pred, "_average_output", False):
+                        # no additive tail bound: full forest or nothing
+                        out = pred.predict(rows)
+                        degraded, info = False, None
+                    else:
+                        out, info = pred.predict_cascade(
+                            rows,
+                            prefix_iterations=self.cascade.prefix_trees,
+                            epsilon=self.cascade.epsilon,
+                            force_prefix=True)
+                        degraded = True
+            finally:
+                if dspan is not None:
+                    dspan.finish()
+            m = self.metrics.model(name)
+            if degraded:
+                m.record_degraded()
+                m.record_early_exit(info["n_exited"], rows.shape[0])
+                if span is not None:
+                    # degraded serves are always-kept by the tail sampler:
+                    # they are exactly the requests a latency post-mortem
+                    # needs to see
+                    span.mark("degraded")
+                    span.set(degraded=True,
+                             prefix_iterations=info["prefix_iterations"])
+            m.record_request(rows.shape[0],
+                             latency_s=time.perf_counter() - t0)
+            resp = {"name": name, "version": served_version,
+                    "predictions": np.asarray(out).tolist(),
+                    "degraded": degraded}
+            if info is not None:
+                resp["exited_early"] = [bool(x) for x in info["exited"]]
+                resp["prefix_iterations"] = int(info["prefix_iterations"])
+            return 200, resp
         if default_call and self.batching:
             # reject too-narrow bodies BEFORE coalescing so the error is
             # this request's own 400, not a poisoned flush.  Full-width
@@ -379,9 +460,21 @@ class ServingApp:
                 raise LightGBMError(
                     f"predict called with {rows.shape[1]} features; model "
                     f"{name!r} expects {nfeat}")
-            out, served_version = batcher.predict(rows,
-                                                  deadline_t=deadline_t,
-                                                  trace_span=span)
+            out, meta = batcher.predict(rows, deadline_t=deadline_t,
+                                        trace_span=span)
+            if isinstance(meta, dict):
+                # cascade flush: per-row exit facts rode the meta, sliced
+                # to this request's rows by the batcher
+                exited = (meta.get("row_meta") or {}).get("exited")
+                resp = {"name": name, "version": meta.get("version"),
+                        "predictions": np.asarray(out).tolist(),
+                        "degraded": False,
+                        "exited_early": [] if exited is None
+                        else [bool(x) for x in exited],
+                        "prefix_iterations":
+                            int(meta.get("prefix_iterations", 0))}
+                return 200, resp
+            served_version = meta
         else:
             # the non-batched path has no queue, but the deadline still
             # gates DISPATCH: a pinned-version/sliced predict whose

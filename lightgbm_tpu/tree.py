@@ -159,6 +159,19 @@ class Tree:
     def scale_leaf(self, leaf_values: np.ndarray) -> None:
         self.leaf_value[:self.num_leaves] = leaf_values[:self.num_leaves]
 
+    def max_abs_leaf(self) -> float:
+        """Largest |leaf value| this tree can contribute to any row —
+        the per-tree term of the early-exit cascade's tail bound
+        (ops.predict.tree_tail_bounds).  Leaf values store shrinkage
+        in-place (see shrinkage()), so the bound needs no rate factor.
+        Constant leaves only: a linear tree's contribution also depends
+        on its per-leaf coefficients, so no finite per-tree bound exists
+        here (the serving CompiledPredictor rejects linear trees)."""
+        n = self.num_leaves
+        if n <= 0:
+            return 0.0
+        return float(np.max(np.abs(self.leaf_value[:n])))
+
     # ------------------------------------------------------------------
     def _cat_in_bitset(self, node: int, ival: np.ndarray, inner: bool) -> np.ndarray:
         if inner:

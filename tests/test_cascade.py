@@ -1,0 +1,379 @@
+"""Early-exit cascade inference tests (ISSUE 17).
+
+The cascade's correctness contract has three legs, each tested here:
+
+1. **Soundness** — the suffix tail bound dominates the true remaining
+   contribution for EVERY prefix length, so a row that exits can never
+   be further from the full-forest answer than the published band.
+2. **Bit-identity at band=infinity** — epsilon<=0 completes every row
+   via the same full-range compiled program plain serving uses, so the
+   cascade arm is np.array_equal to the non-cascade arm (tree traversal
+   is row-independent; completion re-runs the whole range rather than
+   resuming a partial sum, which would re-associate f32 adds).
+3. **Degrade-over-refuse** — force_prefix / degrade=true serves every
+   row from the prefix with degraded=true flagged and counted, and the
+   router flips the flag when the remaining deadline budget cannot
+   afford the per-model p99 (evidence-driven, never speculative).
+
+Everything runs in-process on the CPU backend; router tests use fake
+replicas (no sockets), mirroring tests/test_fleet_gray.py.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import FleetRouter, SLOPolicy
+from lightgbm_tpu.fleet.slo import full_forest_affordable
+from lightgbm_tpu.log import LightGBMError
+from lightgbm_tpu.serving import MicroBatcher, ServingApp
+from lightgbm_tpu.serving.cascade import (CascadeConfig,
+                                          resolve_prefix_iterations,
+                                          served_delta_bound)
+
+RNG = np.random.RandomState(17)
+
+
+def _train(objective="binary", num_class=1, n=600, nfeat=6, rounds=24):
+    """Strongly separable data: most rows sit far from the decision
+    boundary, so a short prefix already pins their answer — the regime
+    the band exit is built for."""
+    X = RNG.randn(n, nfeat).astype(np.float32)
+    margin = 2.5 * X[:, 0] + 1.5 * X[:, 1]
+    if objective == "regression":
+        y = margin + 0.1 * RNG.randn(n).astype(np.float32)
+    elif num_class > 1:
+        y = (np.abs(margin) * 1.2).astype(int) % num_class
+    else:
+        y = margin > 0
+    params = {"objective": objective, "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "learning_rate": 0.1}
+    if num_class > 1:
+        params["num_class"] = num_class
+    return lgb.train(params, lgb.Dataset(X, y.astype(np.float32)),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    return _train()
+
+
+@pytest.fixture(scope="module")
+def multiclass_booster():
+    return _train(objective="multiclass", num_class=3, rounds=12)
+
+
+@pytest.fixture(scope="module")
+def regression_booster():
+    return _train(objective="regression", rounds=12)
+
+
+# ---------------------------------------------------------------------------
+# Tail-bound soundness + served_delta_bound math (pure host, no server)
+# ---------------------------------------------------------------------------
+def test_tail_bound_sound_for_every_prefix(binary_booster,
+                                           multiclass_booster,
+                                           regression_booster):
+    """|full raw - prefix raw| <= tail_bound(K, n) per class, for a
+    spread of prefix lengths on all three objective shapes.  Tolerance
+    covers f32 device summation noise only — the bound itself is f64
+    and exact over leaf values."""
+    X = RNG.randn(128, 6).astype(np.float32)
+    for booster in (binary_booster, multiclass_booster, regression_booster):
+        pred = booster.to_compiled(buckets=(128,))
+        n = booster.current_iteration()
+        full = np.asarray(pred.predict(X, raw_score=True), np.float64)
+        for k in sorted({1, n // 4, n // 2, n - 1, n}):
+            tail = pred.tail_bound(k, n)            # [num_class] f64
+            prefix = np.asarray(
+                pred.predict(X, num_iteration=k, raw_score=True),
+                np.float64)
+            diff = np.abs(full - prefix)
+            bound = tail if diff.ndim == 2 else float(tail.max())
+            assert np.all(diff <= bound * (1 + 1e-5) + 1e-5), (
+                booster.params.get("objective"), k,
+                float(np.max(diff - bound)))
+        assert float(pred.tail_bound(n, n).max()) == 0.0
+
+
+def test_served_delta_bound_raw_kind_is_tail_max():
+    raw = RNG.randn(16, 3)
+    tail = np.array([0.5, 2.0, 1.25])
+    out = served_delta_bound(raw, tail, "multiclass", kind="raw")
+    assert out.shape == (16,)
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_softmax_bracket_dominates_random_perturbations():
+    """The softmax served-delta bound must dominate |p(raw+d) - p(raw)|
+    for every perturbation with |d_c| <= tail_c — checked against a
+    Monte-Carlo sweep of corner-ish perturbations."""
+    def softmax(z):
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+    raw = RNG.randn(64, 4) * 3.0
+    tail = np.abs(RNG.randn(4)) + 0.05
+    bound = served_delta_bound(raw, tail, "multiclass", kind="prob")
+    base = softmax(raw)
+    for _ in range(50):
+        d = (RNG.randint(0, 2, size=raw.shape) * 2 - 1) * tail
+        d *= RNG.uniform(0.0, 1.0, size=(raw.shape[0], 1))
+        delta = np.abs(softmax(raw + d) - base).max(axis=1)
+        assert np.all(delta <= bound + 1e-9)
+
+
+def test_resolve_prefix_iterations_edges():
+    assert resolve_prefix_iterations(100, 0) == 25     # auto = quarter
+    assert resolve_prefix_iterations(2, 0) == 1        # floor at 1
+    assert resolve_prefix_iterations(3, -7) == 1       # negative = auto
+    assert resolve_prefix_iterations(10, 7) == 7
+    assert resolve_prefix_iterations(10, 99) == 10     # clamp to n
+
+
+def test_cascade_config_validates_mode():
+    assert not CascadeConfig(mode="off").enabled
+    assert CascadeConfig(mode="band").enabled
+    assert CascadeConfig(mode="deadline").enabled
+    with pytest.raises(LightGBMError):
+        CascadeConfig(mode="sometimes")
+
+
+# ---------------------------------------------------------------------------
+# predict_cascade on the compiled predictor
+# ---------------------------------------------------------------------------
+def test_band_infinity_is_bit_identical(binary_booster, multiclass_booster):
+    """epsilon<=0 means band=infinity: no row can exit, every row is
+    served by the SAME full-range program plain predict uses, so the
+    two arms are np.array_equal — not merely allclose."""
+    X = RNG.randn(200, 6).astype(np.float32)
+    for booster in (binary_booster, multiclass_booster):
+        pred = booster.to_compiled(buckets=(256,))
+        for raw in (False, True):
+            plain = pred.predict(X, raw_score=raw)
+            out, info = pred.predict_cascade(X, epsilon=0.0, raw_score=raw)
+            assert np.array_equal(np.asarray(out), np.asarray(plain))
+            assert info["n_exited"] == 0 and not info["exited"].any()
+
+
+def test_band_exits_honor_epsilon(binary_booster):
+    """With separable data a 75% prefix exits a healthy fraction of
+    rows; every exited row's served answer is within epsilon of the
+    full-forest answer and every completed row is bit-identical."""
+    X = RNG.randn(400, 6).astype(np.float32)
+    pred = binary_booster.to_compiled(buckets=(512,))
+    n = binary_booster.current_iteration()
+    k, eps = (3 * n) // 4, 0.25
+    out, info = pred.predict_cascade(X, prefix_iterations=k, epsilon=eps)
+    full = np.asarray(pred.predict(X), np.float64)
+    exited = info["exited"]
+    assert info["prefix_iterations"] == k
+    assert info["n_exited"] > 0, "separable data should exit some rows"
+    assert info["n_exited"] + info["completed"] == X.shape[0]
+    # exit decision is exactly the band test, nothing fuzzier
+    np.testing.assert_array_equal(exited, info["delta_bound"] <= eps)
+    assert np.all(np.abs(np.asarray(out, np.float64) - full)[exited]
+                  <= eps + 1e-9)
+    assert np.array_equal(np.asarray(out)[~exited],
+                          np.asarray(pred.predict(X))[~exited])
+
+
+def test_force_prefix_serves_every_row_from_prefix(binary_booster):
+    X = RNG.randn(64, 6).astype(np.float32)
+    pred = binary_booster.to_compiled(buckets=(64,))
+    out, info = pred.predict_cascade(X, prefix_iterations=6, epsilon=0.0,
+                                     force_prefix=True)
+    assert info["exited"].all() and info["completed"] == 0
+    # served answer is the host-f64 link of the prefix raw scores
+    ref = pred.predict(X, num_iteration=6)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_average_output_model_refuses_cascade(binary_booster):
+    """Random-forest averaging has no additive suffix bound; the
+    predictor must refuse rather than publish a wrong band."""
+    pred = binary_booster.to_compiled(buckets=(8,))
+    pred._average_output = True
+    try:
+        with pytest.raises(LightGBMError):
+            pred.predict_cascade(np.zeros((2, 6), np.float32))
+    finally:
+        pred._average_output = False
+
+
+# ---------------------------------------------------------------------------
+# MicroBatcher row_meta scatter
+# ---------------------------------------------------------------------------
+def test_microbatcher_slices_row_meta_per_request():
+    """A flush meta carrying row_meta arrays is sliced per request, so
+    coalesced neighbours never see each other's exit flags.  The fake
+    derives meta from row CONTENT, making the check independent of how
+    requests happen to coalesce into flushes."""
+    class Fake:
+        def predict(self, X):
+            col = np.asarray(X)[:, 0].astype(np.float64)
+            return col, {"version": 7, "prefix_iterations": 4,
+                         "row_meta": {"tag": col * 2.0,
+                                      "exited": col > 0}}
+
+    with MicroBatcher(Fake(), max_wait_ms=1) as mb:
+        blocks = [RNG.randn(n, 3).astype(np.float32) for n in (3, 5, 2)]
+        futs = [mb.submit(b) for b in blocks]
+        for b, f in zip(blocks, futs):
+            out, meta = f.result(timeout=30)
+            col = b[:, 0].astype(np.float64)
+            np.testing.assert_array_equal(out, col)
+            assert meta["version"] == 7
+            np.testing.assert_array_equal(meta["row_meta"]["tag"], col * 2)
+            np.testing.assert_array_equal(meta["row_meta"]["exited"],
+                                          col > 0)
+
+
+# ---------------------------------------------------------------------------
+# ServingApp: band responses, degrade responses, off = unchanged shape
+# ---------------------------------------------------------------------------
+def test_app_band_mode_flags_and_counts_exits(binary_booster):
+    n_trees = binary_booster.current_iteration()
+    app = ServingApp(max_wait_ms=1, cascade_mode="band",
+                     cascade_prefix_trees=(3 * n_trees) // 4,
+                     cascade_epsilon=0.25)
+    try:
+        app.registry.publish("m", booster=binary_booster, warmup=False)
+        X = RNG.randn(32, 6)
+        status, body = app.handle("POST", "/v1/models/m:predict",
+                                  {"rows": X.tolist()})
+        assert status == 200
+        assert body["degraded"] is False
+        assert len(body["exited_early"]) == 32
+        assert body["prefix_iterations"] == (3 * n_trees) // 4
+        snap = app.metrics.model("m").snapshot()
+        assert snap["early_exits"] == sum(body["exited_early"])
+        assert snap["degraded"] == 0
+        if snap["early_exits"]:
+            assert 0.0 < snap["exit_fraction"] <= 1.0
+    finally:
+        app.close()
+
+
+def test_app_degrade_body_serves_prefix_and_counts(binary_booster):
+    app = ServingApp(max_wait_ms=1, cascade_mode="deadline",
+                     cascade_prefix_trees=6, cascade_epsilon=0.0)
+    try:
+        app.registry.publish("m", booster=binary_booster, warmup=False)
+        X = RNG.randn(8, 6)
+        status, body = app.handle("POST", "/v1/models/m:predict",
+                                  {"rows": X.tolist(), "degrade": True})
+        assert status == 200
+        assert body["degraded"] is True
+        assert body["prefix_iterations"] == 6
+        assert all(body["exited_early"])
+        snap = app.metrics.model("m").snapshot()
+        assert snap["degraded"] == 1
+        assert snap["early_exits"] == 8
+    finally:
+        app.close()
+
+
+def test_app_cascade_off_response_shape_unchanged(binary_booster):
+    """cascade_mode=off must be invisible on the wire: no degraded /
+    exited_early keys, and a stray degrade=true body key is ignored."""
+    app = ServingApp(max_wait_ms=1)
+    try:
+        app.registry.publish("m", booster=binary_booster, warmup=False)
+        X = RNG.randn(4, 6)
+        for body_in in ({"rows": X.tolist()},
+                        {"rows": X.tolist(), "degrade": True}):
+            status, body = app.handle("POST", "/v1/models/m:predict",
+                                      body_in)
+            assert status == 200
+            assert "degraded" not in body and "exited_early" not in body
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# Router deadline degrade (fake replicas, no sockets)
+# ---------------------------------------------------------------------------
+def test_full_forest_affordable():
+    assert full_forest_affordable(0.05, 0.0)           # no evidence yet
+    assert full_forest_affordable(0.05, -1.0)
+    assert full_forest_affordable(0.6, 500.0)
+    assert not full_forest_affordable(0.4, 500.0)
+    assert not full_forest_affordable(0.6, 500.0, safety=2.0)
+
+
+class _FakeReplica:
+    """Minimal transport-free replica: records every forwarded predict
+    body so tests can assert what the router actually sent."""
+
+    def __init__(self, name):
+        self.name = name
+        self.bodies = []
+
+    def health(self, timeout_s=2.0):
+        return {"p99_ms": 1.0, "queue_rows": 0, "inflight_rows": 0,
+                "batch_fill": 0.5, "boot_s": 1.0}
+
+    def request(self, method, path, body=None, timeout_s=None):
+        if path.endswith(":predict"):
+            self.bodies.append(dict(body or {}))
+            n = len(body["rows"])
+            return 200, {"name": "m", "version": 1,
+                         "predictions": [0.0] * n,
+                         "degraded": bool(body.get("degrade", False))}
+        return 404, {"error": "no route"}
+
+
+def _seed_p99(router, name, seconds, n=24):
+    mm = router._model_stats(name)
+    for _ in range(n):
+        mm.window.observe(seconds)
+    return mm.window.percentiles()["p99_ms"]
+
+
+def test_router_degrades_unaffordable_deadline_instead_of_504():
+    """deadline cascade: a live-but-too-small budget (p99 evidence says
+    the full forest won't fit) is forwarded degrade=true and answered
+    200, and the degrade is counted — NOT refused 504."""
+    rep = _FakeReplica("a")
+    r = FleetRouter([rep], poll_interval_ms=0, autostart=False,
+                    policy=SLOPolicy(), hedge_min_ms=1.0,
+                    cascade_mode="deadline")
+    r.poll_once()
+    p99 = _seed_p99(r, "m", 0.5)
+    assert p99 >= 400.0
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]], "deadline_ms": 50.0})
+    assert status == 200 and body["degraded"] is True
+    assert rep.bodies[-1].get("degrade") is True
+    assert r.registry.snapshot()["lgbm_fleet_degraded_total"]["_"] == 1
+
+
+def test_router_ample_budget_never_degrades():
+    rep = _FakeReplica("a")
+    r = FleetRouter([rep], poll_interval_ms=0, autostart=False,
+                    policy=SLOPolicy(), hedge_min_ms=1.0,
+                    cascade_mode="deadline")
+    r.poll_once()
+    _seed_p99(r, "m", 0.001)       # p99 ~ 1ms, budget 5s: affordable
+    status, body = r.handle("POST", "/v1/models/m:predict",
+                            {"rows": [[0.0]], "deadline_ms": 5000.0})
+    assert status == 200
+    assert not rep.bodies[-1].get("degrade", False)
+    assert r.registry.snapshot()["lgbm_fleet_degraded_total"]["_"] == 0
+
+
+def test_router_cascade_off_keeps_504_semantics():
+    """Without opt-in the router must keep refusing: degrade only
+    happens when cascade_mode=deadline is configured."""
+    rep = _FakeReplica("a")
+    r = FleetRouter([rep], poll_interval_ms=0, autostart=False,
+                    policy=SLOPolicy(), hedge_min_ms=1.0)
+    r.poll_once()
+    _seed_p99(r, "m", 0.5)
+    status, _ = r.handle("POST", "/v1/models/m:predict",
+                         {"rows": [[0.0]], "deadline_ms": 50.0})
+    assert status == 200                  # fake replica answers instantly
+    assert not rep.bodies[-1].get("degrade", False)
+    assert r.registry.snapshot()["lgbm_fleet_degraded_total"]["_"] == 0

@@ -53,6 +53,10 @@ def test_binary_example_data_quality(binary_data):
     assert auc > 0.79
 
 
+@pytest.mark.slow   # tier-1 budget (28s): the l2 objective trains in
+# tier-1 all over test_constraints/test_extras/test_linear_tree (same
+# "regression" params at fewer rounds); quality bars stay via
+# test_binary here and golden-model checks in test_consistency
 def test_regression(regression_data):
     X_train, y_train, X_test, y_test = regression_data
     params = {"objective": "regression", "metric": "l2", "verbosity": -1}

@@ -861,19 +861,23 @@ def test_fleet_and_serving_params_documented():
     with open(readme, encoding="utf-8") as fh:
         text = fh.read()
     scoped = [p for p in _PARAMS
-              if p.name.startswith(("fleet_", "serving_"))]
-    assert len(scoped) >= 31      # the guard guards something real
+              if p.name.startswith(("fleet_", "serving_", "cascade_"))]
+    assert len(scoped) >= 34      # the guard guards something real
     # ISSUE-16: the multi-tenant control plane shipped its own knob
     # families — placement + autoscaling must stay covered by this guard
     ctrl = [p.name for p in scoped if p.name.startswith(
         ("fleet_placement", "fleet_autoscale", "fleet_max_models"))]
     assert len(ctrl) >= 12, ctrl
+    # ISSUE-17: the early-exit cascade's knob family
+    casc = [p.name for p in scoped if p.name.startswith("cascade_")]
+    assert len(casc) >= 3, casc
     missing_desc = [p.name for p in scoped if not (p.desc or "").strip()]
     assert not missing_desc, (
-        f"fleet_*/serving_* params without a desc: {missing_desc}")
+        f"fleet_*/serving_*/cascade_* params without a desc: "
+        f"{missing_desc}")
     missing_doc = [p.name for p in scoped if p.name not in text]
     assert not missing_doc, (
-        f"fleet_*/serving_* params not mentioned in README.md: "
+        f"fleet_*/serving_*/cascade_* params not mentioned in README.md: "
         f"{missing_doc}")
 
 
@@ -930,7 +934,9 @@ def test_metric_families_and_trace_params_documented():
                 continue
             with open(os.path.join(dirpath, fn), encoding="utf-8") as fh:
                 registered |= set(reg_call.findall(fh.read()))
-    assert len(registered) >= 40      # the guard guards something real
+    # ISSUE-17 raised the floor: the cascade added the early-exit /
+    # degraded / exit-fraction / program-cache families
+    assert len(registered) >= 45      # the guard guards something real
     with open(os.path.join(root, "README.md"), encoding="utf-8") as fh:
         readme = fh.read()
 
@@ -963,3 +969,50 @@ def test_metric_families_and_trace_params_documented():
     assert not missing_doc, (
         f"trace_*/telemetry_* params not mentioned in README.md: "
         f"{missing_doc}")
+
+
+def test_degraded_paths_always_counted():
+    """ISSUE-17 static guard: a degraded (prefix-only) answer that isn't
+    counted is invisible to operators — the whole point of degrading
+    instead of 504ing is that it shows up on dashboards.  Every function
+    in lightgbm_tpu/ that sets a degraded/degrade flag true (response
+    field, trace attribute, or forwarded body) must also increment a
+    degraded counter (record_degraded() -> lgbm_serving_degraded_total,
+    or the router's _m_degraded -> lgbm_fleet_degraded_total) in that
+    same function."""
+    import ast
+    import os
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(root, "lightgbm_tpu")
+    setter = re.compile(
+        r'(?:["\']degraded?["\']\s*\]?\s*[:=]\s*True'   # dict/body field
+        r'|\bdegraded?\s*=\s*True)')                    # flag assignment
+    counted = re.compile(r"record_degraded\(|_degraded\.inc\(")
+    offenders, found = [], 0
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            if "degrade" not in src:
+                continue
+            lines = src.splitlines()
+            for node in ast.walk(ast.parse(src)):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                fsrc = "\n".join(lines[node.lineno - 1:node.end_lineno])
+                if setter.search(fsrc):
+                    found += 1
+                    if not counted.search(fsrc):
+                        offenders.append(
+                            f"{os.path.relpath(path, root)}:{node.name}")
+    # the guard must actually see the two known degrade sites (replica
+    # direct path + router deadline decision) or it is scanning nothing
+    assert found >= 2, found
+    assert not offenders, (
+        f"functions set degraded=true without counting it: {offenders}")

@@ -385,6 +385,10 @@ def _build_fleet(tmp_path, rank_timeout_s, barrier_timeout_s):
     return src, svcs, apps
 
 
+@pytest.mark.slow   # tier-1 budget (50s): the quorum-commit decision
+# matrix stays covered by test_timeout_without_quorum_aborts_cleanly and
+# test_two_worker_fleet_stall_quorum_and_replay (stall + replay end to
+# end); quorum voting itself by the three test_quorum_vote_* tests
 def test_quorum_commit_requeue_and_rejoin(tmp_path, monkeypatch):
     # generous deadline for the compile-heavy warm-up cycle (thread
     # skew between ranks counts against the barrier wait), tightened
