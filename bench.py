@@ -130,6 +130,24 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  ladder programs).  CPU by design: topology claims.
                  Knobs: BENCH_CASCADE_{TREES,THREADS,SECONDS,
                  STORM_THREADS,STORM_ROWS,TRAIN_ROWS,EPSILON}.
+- explain        explanation serving tier proof (run_explain): device
+                 kind="contrib" output vs the host pred_contrib path
+                 (parity + rows-sum-to-raw + zero post-warmup compiles
+                 across ladder-straddling batch sizes), then two
+                 replica PROCESSES behind the router serving concurrent
+                 :explain and :predict traffic, each verb carrying a
+                 deadline from its OWN SLO class.  Bars (vs_baseline
+                 1.0 iff all hold): host parity, ZERO failed requests
+                 on both verbs, explain p99 under the explain deadline,
+                 the lgbm_fleet_explain_* family counted separately
+                 from predict, ZERO compiles after the explain_warmup
+                 publishes, and the early-warning probe: a covariate
+                 shift injected into the UNLABELED feature stream fires
+                 the AttributionSketch alarm in a strictly earlier
+                 cycle than the labeled AUC gate's first breach (labels
+                 arrive delayed).  CPU by design: topology claims.
+                 Knobs: BENCH_EXPLAIN_{TREES,THREADS,PREDICT_THREADS,
+                 SECONDS,TRAIN_ROWS,MAX_REQ_ROWS,LABEL_DELAY}.
 - multitenant    multi-tenant control-plane soak (run_multitenant): a
                  few trained boosters published under 100+ tenant names
                  onto 2 supervised replica PROCESSES behind an
@@ -1999,6 +2017,357 @@ def run_cascade():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def run_explain():
+    """Child body for BENCH_STAGE=explain: the explanation serving tier
+    proof (lightgbm_tpu/explain/).
+
+    Correctness first, in-process on a compiled predictor: the
+    kind="contrib" device program must match the host pred_contrib path
+    within f32 honesty, every row must sum to the raw score, and
+    post-warmup contrib traffic across ladder-straddling batch sizes
+    must compile ZERO new programs (path tables ride the shared
+    tree-bucket ladder).
+
+    Then the serving soak: two replica processes with explain_warmup=on
+    behind the fleet router, concurrent :explain and :predict clients,
+    each verb carrying a deadline sized from its OWN healthy p50 — the
+    explain lane is a separate SLO class, not a tax on predict.  Bars:
+    zero failed requests on both verbs, explain p99 under the explain
+    deadline, the lgbm_fleet_explain_* family populated separately from
+    the predict family, and zero compiles after the publish warmups.
+
+    Last, the attribution early-warning probe: a covariate shift (the
+    driving feature pinned at the decision boundary, collapsing its
+    attributions) enters the UNLABELED feature stream at a known cycle
+    while labels arrive delayed.  The AttributionSketch alarm — which
+    needs no labels — must fire in a strictly earlier cycle than the
+    labeled AUC gate's first breach: the window where explanations warn
+    before quality metrics can."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.fleet import (FleetRouter, FleetSupervisor,
+                                    HttpReplica, SLOPolicy,
+                                    default_replica_argv)
+
+    ex_threads = int(os.environ.get("BENCH_EXPLAIN_THREADS", 3))
+    pr_threads = int(os.environ.get("BENCH_EXPLAIN_PREDICT_THREADS", 2))
+    rounds = int(os.environ.get("BENCH_EXPLAIN_TREES", 128))
+    train_rows = int(os.environ.get("BENCH_EXPLAIN_TRAIN_ROWS", 8_000))
+    phase_s = float(os.environ.get("BENCH_EXPLAIN_SECONDS", 4.0))
+    max_req_rows = int(os.environ.get("BENCH_EXPLAIN_MAX_REQ_ROWS", 8))
+    label_delay = int(os.environ.get("BENCH_EXPLAIN_LABEL_DELAY", 2))
+
+    X, y = synth_binary(train_rows, seed=18)
+    params = {"objective": "binary", "num_leaves": 31, "learning_rate": 0.1,
+              "verbosity": -1, "max_bin": MAX_BIN, "min_data_in_leaf": 20}
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_explain_")
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+    model_path = os.path.join(tmp, "model.txt")
+    bst.save_model(model_path)
+
+    # --- in-process probe: parity, sum-to-raw, warm-ladder compiles --
+    pred = bst.to_compiled()
+    pred.warmup(kinds=("prob", "contrib"))
+    probe = np.random.RandomState(7).randn(256, N_FEATURES)
+    probe[:13, 3] = np.nan     # missing-value routing on the device path
+    host = np.asarray(bst.predict(probe, pred_contrib=True))
+    dev = np.asarray(pred.predict(probe, pred_contrib=True))
+    parity_delta = float(np.max(np.abs(host - dev)))
+    raw = np.asarray(pred.predict(probe, raw_score=True), np.float64)
+    sum_delta = float(np.max(np.abs(dev.sum(axis=-1) - raw)))
+    compiles0 = pred.compile_count
+    for n in (1, 7, 33, probe.shape[0]):     # straddle ladder rungs
+        pred.predict(probe[:n], pred_contrib=True)
+    warm_compiles = pred.compile_count - compiles0
+    probe_bars = {
+        "host_parity": bool(parity_delta <= 5e-6),
+        "rows_sum_to_raw": bool(sum_delta <= 5e-6),
+        "zero_warm_ladder_compiles": bool(warm_compiles == 0),
+    }
+
+    pool = np.random.RandomState(1).randn(4096, N_FEATURES).astype(np.float64)
+
+    def drive(router, seconds, seed0, threads, verb, deadline_ms=None):
+        stop = time.time() + seconds
+        lat = [[] for _ in range(threads)]
+        stat = [{} for _ in range(threads)]
+        rows_served = [0] * threads
+
+        def client(i):
+            r = np.random.RandomState(seed0 + i)
+            while time.time() < stop:
+                n = int(r.randint(1, max_req_rows + 1))
+                lo = int(r.randint(0, pool.shape[0] - n))
+                body = {"rows": pool[lo:lo + n].tolist()}
+                if deadline_ms is not None:
+                    body["deadline_ms"] = deadline_ms
+                t0 = time.perf_counter()
+                status, _ = router.handle(
+                    "POST", f"/v1/models/default:{verb}", body)
+                lat[i].append(time.perf_counter() - t0)
+                stat[i][status] = stat[i].get(status, 0) + 1
+                if status == 200:
+                    rows_served[i] += n
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(seconds + 120)
+        statuses: dict = {}
+        for s in stat:
+            for k, v in s.items():
+                statuses[k] = statuses.get(k, 0) + v
+        return statuses, sorted(x for part in lat for x in part), \
+            sum(rows_served)
+
+    def p99_ms(lat):
+        if not lat:
+            return 0.0
+        return lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+
+    def fleet_compiles(replicas):
+        total = 0
+        for rep in replicas:
+            _, metrics = rep.request("GET", "/v1/metrics")
+            total += sum(m.get("compile_count", 0)
+                         for m in metrics.values() if isinstance(m, dict))
+        return total
+
+    replica_params = {"input_model": model_path, "verbosity": "-1",
+                      "serving_max_wait_ms": "2",
+                      "serving_max_batch": "256",
+                      "serving_max_queue_rows": "2048",
+                      "explain_max_wait_ms": "2",
+                      "explain_max_batch": "256",
+                      "explain_warmup": "true"}
+
+    soak = {}
+    ports = find_open_ports(2)
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(replica_params, port),
+        ports, log_dir=os.path.join(tmp, "logs"),
+        max_restarts=2, restart_backoff_s=0.5)
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=min(
+            180.0, max(deadline - time.time() - 120.0, 30.0)))
+        sup.start_watching(interval_s=0.2)
+        replicas = [HttpReplica(u) for u in sup.urls]
+        with FleetRouter(replicas, policy=SLOPolicy(recover_polls=1),
+                         poll_interval_ms=50) as r:
+            # warm both verbs CONCURRENTLY and size each verb's
+            # deadline from ITS healthy p50 under mixed traffic — the
+            # explain lane is its own SLO class (~depth^2-heavier
+            # work), and predict's honest budget must absorb the
+            # head-of-line device occupancy of explain batches it will
+            # share replicas with during the measured phase
+            warm: dict = {}
+
+            def warm_drive(verb, seed0, threads):
+                warm[verb] = drive(r, 2.0, seed0, threads, verb)
+
+            w_ex = threading.Thread(target=warm_drive,
+                                    args=("explain", 200, ex_threads))
+            w_pr = threading.Thread(target=warm_drive,
+                                    args=("predict", 100, pr_threads))
+            w_ex.start()
+            w_pr.start()
+            w_ex.join(240)
+            w_pr.join(240)
+            _, lat_wp, _ = warm["predict"]
+            _, lat_we, _ = warm["explain"]
+            # p99-based: under mixed traffic the tail is bimodal (a
+            # predict landing behind a full explain batch inherits its
+            # device occupancy), so a p50 multiple undersizes the
+            # budget a co-located verb can actually honor
+            dl_predict = max(4.0 * p99_ms(lat_wp), 120.0)
+            dl_explain = max(4.0 * p99_ms(lat_we), 200.0)
+            compiles_warm = fleet_compiles(replicas)
+
+            # measured phase: both verbs concurrently on the same fleet
+            out: dict = {}
+
+            def measured(verb, seed0, threads, dl):
+                out[verb] = drive(r, phase_s, seed0, threads, verb,
+                                  deadline_ms=dl)
+
+            t_ex = threading.Thread(
+                target=measured, args=("explain", 300, ex_threads,
+                                       dl_explain))
+            t_pr = threading.Thread(
+                target=measured, args=("predict", 400, pr_threads,
+                                       dl_predict))
+            t0 = time.time()
+            t_ex.start()
+            t_pr.start()
+            t_ex.join(phase_s + 240)
+            t_pr.join(phase_s + 240)
+            elapsed = max(time.time() - t0, 1e-9)
+
+            stat_e, lat_e, rows_e = out["explain"]
+            stat_p, lat_p, rows_p = out["predict"]
+            snap = r.registry.snapshot()
+            fam_e = snap.get("lgbm_fleet_explain_requests_total", {})
+            fam_p = snap.get("lgbm_fleet_requests_total", {})
+            soak = {
+                "explain_statuses": {str(k): v for k, v in stat_e.items()},
+                "predict_statuses": {str(k): v for k, v in stat_p.items()},
+                "failed_requests": sum(
+                    v for st in (stat_e, stat_p)
+                    for k, v in st.items() if k != 200),
+                "explain_rows_per_s": round(rows_e / elapsed, 1),
+                "predict_rows_per_s": round(rows_p / elapsed, 1),
+                "explain_p99_ms": round(p99_ms(lat_e), 1),
+                "predict_p99_ms": round(p99_ms(lat_p), 1),
+                "explain_deadline_ms": round(dl_explain, 1),
+                "predict_deadline_ms": round(dl_predict, 1),
+                "router_explain_requests": float(
+                    fam_e.get("model=default", 0.0)),
+                "router_predict_requests": float(
+                    fam_p.get("model=default", 0.0)),
+                "compiles_after_warmup":
+                    fleet_compiles(replicas) - compiles_warm,
+            }
+    finally:
+        sup.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # --- attribution early-warning probe vs the labeled AUC gate -----
+    early = _explain_early_warning_probe(label_delay)
+
+    bars = dict(probe_bars)
+    bars.update({
+        "zero_failed_requests": bool(soak.get("failed_requests", 1) == 0),
+        "explain_p99_under_deadline": bool(
+            soak.get("explain_p99_ms", 1e9)
+            < soak.get("explain_deadline_ms", 0.0)),
+        "explain_family_isolated": bool(
+            soak.get("router_explain_requests", 0.0) > 0
+            and soak.get("router_predict_requests", 0.0) > 0),
+        "zero_post_warmup_compiles": bool(
+            soak.get("compiles_after_warmup", 1) == 0),
+        "attrib_alarm_before_auc_gate": bool(
+            early["attrib_alarm_cycle"] is not None
+            and early["auc_breach_cycle"] is not None
+            and early["attrib_alarm_cycle"] < early["auc_breach_cycle"]),
+    })
+    result = {
+        "metric": f"explain_2replicas_{rounds}trees_{ex_threads}threads",
+        "value": soak.get("explain_rows_per_s", 0.0),
+        "unit": "explain_rows_per_s",
+        "vs_baseline": 1.0 if all(bars.values()) else 0.0,
+        "bars": bars,
+        "contrib_parity_delta": parity_delta,
+        "contrib_sum_to_raw_delta": sum_delta,
+        "warm_ladder_compiles": warm_compiles,
+        "soak": soak,
+        "early_warning": early,
+        "setup_s": round(time.time() - t_start, 1),
+        "backend": backend,
+    }
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
+def _explain_early_warning_probe(label_delay):
+    """The probe behind the explain stage's headline claim: attribution
+    drift warns BEFORE the labeled AUC gate can.
+
+    A model whose signal lives in feature 0 serves cycles of unlabeled
+    traffic; at a known cycle the stream's covariate collapses (feature
+    0 pinned at the decision boundary — outcomes decouple from the
+    model's learned signal).  The AttributionSketch watches every
+    cycle's features as they arrive; the AUC gate can only score a
+    cycle once its labels land, ``label_delay`` cycles later.  Reports
+    the first alarm cycle of each watcher."""
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.continuous.gate import PublishGate
+    from lightgbm_tpu.serving.registry import ModelRegistry
+    from lightgbm_tpu.telemetry.registry import MetricsRegistry
+
+    rng = np.random.RandomState(0)
+    nf, window, shift_cycle, n_cycles = 5, 300, 4, 8
+    auc_floor = 0.75
+
+    def batch(shifted):
+        Xc = rng.randn(window, nf)
+        if shifted:
+            Xc[:, 0] = 0.0      # pin the driver at the boundary
+        yc = (Xc[:, 0] + 0.3 * rng.randn(window) > 0).astype(np.float64)
+        return Xc, yc
+
+    Xt = rng.randn(3000, nf)
+    yt = (Xt[:, 0] + 0.3 * rng.randn(3000) > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(Xt.astype(np.float32), yt),
+                    num_boost_round=10)
+    mstr = bst.model_to_string()
+
+    gate = PublishGate(ModelRegistry(), "probe", min_auc=auc_floor,
+                       metrics_registry=MetricsRegistry(),
+                       attrib_threshold=0.3, attrib_sample=256,
+                       attrib_gate=False)
+    ev = gate.consider(mstr, 0.95, cycle=-1)
+    assert ev["action"] == "publish", ev
+
+    labeled: list = []           # (cycle, X, y) waiting for labels
+    attrib_cycle = auc_cycle = None
+    cycles = []
+    for c in range(n_cycles):
+        Xc, yc = batch(shifted=c >= shift_cycle)
+        labeled.append((c, Xc, yc))
+        # label-free watcher sees cycle c's features NOW
+        alarm = gate.watch_attribution(Xc)
+        if alarm is not None and attrib_cycle is None:
+            attrib_cycle = c
+        # the labeled gate can only see the batch from label_delay ago
+        auc = None
+        if c - label_delay >= 0:
+            _, Xl, yl = labeled[c - label_delay]
+            auc = float(roc_auc_score(yl, bst.predict(Xl)))
+            ev = gate.consider(mstr, auc, cycle=c)
+            if ev["action"] == "reject" and auc_cycle is None:
+                auc_cycle = c
+        cycles.append({
+            "cycle": c,
+            "shifted": bool(c >= shift_cycle),
+            "attrib_score": round(float(gate.sketch.max_score()), 4)
+            if gate.sketch is not None else None,
+            "attrib_alarm": bool(alarm is not None),
+            "labeled_auc": round(auc, 4) if auc is not None else None,
+        })
+    return {
+        "shift_cycle": shift_cycle,
+        "label_delay": label_delay,
+        "attrib_alarm_cycle": attrib_cycle,
+        "auc_breach_cycle": auc_cycle,
+        "lead_cycles": (auc_cycle - attrib_cycle
+                        if attrib_cycle is not None
+                        and auc_cycle is not None else None),
+        "cycles": cycles,
+    }
+
+
 def _continuous_incremental_phase(params, tmp):
     """Growing-pool probe for the incremental dataset pipeline (ISSUE 10):
     N stationary cycles, each ingesting one fresh segment into the
@@ -3100,6 +3469,8 @@ if __name__ == "__main__":
             run_multitenant()
         elif stage == "cascade":
             run_cascade()
+        elif stage == "explain":
+            run_explain()
         elif stage == "continuous":
             run_continuous()
         elif stage == "continuous_sharded":

@@ -256,7 +256,12 @@ class Application:
                          default_deadline_ms=cfg.serving_default_deadline_ms,
                          cascade_mode=cfg.cascade_mode,
                          cascade_prefix_trees=cfg.cascade_prefix_trees,
-                         cascade_epsilon=cfg.cascade_epsilon)
+                         cascade_epsilon=cfg.cascade_epsilon,
+                         explain_max_batch=cfg.explain_max_batch,
+                         explain_max_wait_ms=cfg.explain_max_wait_ms,
+                         explain_default_deadline_ms=(
+                             cfg.explain_default_deadline_ms),
+                         explain_warmup=bool(cfg.explain_warmup))
         models = [m for m in str(cfg.input_model).split(",") if m]
         names = [n for n in str(cfg.serving_model_name).split(",") if n]
         if len(names) > len(models):
@@ -354,7 +359,12 @@ class Application:
                          default_deadline_ms=cfg.serving_default_deadline_ms,
                          cascade_mode=cfg.cascade_mode,
                          cascade_prefix_trees=cfg.cascade_prefix_trees,
-                         cascade_epsilon=cfg.cascade_epsilon)
+                         cascade_epsilon=cfg.cascade_epsilon,
+                         explain_max_batch=cfg.explain_max_batch,
+                         explain_max_wait_ms=cfg.explain_max_wait_ms,
+                         explain_default_deadline_ms=(
+                             cfg.explain_default_deadline_ms),
+                         explain_warmup=bool(cfg.explain_warmup))
         name = str(cfg.serving_model_name).split(",")[0] or "default"
         bundle = cfg.aot_bundle_dir or None
         shards = int(cfg.continuous_shards or 0)
@@ -428,7 +438,10 @@ class Application:
         gate = PublishGate(app.registry, name,
                            min_auc=cfg.continuous_min_auc,
                            max_regression=cfg.continuous_max_regression,
-                           aot_bundle_dir=bundle)
+                           aot_bundle_dir=bundle,
+                           attrib_threshold=cfg.continuous_attrib_threshold,
+                           attrib_sample=cfg.continuous_attrib_sample,
+                           attrib_gate=bool(cfg.continuous_attrib_gate))
         if cfg.input_model:
             # seed: serving is live (and gated-good) before cycle 0 ends
             from .io.file_io import read_text

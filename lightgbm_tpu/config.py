@@ -300,6 +300,24 @@ _PARAMS: List[ParamSpec] = [
        "0 = band=infinity: every row completes (bit-identical answers, "
        "cascade plumbing exercised); exits count "
        "lgbm_serving_early_exit_total"),
+    # ---- Explanation serving (POST :explain; lightgbm_tpu/explain/) ----
+    _p("explain_max_batch", int, 256, (), ">0",
+       "row cap per device dispatch on the explain lane (its own "
+       "MicroBatcher per model, separate from the predict lane): "
+       "pred_contrib programs cost O(leaves x depth^2) per row, so the "
+       "explain SLO class batches smaller than predict"),
+    _p("explain_max_wait_ms", float, 4.0, (), ">=0",
+       "explain-lane batching window: how long a queued explain request "
+       "may wait for co-riders before its batch flushes"),
+    _p("explain_default_deadline_ms", float, 0.0, (), ">=0",
+       "default deadline applied to explain requests that carry no "
+       "deadline_ms — the explain lane's own SLO class; refusals count "
+       "lgbm_serving_explain_deadline_refused_total.  0 = no default"),
+    _p("explain_warmup", bool, False, (),
+       desc="pre-compile the kind=contrib program ladder at publish, so "
+            "a new version's first explain request pays no compile; off "
+            "by default — replicas that never serve explanations "
+            "shouldn't spend publish latency on it"),
     # ---- Fleet serving (task=serve + fleet_*; lightgbm_tpu/fleet/) ----
     _p("fleet_role", str, "", (), "in:|replica|router",
        "task=serve role: empty = single server (or full fleet launch "
@@ -459,6 +477,23 @@ _PARAMS: List[ParamSpec] = [
     _p("continuous_holdout_fraction", float, 0.2, (), ">0",
        "fraction of ingested rows held out (deterministically, by "
        "global ingest index) for the gate's AUC"),
+    _p("continuous_attrib_threshold", float, 0.0, (), ">=0",
+       "attribution-drift early warning: each cycle the live model "
+       "explains a sample of the fresh holdout rows (pred_contrib) and "
+       "an AttributionSketch tracks the per-feature mean-|phi| profile; "
+       "a debiased shift past this threshold bumps "
+       "lgbm_continuous_attrib_alarm_total.  Label-free, so covariate "
+       "shift fires here cycles before the AUC watch can see it.  "
+       "0 = off"),
+    _p("continuous_attrib_sample", int, 256, (), ">0",
+       "row cap per cycle for the attribution-drift watch's explain "
+       "pass (deterministic strided sample of the fresh holdout) — "
+       "bounds the pred_contrib cost the watch adds to a cycle"),
+    _p("continuous_attrib_gate", bool, False, (),
+       desc="let a pending attribution-drift alarm also REJECT "
+            "candidate publishes (reason attrib-drift) until the "
+            "profile settles back under continuous_attrib_threshold; "
+            "off = warn-only"),
     _p("continuous_max_cycles", int, 0, (), ">=0",
        "stop the service after this many training cycles (0 = run "
        "until killed)"),

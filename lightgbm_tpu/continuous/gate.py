@@ -49,10 +49,24 @@ class PublishGate:
                  aot_bundle_dir: Optional[str] = None,
                  metrics_registry=None,
                  publish_fn=None,
-                 rollback_fn=None):
+                 rollback_fn=None,
+                 attrib_threshold: float = 0.0,
+                 attrib_sample: int = 256,
+                 attrib_gate: bool = False):
         """``registry`` is a serving ``ModelRegistry`` (or None when
         ``publish_fn``/``rollback_fn`` are given — the fleet path, where
-        publish is an HTTP broadcast instead of an in-process call)."""
+        publish is an HTTP broadcast instead of an in-process call).
+
+        ``attrib_threshold`` > 0 arms the attribution-drift early
+        warning (``watch_attribution``): each cycle's fresh holdout rows
+        are explained against the LIVE model and the per-feature
+        mean-|phi| profile is tracked by an ``AttributionSketch``; a
+        debiased shift past the threshold bumps the alarm counter.
+        Unlike the AUC watch it needs NO labels, so it fires as soon as
+        the input distribution moves — typically cycles before enough
+        labeled evidence accumulates for the AUC gate to react.  With
+        ``attrib_gate`` the pending alarm also REJECTS candidate
+        publishes (reason ``attrib-drift``) until the drift subsides."""
         self.registry = registry
         self.model_name = model_name
         self.min_auc = float(min_auc)
@@ -61,6 +75,13 @@ class PublishGate:
         self.aot_bundle_dir = aot_bundle_dir or None
         self._publish_fn = publish_fn
         self._rollback_fn = rollback_fn
+        self.attrib_threshold = float(attrib_threshold)
+        self.attrib_sample = int(attrib_sample)
+        self.attrib_gate = bool(attrib_gate)
+        self.sketch = None              # AttributionSketch, lazy on first X
+        self._attrib_alarm_pending = False
+        self._attrib_booster = None     # cached live-model Booster
+        self._attrib_src: Optional[str] = None
         self.best_auc: Optional[float] = None   # best PUBLISHED AUC ever
         self.live_auc: Optional[float] = None   # publish-time AUC of current
         self._live_model_str: Optional[str] = None
@@ -76,6 +97,11 @@ class PublishGate:
             metrics_registry, "lgbm_continuous_rollback_total",
             "ALARM: published models withdrawn after a post-publish "
             "regression on fresh data")
+        self.m_attrib_alarms = get_counter(
+            metrics_registry, "lgbm_continuous_attrib_alarm_total",
+            "ALARM: attribution-drift early warnings — the live model's "
+            "per-feature mean-|phi| profile on fresh rows shifted past "
+            "continuous_attrib_threshold")
 
     # ------------------------------------------------------------------
     def _record(self, event: Dict) -> Dict:
@@ -109,6 +135,20 @@ class PublishGate:
                 f"from the best published {self.best_auc:.4f}")
             return self._record({"action": "reject", "cycle": cycle,
                                  "auc": auc, "reason": "regression"})
+        if self.attrib_gate and self._attrib_alarm_pending:
+            # the attribution watch says the inputs have moved out from
+            # under the live model; a candidate trained THROUGH that
+            # shift would gate on an AUC measured against a holdout the
+            # drift has already contaminated.  Hold publishes until the
+            # profile settles (the pending flag clears when a later
+            # watch_attribution scores back under the threshold).
+            self.m_rejected.inc()
+            log_warning(
+                f"continuous: cycle {cycle} candidate REJECTED: "
+                "attribution drift alarm pending "
+                f"(threshold {self.attrib_threshold:g})")
+            return self._record({"action": "reject", "cycle": cycle,
+                                 "auc": auc, "reason": "attrib-drift"})
         version = self._publish(candidate_str)
         self.best_auc = auc if self.best_auc is None \
             else max(self.best_auc, auc)
@@ -183,3 +223,55 @@ class PublishGate:
         self._live_model_str = None
         return self._record({"action": "rollback", "auc": fresh,
                              "bound": bound, "restored_version": restored})
+
+    # ------------------------------------------------------------------
+    def watch_attribution(self, X: np.ndarray) -> Optional[Dict]:
+        """Attribution-drift early warning: explain a bounded sample of
+        fresh rows against the LIVE model and feed the per-feature
+        mean-|phi| profile to the sketch.  Needs no labels — covariate
+        shift shows up here the cycle it arrives, while the AUC watch
+        must wait for labeled outcomes to accumulate.  Returns the alarm
+        event when the debiased shift exceeds ``attrib_threshold``
+        (counter bumped, publish gated when ``attrib_gate``), else
+        None."""
+        if self.attrib_threshold <= 0 or self._live_model_str is None:
+            return None
+        X = np.asarray(X)
+        if X.ndim != 2 or not len(X):
+            return None
+        if len(X) > self.attrib_sample:
+            # deterministic strided sample: bounded explain cost per
+            # cycle without an RNG state to persist
+            idx = np.linspace(0, len(X) - 1, self.attrib_sample,
+                              dtype=np.int64)
+            X = X[idx]
+        if self._attrib_booster is None \
+                or self._attrib_src is not self._live_model_str:
+            from ..basic import Booster
+            self._attrib_booster = Booster(model_str=self._live_model_str)
+            self._attrib_src = self._live_model_str
+        bst = self._attrib_booster
+        phi = np.asarray(bst.predict(X, pred_contrib=True))
+        k = max(int(bst.num_model_per_iteration()), 1)
+        f1 = phi.shape[1] // k
+        # collapse class blocks to one |phi| profile per feature; the
+        # bias column carries the expected value, not a feature — drop it
+        abs_phi = np.abs(phi.reshape(len(X), k, f1)).sum(axis=1)[:, :-1]
+        if self.sketch is None:
+            from ..explain import AttributionSketch
+            self.sketch = AttributionSketch(abs_phi.shape[1])
+        self.sketch.observe(abs_phi)
+        score = self.sketch.max_score()
+        if score <= self.attrib_threshold:
+            self._attrib_alarm_pending = False
+            return None
+        self._attrib_alarm_pending = True
+        self.m_attrib_alarms.inc()
+        top = self.sketch.summary()
+        log_warning(
+            f"continuous: ALARM — attribution drift on "
+            f"{self.model_name!r}: max per-feature shift {score:.3f} > "
+            f"threshold {self.attrib_threshold:g} (top: {top})")
+        return self._record({"action": "attrib-alarm", "score": score,
+                             "threshold": self.attrib_threshold,
+                             "top": top})

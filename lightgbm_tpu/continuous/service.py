@@ -117,6 +117,16 @@ class ContinuousService:
         # new candidate's comparison base
         if fresh_hy:
             import numpy as np
+            # attribution early warning BEFORE the AUC watch: it reads
+            # only the feature rows (no labels), so covariate shift is
+            # flagged here the cycle it arrives — and it must score the
+            # model that is still live, before a rollback below swaps it
+            with _trace.child_span("cycle.attrib") as asp:
+                al = self.gate.watch_attribution(np.concatenate(fresh_hX))
+                if asp is not None and al is not None:
+                    asp.set(alarm=True, score=round(al["score"], 4))
+            if al is not None:
+                summary["attrib_alarm"] = al
             with _trace.child_span("cycle.watch") as ws:
                 rb = self.gate.watch(np.concatenate(fresh_hX),
                                      np.concatenate(fresh_hy))

@@ -27,7 +27,6 @@ from typing import List, NamedTuple
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 __all__ = ["predict_contrib"]
 
@@ -152,72 +151,15 @@ def _go_left_matrix(tree, X: np.ndarray) -> np.ndarray:
 @functools.partial(jax.jit, static_argnames=("num_features",))
 def _tree_contrib(go_left, step_node, step_dir, slot_of_step, slot_feat,
                   slot_z, n_slots, leaf_value, fact_w, num_features: int):
-    """phi [N, F+1] for one tree given the row decisions at each node."""
-    L, D = step_node.shape
-    n = go_left.shape[0]
+    """phi [N, F+1] for one tree given the row decisions at each node.
 
-    def per_leaf(leaf_i):
-        nodes = step_node[leaf_i]            # [D]
-        valid = nodes >= 0
-        gl = go_left[:, jnp.clip(nodes, 0, go_left.shape[1] - 1)]  # [N, D]
-        passes = jnp.where(valid[None, :],
-                           gl == step_dir[leaf_i][None, :], True)
-        # o per slot: AND over this slot's steps
-        slot_mask = (slot_of_step[leaf_i][None, :] ==
-                     jnp.arange(D)[:, None]) & valid[None, :]      # [D, D]
-        o = jnp.all(jnp.where(slot_mask[None, :, :], passes[:, None, :],
-                              True), axis=2)                       # [N, D]
-        u = n_slots[leaf_i]
-        slot_valid = jnp.arange(D) < u
-        of = jnp.where(slot_valid[None, :], o.astype(jnp.float32), 0.0)
-        zf = jnp.where(slot_valid, slot_z[leaf_i].astype(jnp.float32), 1.0)
-
-        # poly = prod_j (z_j + o_j t): coefficients [N, D+1]; padded slots
-        # contribute the neutral factor (z=1, o=0)
-        def mul(poly, jo_jz):
-            jo, jz = jo_jz
-            shifted = jnp.concatenate(
-                [jnp.zeros((n, 1), poly.dtype), poly[:, :-1]], axis=1)
-            return poly * jz + shifted * jo[:, None], None
-
-        init = jnp.zeros((n, D + 1), jnp.float32).at[:, 0].set(1.0)
-        poly, _ = jax.lax.scan(mul, init, (of.T, zf))
-
-        w_u = fact_w[u]                                            # [D+1]
-
-        def unwind(i):
-            oi = of[:, i]
-            zi = zf[i]
-            # divide poly by (z_i + o_i t):
-            #   o_i=1: synthetic division top-down  c_{k-1} = p_k - c_k z_i
-            #   o_i=0: plain scale                  c_k = p_k / z_i
-            def div_step(c_prev, k):
-                c = poly[:, k] - c_prev * zi
-                return c, c
-
-            ks = jnp.arange(D, 0, -1)
-            _, cs_o1 = jax.lax.scan(div_step, jnp.zeros((n,)), ks)
-            cs_o1 = jnp.moveaxis(cs_o1, 0, 1)[:, ::-1]             # [N, D]
-            cs_o0 = poly[:, :D] / jnp.maximum(zi, _EPS)
-            cs = jnp.where(oi[:, None] > 0, cs_o1, cs_o0)
-            s = (cs * w_u[None, :D]).sum(axis=1)
-            return (oi - zi) * s                                   # [N]
-
-        contrib = jax.vmap(unwind)(jnp.arange(D))                  # [D, N]
-        contrib = contrib.T * leaf_value[leaf_i]
-        contrib = jnp.where(slot_valid[None, :], contrib, 0.0)
-        return contrib, slot_feat[leaf_i]
-
-    def body(acc, leaf_i):
-        contrib, feats = per_leaf(leaf_i)
-        idx = jnp.clip(feats, 0, num_features - 1)
-        upd = jnp.where((feats >= 0)[None, :], contrib, 0.0)
-        acc = acc.at[:, idx].add(upd)
-        return acc, None
-
-    phi = jnp.zeros((n, num_features + 1), jnp.float32)
-    phi, _ = jax.lax.scan(body, phi, jnp.arange(L))
-    return phi
+    The per-tree dispatch shape of ``explain.paths.tree_phi`` (the one
+    implementation of the per-leaf math) — kept as the bit-reference the
+    batched host path's regression test compares against."""
+    from .explain.paths import tree_phi
+    return tree_phi(go_left, step_node, step_dir, slot_of_step, slot_feat,
+                    slot_z, n_slots, leaf_value, fact_w,
+                    num_features=num_features)
 
 
 def _fact_weights(D: int) -> np.ndarray:
@@ -238,34 +180,18 @@ def predict_contrib(trees: List, X: np.ndarray, num_class: int) -> np.ndarray:
     out = np.zeros((n, (f + 1) * num_class))
     if not trees:
         return out
-    paths = [_tree_paths(t) for t in trees]
-    # pad every tree to common (L, D) so _tree_contrib compiles ONCE for the
-    # whole model (padded leaves: value 0, neutral slots -> zero phi)
-    Dmax = max(max(p.step_node.shape[1] for p in paths), 1)
-    Lmax = max(max(p.step_node.shape[0] for p in paths), 1)
-    fact_w = jnp.asarray(_fact_weights(Dmax), jnp.float32)
-    for i, (tree, p) in enumerate(zip(trees, paths)):
+    # ONE scanned device dispatch for all trees (go-left decisions stay
+    # host f64) instead of a Python re-dispatch per tree; the f64 class
+    # accumulation below keeps the per-tree order, so the output is
+    # bit-identical to the legacy loop over _tree_contrib
+    from .explain.paths import forest_phi_host
+    phi_all, expected = forest_phi_host(trees, X, f)
+    for i, tree in enumerate(trees):
         cls = i % num_class
         lo = cls * (f + 1)
         if tree.num_leaves <= 1:
             out[:, lo + f] += tree.leaf_value[0]
             continue
-        L, D = p.step_node.shape
-        pad = ((0, Lmax - L), (0, Dmax - D))
-        gl_np = _go_left_matrix(tree, X)
-        gl = jnp.asarray(np.pad(
-            gl_np, ((0, 0), (0, max(Lmax - 1, 1) - gl_np.shape[1]))))
-        phi = _tree_contrib(
-            gl,
-            jnp.asarray(np.pad(p.step_node, pad, constant_values=-1)),
-            jnp.asarray(np.pad(p.step_dir, pad)),
-            jnp.asarray(np.pad(p.slot_of_step, pad)),
-            jnp.asarray(np.pad(p.slot_feat, pad, constant_values=-1)),
-            jnp.asarray(np.pad(p.slot_z, pad, constant_values=1.0),
-                        jnp.float32),
-            jnp.asarray(np.pad(p.n_slots, (0, Lmax - L))),
-            jnp.asarray(np.pad(p.leaf_value, (0, Lmax - L)), jnp.float32),
-            fact_w, num_features=f)
-        out[:, lo:lo + f + 1] += np.asarray(phi, np.float64)
-        out[:, lo + f] += p.expected
+        out[:, lo:lo + f + 1] += phi_all[i]
+        out[:, lo + f] += expected[i]
     return out

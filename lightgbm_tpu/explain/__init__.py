@@ -1,0 +1,18 @@
+"""Explanation serving tier: per-row SHAP attributions as a product.
+
+``paths.py`` packs contrib.py's per-leaf path tables into fixed-shape
+device arrays padded to the serving bucket ladder (``ContribPack``) and
+evaluates the whole stacked forest in one program (``forest_phi``) — the
+``kind="contrib"`` executable the CompiledPredictor caches next to
+raw/prob.  ``attrib.py`` is the continuous-tier consumer: a bounded
+per-feature mean-|phi| sketch whose debiased shift score gives the
+publish gate an attribution-drift alarm that fires before AUC moves.
+"""
+
+from .attrib import AttributionSketch
+from .paths import (ContribPack, forest_phi, forest_phi_host,
+                    go_left_nodes, pack_contrib_paths, tree_phi)
+
+__all__ = ["AttributionSketch", "ContribPack", "forest_phi",
+           "forest_phi_host", "go_left_nodes", "pack_contrib_paths",
+           "tree_phi"]

@@ -1,0 +1,140 @@
+"""AttributionSketch: bounded per-feature mean-|phi| drift statistics.
+
+The continuous tier's early-warning signal: a distribution shift moves
+the live model's feature ATTRIBUTIONS before it moves AUC (the label
+evidence a regression needs arrives later and noisier than the covariate
+evidence the attributions read directly).  Each cycle the publish gate
+folds the per-row |phi| of a sampled fraction of the fresh holdout
+window into this sketch; a debiased shift of the recent mean-|phi|
+profile against the reference profile past ``continuous_attrib_threshold``
+raises the ``lgbm_continuous_attrib_alarm_total`` counter — and, when
+``continuous_attrib_gate`` is on, rejects the cycle's candidate publish
+next to the AUC floor (gate.py).
+
+Same design discipline as continuous/drift.py's PSI sketch: bounded
+state (per-feature sums, no row retention), plain host numpy, and a
+finite-sample noise floor subtracted from the raw score so stationary
+data scores ~0 at ANY window size:
+
+    score_f = max(|mu_recent - mu_ref| - 2 * se_f, 0) / scale_f
+
+where ``se_f`` is the standard error of the difference of means
+(reference variance, both effective sample sizes) and ``scale_f``
+normalizes by the reference attribution magnitude so one dominant
+feature cannot hide drift in the others.  The recent window is an EMA
+(``decay`` per observed window), so the sketch tracks the CURRENT
+attribution profile with bounded memory of the past.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["AttributionSketch"]
+
+
+class AttributionSketch:
+    """Per-feature mean-|phi| reference vs EMA-recent, debiased shift.
+
+    ``observe(abs_phi)`` folds one window of per-row |phi| ([n, F],
+    bias column excluded, classes collapsed by the caller); the first
+    ``ref_windows`` windows pin the reference profile, everything after
+    feeds the decayed recent window.  ``scores()`` is the per-feature
+    debiased relative shift; ``max_score()`` is the alarm input."""
+
+    def __init__(self, num_features: int, ref_windows: int = 2,
+                 decay: float = 0.5):
+        if num_features <= 0:
+            raise ValueError("AttributionSketch needs num_features > 0, "
+                             f"got {num_features}")
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
+        self.num_features = int(num_features)
+        self.ref_windows = max(int(ref_windows), 1)
+        self.decay = float(decay)
+        F = self.num_features
+        self.ref_sum = np.zeros(F)
+        self.ref_sumsq = np.zeros(F)
+        self.ref_rows = 0
+        self.windows_seen = 0
+        self.rec_sum = np.zeros(F)
+        self.rec_rows = 0.0
+
+    # ------------------------------------------------------------------
+    def observe(self, abs_phi: np.ndarray) -> None:
+        """Fold one window of per-row |phi| ([n, F]) into the sketch."""
+        a = np.asarray(abs_phi, np.float64)
+        if a.ndim != 2 or a.shape[1] != self.num_features:
+            raise ValueError(
+                f"attribution window must be [n, {self.num_features}], "
+                f"got {a.shape}")
+        if a.shape[0] == 0:
+            return
+        self.windows_seen += 1
+        if self.windows_seen <= self.ref_windows:
+            self.ref_sum += a.sum(axis=0)
+            self.ref_sumsq += (a * a).sum(axis=0)
+            self.ref_rows += a.shape[0]
+            return
+        self.rec_sum = self.decay * self.rec_sum + a.sum(axis=0)
+        self.rec_rows = self.decay * self.rec_rows + a.shape[0]
+
+    # ------------------------------------------------------------------
+    def scores(self) -> np.ndarray:
+        """[F] debiased relative shift of recent mean-|phi| vs the
+        reference profile.  Zeros until both sides have rows."""
+        F = self.num_features
+        if self.ref_rows == 0 or self.rec_rows <= 0:
+            return np.zeros(F)
+        mu_ref = self.ref_sum / self.ref_rows
+        mu_rec = self.rec_sum / self.rec_rows
+        var = np.maximum(self.ref_sumsq / self.ref_rows - mu_ref ** 2, 0.0)
+        # standard error of the difference of two means: reference
+        # variance over both effective sample sizes — the noise floor a
+        # stationary stream stays under
+        se = np.sqrt(var * (1.0 / self.ref_rows + 1.0 / self.rec_rows))
+        scale = mu_ref + 0.01 * max(float(mu_ref.mean()), 0.0) + 1e-12
+        return np.maximum(np.abs(mu_rec - mu_ref) - 2.0 * se, 0.0) / scale
+
+    def max_score(self) -> float:
+        s = self.scores()
+        return float(s.max()) if len(s) else 0.0
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return {"ref_sum": np.asarray(self.ref_sum),
+                "ref_sumsq": np.asarray(self.ref_sumsq),
+                "rec_sum": np.asarray(self.rec_sum),
+                "counts": np.asarray([float(self.ref_rows),
+                                      float(self.rec_rows),
+                                      float(self.windows_seen)])}
+
+    def load_state(self, state: Dict[str, np.ndarray]) -> None:
+        ref_sum = np.asarray(state["ref_sum"], np.float64)
+        if ref_sum.shape != (self.num_features,):
+            raise ValueError(
+                "attribution sketch state was recorded for "
+                f"{ref_sum.shape[0]} features, this sketch has "
+                f"{self.num_features}")
+        self.ref_sum = ref_sum.copy()
+        self.ref_sumsq = np.asarray(state["ref_sumsq"], np.float64).copy()
+        self.rec_sum = np.asarray(state["rec_sum"], np.float64).copy()
+        counts = np.asarray(state["counts"], np.float64)
+        self.ref_rows = int(counts[0])
+        self.rec_rows = float(counts[1])
+        self.windows_seen = int(counts[2])
+
+    def summary(self, top: int = 3) -> Dict:
+        """Compact event payload: max shift + the worst features."""
+        s = self.scores()
+        order = np.argsort(-s)[:top]
+        return {
+            "max_shift": round(float(s.max()), 5) if len(s) else 0.0,
+            "recent_rows": round(float(self.rec_rows), 1),
+            "reference_rows": int(self.ref_rows),
+            "top_features": [
+                {"feature": int(f), "shift": round(float(s[f]), 5)}
+                for f in order if len(s)],
+        }

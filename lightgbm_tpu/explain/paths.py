@@ -1,0 +1,339 @@
+"""Device-shaped TreeSHAP: the whole forest's path tables in one program.
+
+contrib.py derives, per tree, the per-leaf path decomposition (the
+GPUTreeShap reformulation of the reference Tree::PredictContrib
+recursion) and evaluates it one tree at a time.  This module stacks those
+tables across the TREE axis into a ``ContribPack`` of fixed-shape device
+arrays — padded to the same (tree-bucket, leaf, depth) geometry contract
+as ``ops.predict.pad_stacked_trees`` — so the CompiledPredictor can cache
+ONE ``kind="contrib"`` executable per (row-bucket, tree-bucket, features,
+dtype) rung, exactly like raw/prob:
+
+- padded/null trees carry ``n_slots = 0``, ``leaf_value = 0`` and
+  ``expected = 0``: their phi is an exact zero, so the bucketed program
+  is parity-equal to the exact-shape one;
+- single-leaf REAL trees have an empty path and ``expected =
+  leaf_value[0]``: bias-only, matching the host path;
+- the factorial-weight table rides IN the pack as a runtime argument —
+  never a traced constant — so the program stays model-free (the jaxpr
+  const guard in test_placement applies to this kind too).
+
+``go_left_nodes`` is the node-parallel form of ``ops.predict.
+_traverse_one_tree``'s decision body (same missing/NaN/categorical-bitset
+semantics, all nodes of one tree at once), and ``tree_phi`` is the single
+per-tree phi evaluation both the host path (``forest_phi_host``, one
+scanned dispatch for the whole model) and the device program
+(``forest_phi``) share.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..contrib import _EPS, _K_ZERO, _fact_weights, _go_left_matrix, \
+    _tree_paths
+
+__all__ = ["ContribPack", "pack_contrib_paths", "go_left_nodes",
+           "tree_phi", "forest_phi", "forest_phi_host"]
+
+
+class ContribPack(NamedTuple):
+    """Per-leaf path tables for a stacked forest, tree axis leading."""
+    step_node: jnp.ndarray     # [T, L, D] int32 internal node id (-1 pad)
+    step_dir: jnp.ndarray      # [T, L, D] bool: path goes LEFT here
+    slot_of_step: jnp.ndarray  # [T, L, D] int32 unique-feature slot
+    slot_feat: jnp.ndarray     # [T, L, D] int32 real feature id (-1 pad)
+    slot_z: jnp.ndarray        # [T, L, D] f32 cover product (1.0 pad)
+    n_slots: jnp.ndarray       # [T, L] int32 (u per leaf)
+    leaf_value: jnp.ndarray    # [T, L] f32
+    expected: jnp.ndarray      # [T] f32 E[f] per tree
+    class_of: jnp.ndarray      # [T] int32 tree index % num_class
+    fact_w: jnp.ndarray        # [D+1, D+1] f32 k!(u-1-k)!/u!
+
+
+def _stack_path_tables(paths, L: int, D: int):
+    """Stack per-tree ``_TreePaths`` into [T, L, D] numpy tables."""
+    T = len(paths)
+    sn = np.full((T, L, D), -1, np.int32)
+    sd = np.zeros((T, L, D), bool)
+    sos = np.zeros((T, L, D), np.int32)
+    sft = np.full((T, L, D), -1, np.int32)
+    sz = np.ones((T, L, D))
+    ns = np.zeros((T, L), np.int32)
+    lv = np.zeros((T, L))
+    ex = np.zeros(T)
+    for i, p in enumerate(paths):
+        l, d = p.step_node.shape
+        sn[i, :l, :d] = p.step_node
+        sd[i, :l, :d] = p.step_dir
+        sos[i, :l, :d] = p.slot_of_step
+        sft[i, :l, :d] = p.slot_feat
+        sz[i, :l, :d] = p.slot_z
+        ns[i, :l] = p.n_slots
+        lv[i, :l] = p.leaf_value
+        ex[i] = p.expected
+    return sn, sd, sos, sft, sz, ns, lv, ex
+
+
+def pack_contrib_paths(trees: List, tree_count: Optional[int] = None,
+                       leaf_count: Optional[int] = None,
+                       depth_count: Optional[int] = None,
+                       num_class: int = 1) -> ContribPack:
+    """Build the device pack for ``trees``, optionally padded out to a
+    bucketed (tree, leaf, depth) geometry.
+
+    Single-leaf trees get an empty path with ``expected = leaf value``
+    (bias-only); trees past ``len(trees)`` are nulls with everything
+    zero, so a bucketed pack scores parity-equal to the exact one."""
+    paths = [_tree_paths(t) for t in trees]
+    L = max([p.step_node.shape[0] for p in paths] + [1])
+    D = max([p.step_node.shape[1] for p in paths] + [1])
+    # a single-leaf tree's _TreePaths rides a [1, 1] placeholder with
+    # n_slots=0: its tables are already the null-tree encoding
+    for i, t in enumerate(trees):
+        if t.num_leaves <= 1:
+            paths[i] = paths[i]._replace(
+                leaf_value=np.zeros(1),
+                expected=float(t.leaf_value[0]))
+    T = len(trees)
+    if tree_count is not None:
+        if int(tree_count) < T:
+            raise ValueError(f"pack_contrib_paths cannot shrink the tree "
+                             f"axis: {T} -> {tree_count}")
+        T = int(tree_count)
+    if leaf_count is not None:
+        if int(leaf_count) < L:
+            raise ValueError(f"pack_contrib_paths cannot shrink the leaf "
+                             f"axis: {L} -> {leaf_count}")
+        L = int(leaf_count)
+    if depth_count is not None:
+        if int(depth_count) < D:
+            raise ValueError(f"pack_contrib_paths cannot shrink the depth "
+                             f"axis: {D} -> {depth_count}")
+        D = int(depth_count)
+    sn, sd, sos, sft, sz, ns, lv, ex = _stack_path_tables(paths, L, D)
+    if T > len(paths):
+        pad = T - len(paths)
+        sn = np.concatenate([sn, np.full((pad, L, D), -1, np.int32)])
+        sd = np.concatenate([sd, np.zeros((pad, L, D), bool)])
+        sos = np.concatenate([sos, np.zeros((pad, L, D), np.int32)])
+        sft = np.concatenate([sft, np.full((pad, L, D), -1, np.int32)])
+        sz = np.concatenate([sz, np.ones((pad, L, D))])
+        ns = np.concatenate([ns, np.zeros((pad, L), np.int32)])
+        lv = np.concatenate([lv, np.zeros((pad, L))])
+        ex = np.concatenate([ex, np.zeros(pad)])
+    # class routing rides IN the pack (a runtime argument, like every
+    # other table) so the device program never bakes a tree-axis-sized
+    # iota constant into the executable; padded trees continue the
+    # i % num_class pattern, harmless since their phi is exactly zero
+    class_of = (np.arange(T, dtype=np.int32)
+                % np.int32(max(int(num_class), 1)))
+    return ContribPack(
+        jnp.asarray(sn), jnp.asarray(sd), jnp.asarray(sos),
+        jnp.asarray(sft), jnp.asarray(sz, jnp.float32),
+        jnp.asarray(ns), jnp.asarray(lv, jnp.float32),
+        jnp.asarray(ex, jnp.float32), jnp.asarray(class_of),
+        jnp.asarray(_fact_weights(D), jnp.float32))
+
+
+# ----------------------------------------------------------------------
+def go_left_nodes(X, sf, th, dt, cb, ct):
+    """[N, M] bool: would each row go LEFT at each node of ONE stacked
+    tree — the node-parallel form of ``_traverse_one_tree``'s decision
+    body (ops/predict.py), same missing/NaN and categorical-bitset
+    semantics."""
+    fval = X[:, sf]                                   # [N, M] gather
+    d = dt[None, :]
+    is_cat = (d & 1) != 0
+    missing_type = (d >> 2) & 3
+    default_left = (d & 2) != 0
+    isnan = jnp.isnan(fval)
+    fval0 = jnp.where(isnan & (missing_type != 2), 0.0, fval)
+    iszero = jnp.abs(fval0) < _K_ZERO
+    is_missing = (((missing_type == 2) & isnan)
+                  | ((missing_type == 1) & iszero))
+    go_left_num = jnp.where(is_missing, default_left, fval0 <= th[None, :])
+    ival = jnp.where(isnan, -1, fval).astype(jnp.int32)
+    cat_idx = th.astype(jnp.int32)
+    lo = cb[jnp.clip(cat_idx, 0, cb.shape[0] - 1)][None, :]
+    hi = cb[jnp.clip(cat_idx + 1, 0, cb.shape[0] - 1)][None, :]
+    word = lo + (ival >> 5)
+    in_range = (ival >= 0) & (word < hi)
+    word_c = jnp.clip(word, 0, ct.shape[0] - 1)
+    bit = (ct[word_c] >> (ival & 31).astype(jnp.uint32)) & 1
+    go_left_cat = in_range & (bit == 1)
+    return jnp.where(is_cat, go_left_cat, go_left_num)
+
+
+def tree_phi(go_left, step_node, step_dir, slot_of_step, slot_feat,
+             slot_z, n_slots, leaf_value, fact_w, num_features: int):
+    """phi [N, F+1] for ONE tree given the row decisions at each node.
+
+    The per-leaf decomposition contrib.py documents (poly build by scan,
+    synthetic-division unwind), shared verbatim by the per-tree host
+    path (contrib._tree_contrib), the batched host path, and the device
+    forest program — one implementation, three dispatch shapes.  The
+    bias column stays zero; expected values are added by callers.
+
+    Row-count-shaped zeros are derived from ``go_left`` (never built
+    eagerly) and the leaf scan iterates the table rows themselves, so no
+    row- or leaf-axis-sized constant gets baked into the executable —
+    the same jaxpr-const discipline test_placement enforces for the
+    predict kinds."""
+    L, D = step_node.shape
+    n = go_left.shape[0]
+    # [n] traced zeros (go_left is bool: finite, NaN-free)
+    row0 = go_left[:, 0].astype(jnp.float32) * 0.0
+
+    def per_leaf(nodes, dirs, sos_l, feats, z_l, u, lv_l):
+        valid = nodes >= 0                                         # [D]
+        gl = go_left[:, jnp.clip(nodes, 0, go_left.shape[1] - 1)]  # [N, D]
+        passes = jnp.where(valid[None, :], gl == dirs[None, :], True)
+        # o per slot: AND over this slot's steps
+        slot_mask = (sos_l[None, :] ==
+                     jnp.arange(D)[:, None]) & valid[None, :]      # [D, D]
+        o = jnp.all(jnp.where(slot_mask[None, :, :], passes[:, None, :],
+                              True), axis=2)                       # [N, D]
+        slot_valid = jnp.arange(D) < u
+        of = jnp.where(slot_valid[None, :], o.astype(jnp.float32), 0.0)
+        zf = jnp.where(slot_valid, z_l.astype(jnp.float32), 1.0)
+
+        # poly = prod_j (z_j + o_j t): coefficients [N, D+1]; padded slots
+        # contribute the neutral factor (z=1, o=0)
+        def mul(poly, jo_jz):
+            jo, jz = jo_jz
+            shifted = jnp.concatenate(
+                [row0[:, None].astype(poly.dtype), poly[:, :-1]], axis=1)
+            return poly * jz + shifted * jo[:, None], None
+
+        init = jnp.concatenate(
+            [row0[:, None] + 1.0,
+             jnp.broadcast_to(row0[:, None], (n, D))], axis=1)
+        poly, _ = jax.lax.scan(mul, init, (of.T, zf))
+
+        w_u = fact_w[u]                                            # [D+1]
+
+        def unwind(i):
+            oi = of[:, i]
+            zi = zf[i]
+            # divide poly by (z_i + o_i t):
+            #   o_i=1: synthetic division top-down  c_{k-1} = p_k - c_k z_i
+            #   o_i=0: plain scale                  c_k = p_k / z_i
+            def div_step(c_prev, k):
+                c = poly[:, k] - c_prev * zi
+                return c, c
+
+            ks = jnp.arange(D, 0, -1)
+            _, cs_o1 = jax.lax.scan(div_step, row0, ks)
+            cs_o1 = jnp.moveaxis(cs_o1, 0, 1)[:, ::-1]             # [N, D]
+            cs_o0 = poly[:, :D] / jnp.maximum(zi, _EPS)
+            cs = jnp.where(oi[:, None] > 0, cs_o1, cs_o0)
+            s = (cs * w_u[None, :D]).sum(axis=1)
+            return (oi - zi) * s                                   # [N]
+
+        contrib = jax.vmap(unwind)(jnp.arange(D))                  # [D, N]
+        contrib = contrib.T * lv_l
+        contrib = jnp.where(slot_valid[None, :], contrib, 0.0)
+        return contrib, feats
+
+    def body(acc, xs):
+        contrib, feats = per_leaf(*xs)
+        idx = jnp.clip(feats, 0, num_features - 1)
+        upd = jnp.where((feats >= 0)[None, :], contrib, 0.0)
+        acc = acc.at[:, idx].add(upd)
+        return acc, None
+
+    phi = jnp.broadcast_to(row0[:, None], (n, num_features + 1))
+    phi, _ = jax.lax.scan(body, phi, (step_node, step_dir, slot_of_step,
+                                      slot_feat, slot_z, n_slots,
+                                      leaf_value))
+    return phi
+
+
+# ----------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("num_features",))
+def _phi_scan(gl, sn, sd, sos, sft, sz, ns, lv, fact_w,
+              num_features: int):
+    """[T, N, F+1] per-tree phi: ONE dispatch for the whole model (the
+    batched host path), scanning ``tree_phi`` over the tree axis."""
+    def body(_, xs):
+        g, a, b, c, d, e, h, v = xs
+        return None, tree_phi(g, a, b, c, d, e, h, v, fact_w,
+                              num_features)
+
+    _, phis = jax.lax.scan(body, None, (gl, sn, sd, sos, sft, sz, ns, lv))
+    return phis
+
+
+def forest_phi_host(trees: List, X: np.ndarray, num_features: int):
+    """Host-side batched per-tree phi: go-left decisions stay on host
+    numpy (f64 — bit-critical near thresholds), the per-leaf math runs
+    as one scanned device dispatch instead of a Python re-dispatch per
+    tree.  Returns ``(phi [T, N, F+1] f32, expected [T] f64)``; callers
+    accumulate per tree (class routing, f64 order) themselves."""
+    paths = [_tree_paths(t) for t in trees]
+    Dmax = max(max(p.step_node.shape[1] for p in paths), 1)
+    Lmax = max(max(p.step_node.shape[0] for p in paths), 1)
+    M = max(Lmax - 1, 1)
+    n = X.shape[0]
+    gl = np.zeros((len(trees), n, M), bool)
+    for i, tree in enumerate(trees):
+        if tree.num_leaves > 1:
+            g = _go_left_matrix(tree, X)
+            gl[i, :, :g.shape[1]] = g
+    sn, sd, sos, sft, sz, ns, lv, ex = _stack_path_tables(
+        paths, Lmax, Dmax)
+    phi = _phi_scan(
+        jnp.asarray(gl), jnp.asarray(sn), jnp.asarray(sd),
+        jnp.asarray(sos), jnp.asarray(sft),
+        jnp.asarray(sz, jnp.float32), jnp.asarray(ns),
+        jnp.asarray(lv, jnp.float32),
+        jnp.asarray(_fact_weights(Dmax), jnp.float32),
+        num_features=num_features)
+    return np.asarray(phi, np.float64), ex
+
+
+# ----------------------------------------------------------------------
+def forest_phi(st, pack: ContribPack, X, num_features: int,
+               num_class: int):
+    """[N, (F+1)*K] f32: SHAP contributions of the whole stacked forest
+    in the reference layout (per-class blocks of F features + bias).
+
+    Scans the tree axis jointly over the StackedTrees decision arrays
+    (go-left on device, f32) and the pack's path tables; null/padded
+    trees contribute exact zeros, so the same program serves every model
+    on the rung.  Rows sum to the raw prediction within f32 honesty."""
+    k = max(int(num_class), 1)
+    n = X.shape[0]
+    F1 = num_features + 1
+
+    def body(acc, xs):
+        (sf, th, dt, cb, ct, c,
+         sn, sd, sos, sft, sz, ns, lv, ex) = xs
+        gl = go_left_nodes(X, sf, th, dt, cb, ct)
+        phi = tree_phi(gl, sn, sd, sos, sft, sz, ns, lv, pack.fact_w,
+                       num_features)
+        phi = phi.at[:, num_features].add(ex)
+        if k == 1:
+            return acc + phi, None
+        return acc.at[c].add(phi), None
+
+    # row-count-shaped zeros derived from a traced input (class_of is
+    # int32: finite), not built eagerly — no [n, F1] constant in the
+    # executable (test_placement's jaxpr-const rule)
+    zero = (pack.class_of[0] * 0).astype(jnp.float32)
+    init = jnp.broadcast_to(
+        zero, (n, F1) if k == 1 else (k, n, F1))
+    acc, _ = jax.lax.scan(body, init, (
+        st.split_feature, st.threshold, st.decision_type,
+        st.cat_boundaries, st.cat_threshold, pack.class_of,
+        pack.step_node, pack.step_dir, pack.slot_of_step, pack.slot_feat,
+        pack.slot_z, pack.n_slots, pack.leaf_value, pack.expected))
+    if k == 1:
+        return acc
+    return jnp.moveaxis(acc, 0, 1).reshape(n, k * F1)

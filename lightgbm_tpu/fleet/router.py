@@ -169,6 +169,7 @@ class HttpReplica:
         # is idempotent by contract (the registry replays the same
         # version for a token it already applied), so it retries too.
         retry_safe = (method == "GET" or path.endswith(":predict")
+                      or path.endswith(":explain")
                       or (isinstance(body, dict)
                           and bool(body.get("publish_token"))))
         for attempt in (0, 1):
@@ -223,27 +224,57 @@ class _ModelStats:
                  "outcomes", "latency_hist", "window", "rows", "p99_g",
                  "miss_g", "goodput_g")
 
-    def __init__(self, reg: MetricsRegistry, name: str):
+    def __init__(self, reg: MetricsRegistry, name: str,
+                 verb: str = "predict"):
         lab = {"model": name}
-        self.requests = reg.counter(
-            "lgbm_fleet_requests_total", "predict requests at the router",
-            **lab)
-        self.reroutes = reg.counter(
-            "lgbm_fleet_reroutes_total",
-            "forwards retried on another replica after a failure", **lab)
-        self.shed = reg.counter(
-            "lgbm_fleet_shed_total",
-            "requests shed because no replica was within SLO", **lab)
-        self.errors = reg.counter(
-            "lgbm_fleet_errors_total",
-            "requests that failed on every routable replica", **lab)
-        self.missed = reg.counter(
-            "lgbm_fleet_model_deadline_missed_total",
-            "requests for this model that ended 504 (deadline verdict "
-            "anywhere along the chain)", **lab)
-        self.latency_hist = reg.histogram(
-            "lgbm_fleet_request_latency_seconds",
-            "router-side end-to-end predict latency", **lab)
+        if verb == "explain":
+            # the explain lane is its OWN SLO class: a burst of expensive
+            # pred_contrib traffic must show up (and alert) on its own
+            # metric family, not dilute the predict lane's p99/miss feed
+            # the placement controller reads
+            self.requests = reg.counter(
+                "lgbm_fleet_explain_requests_total",
+                "explain (pred_contrib) requests at the router", **lab)
+            self.reroutes = reg.counter(
+                "lgbm_fleet_explain_reroutes_total",
+                "explain forwards retried on another replica after a "
+                "failure", **lab)
+            self.shed = reg.counter(
+                "lgbm_fleet_explain_shed_total",
+                "explain requests shed because no replica was within SLO",
+                **lab)
+            self.errors = reg.counter(
+                "lgbm_fleet_explain_errors_total",
+                "explain requests that failed on every routable replica",
+                **lab)
+            self.missed = reg.counter(
+                "lgbm_fleet_explain_deadline_missed_total",
+                "explain requests that ended 504 (deadline verdict "
+                "anywhere along the chain)", **lab)
+            self.latency_hist = reg.histogram(
+                "lgbm_fleet_explain_request_latency_seconds",
+                "router-side end-to-end explain latency", **lab)
+        else:
+            self.requests = reg.counter(
+                "lgbm_fleet_requests_total",
+                "predict requests at the router", **lab)
+            self.reroutes = reg.counter(
+                "lgbm_fleet_reroutes_total",
+                "forwards retried on another replica after a failure",
+                **lab)
+            self.shed = reg.counter(
+                "lgbm_fleet_shed_total",
+                "requests shed because no replica was within SLO", **lab)
+            self.errors = reg.counter(
+                "lgbm_fleet_errors_total",
+                "requests that failed on every routable replica", **lab)
+            self.missed = reg.counter(
+                "lgbm_fleet_model_deadline_missed_total",
+                "requests for this model that ended 504 (deadline verdict "
+                "anywhere along the chain)", **lab)
+            self.latency_hist = reg.histogram(
+                "lgbm_fleet_request_latency_seconds",
+                "router-side end-to-end predict latency", **lab)
         # recent-evidence windows behind the derived gauges: time-bounded
         # so an idle model's gauges decay instead of freezing on history
         # (an all-time miss ratio would pin one early 504 burst on the
@@ -255,18 +286,32 @@ class _ModelStats:
         self.window = LatencyWindow(2048, window_s=60.0)
         self.rows = LatencyWindow(8192, window_s=30.0)
         self.outcomes = LatencyWindow(8192, window_s=60.0)
-        self.p99_g = reg.gauge(
-            "lgbm_fleet_model_p99_ms",
-            "per-model SLO gauge: p99 of recent router-side latencies "
-            "(ms), failures included", **lab)
-        self.miss_g = reg.gauge(
-            "lgbm_fleet_model_deadline_miss_ratio",
-            "per-model SLO gauge: fraction of recent-window requests "
-            "that ended 504", **lab)
-        self.goodput_g = reg.gauge(
-            "lgbm_fleet_model_goodput_rows_per_s",
-            "per-model SLO gauge: rows answered 200 per second over the "
-            "recent window", **lab)
+        if verb == "explain":
+            self.p99_g = reg.gauge(
+                "lgbm_fleet_explain_p99_ms",
+                "per-model explain SLO gauge: p99 of recent router-side "
+                "explain latencies (ms), failures included", **lab)
+            self.miss_g = reg.gauge(
+                "lgbm_fleet_explain_deadline_miss_ratio",
+                "per-model explain SLO gauge: fraction of recent-window "
+                "explain requests that ended 504", **lab)
+            self.goodput_g = reg.gauge(
+                "lgbm_fleet_explain_goodput_rows_per_s",
+                "per-model explain SLO gauge: contribution rows answered "
+                "200 per second over the recent window", **lab)
+        else:
+            self.p99_g = reg.gauge(
+                "lgbm_fleet_model_p99_ms",
+                "per-model SLO gauge: p99 of recent router-side latencies "
+                "(ms), failures included", **lab)
+            self.miss_g = reg.gauge(
+                "lgbm_fleet_model_deadline_miss_ratio",
+                "per-model SLO gauge: fraction of recent-window requests "
+                "that ended 504", **lab)
+            self.goodput_g = reg.gauge(
+                "lgbm_fleet_model_goodput_rows_per_s",
+                "per-model SLO gauge: rows answered 200 per second over "
+                "the recent window", **lab)
 
     def refresh(self) -> None:
         self.p99_g.set(self.window.percentiles()["p99_ms"])
@@ -751,22 +796,29 @@ class FleetRouter:
     # names share one "_other" row
     _MAX_MODEL_LABELS = 256
 
-    def _model_stats(self, name: str) -> _ModelStats:
+    def _model_stats(self, name: str,
+                     verb: str = "predict") -> _ModelStats:
         """Per-model fleet metrics, created on first touch.  Lock-free
         read on the hot path (CPython dict get); creation double-checks
-        under the router lock."""
-        m = self._per_model.get(name)
+        under the router lock.  The explain lane keeps its own row per
+        model (key ``name:explain``) so its SLO windows and counters
+        never mix with the predict lane's — route parsing rejects names
+        containing ``:``, so the suffix cannot collide with a real
+        model."""
+        key = name if verb == "predict" else f"{name}:{verb}"
+        m = self._per_model.get(key)
         if m is not None:
             return m
         with self._lock:
-            m = self._per_model.get(name)
+            m = self._per_model.get(key)
             if m is None:
                 if len(self._per_model) >= self._MAX_MODEL_LABELS:
                     name = "_other"
-                    m = self._per_model.get(name)
+                    key = name if verb == "predict" else f"{name}:{verb}"
+                    m = self._per_model.get(key)
                 if m is None:
-                    m = self._per_model[name] = _ModelStats(self.registry,
-                                                            name)
+                    m = self._per_model[key] = _ModelStats(
+                        self.registry, name, verb)
             return m
 
     def refresh_model_gauges(self) -> None:
@@ -789,7 +841,8 @@ class FleetRouter:
     def _attempt(self, idx: int, name: str, body: dict, nrows: int,
                  timeout_s: float,
                  started: Optional[threading.Event] = None,
-                 tspan=None) -> Tuple[Optional[int], dict]:
+                 tspan=None,
+                 verb: str = "predict") -> Tuple[Optional[int], dict]:
         """One forward to one replica with full gray-failure accounting:
         breaker admission, live in-flight rows, latency digest feed, and
         the transport-error split — a TIMEOUT feeds the breaker/digest
@@ -830,7 +883,7 @@ class FleetRouter:
         t0 = time.perf_counter()
         try:
             status, payload = rep.endpoint.request(
-                "POST", f"/v1/models/{name}:predict", body,
+                "POST", f"/v1/models/{name}:{verb}", body,
                 timeout_s=timeout_s)
         except ReplicaTransportError as exc:
             if aspan is not None:
@@ -930,7 +983,7 @@ class FleetRouter:
     def _attempt_maybe_hedged(self, idx: int, name: str, body: dict,
                               nrows: int, timeout_s: float, tried: set,
                               deadline_t: Optional[float] = None,
-                              tspan=None
+                              tspan=None, verb: str = "predict"
                               ) -> List[Tuple[int, Optional[int], dict]]:
         """Forward to `idx`, duplicating to the next-best peer if the
         primary outlives its hedge delay and the hedge + retry budgets
@@ -953,10 +1006,10 @@ class FleetRouter:
             # Tracked with the router's own in-flight counter, not the
             # executor's private internals
             return [(idx, *self._attempt(idx, name, body, nrows,
-                                         timeout_s, None, tspan))]
+                                         timeout_s, None, tspan, verb))]
         started = threading.Event()
         primary = self._hedge_submit(idx, name, body, nrows, timeout_s,
-                                     started, tspan)
+                                     started, tspan, verb)
         # an attempt can legitimately run ~2x its HTTP timeout (the
         # stale-conn retry inside HttpReplica) — the hard waits below
         # must outlast that, and a primary that never answers within
@@ -1031,7 +1084,7 @@ class FleetRouter:
                         replica=self._replicas[alt].endpoint.name,
                         delay_ms=round(delay * 1e3, 2))
         hedge = self._hedge_submit(alt, name, hbody, nrows, h_timeout,
-                                   None, tspan)
+                                   None, tspan, verb)
         futs = {primary: idx, hedge: alt}
         outcomes: List[Tuple[int, Optional[int], dict]] = []
         pending = set(futs)
@@ -1080,9 +1133,10 @@ class FleetRouter:
                                                   "its transport timeout"}))
         return outcomes
 
-    def _forward_predict(self, name: str, body: dict) -> Tuple[int, dict]:
+    def _forward_predict(self, name: str, body: dict,
+                         verb: str = "predict") -> Tuple[int, dict]:
         self._m_requests.inc()
-        mm = self._model_stats(name)
+        mm = self._model_stats(name, verb)
         mm.requests.inc()
         self.retry_budget.deposit()
         self.hedge_budget.deposit()
@@ -1105,11 +1159,12 @@ class FleetRouter:
         # context) and stamped with every routing decision below
         ctx = body.get(_trace.BODY_KEY)
         tspan = self.tracer.start_request(
-            "router.predict", ctx=ctx if isinstance(ctx, dict) else None,
+            f"router.{verb}", ctx=ctx if isinstance(ctx, dict) else None,
             model=name, rows=nrows)
         if tspan is None:
             status, payload = self._forward_attempts(
-                name, body, nrows, deadline_ms, deadline_t, t0, mm, None)
+                name, body, nrows, deadline_ms, deadline_t, t0, mm, None,
+                verb)
         else:
             if deadline_ms is not None:
                 tspan.set(deadline_ms=round(float(deadline_ms), 1))
@@ -1121,7 +1176,7 @@ class FleetRouter:
                 with _trace.activate(tspan):
                     status, payload = self._forward_attempts(
                         name, body, nrows, deadline_ms, deadline_t, t0,
-                        mm, tspan)
+                        mm, tspan, verb)
             except BaseException as exc:
                 # a request that died mid-route is exactly what tail
                 # sampling exists to capture — complete its trace as the
@@ -1145,7 +1200,7 @@ class FleetRouter:
     def _forward_attempts(self, name: str, body: dict, nrows: int,
                           deadline_ms, deadline_t: Optional[float],
                           t0: float, mm: _ModelStats,
-                          tspan) -> Tuple[int, dict]:
+                          tspan, verb: str = "predict") -> Tuple[int, dict]:
         attempts = 0
         candidates = self._ranked(name)
         tried: set = set()
@@ -1166,10 +1221,14 @@ class FleetRouter:
                 return 504, {"error": "deadline exceeded at router "
                                       f"(budget {float(deadline_ms):g}ms, "
                                       f"attempts {attempts})"}
-            if (not degrade and self.cascade_mode == "deadline"
+            if (verb == "predict" and not degrade
+                    and self.cascade_mode == "deadline"
                     and remaining is not None
                     and not full_forest_affordable(
                         remaining, mm.window.percentiles()["p99_ms"])):
+                # (predict-only: a degraded EXPLANATION would silently
+                # attribute a different model — the prefix forest — so
+                # the explain lane takes the honest 504 instead)
                 # the budget is alive but (on p99 evidence) too small for
                 # a full-forest answer: ask the replica for the calibrated
                 # prefix instead of letting the deadline clock run out
@@ -1223,7 +1282,7 @@ class FleetRouter:
                 fwd_body["degrade"] = True
             outcomes = self._attempt_maybe_hedged(
                 idx, name, fwd_body, nrows, timeout_s, tried, deadline_t,
-                tspan)
+                tspan, verb)
             decisive = next(
                 (o for o in outcomes
                  if o[1] is not None and not _retryable(o[1])), None)
@@ -1584,9 +1643,12 @@ class FleetRouter:
         fleet-confirmed version, and the SLO gauge snapshot the placement
         controller feeds on."""
         with self._lock:
+            # verb-suffixed stats rows (``name:explain``) are metric
+            # lanes, not models — they must not mint phantom table rows
             names = (set(self._published) | set(self._placement)
                      | set(self._model_versions)
-                     | (set(self._per_model) - {"_other"}))
+                     | ({k for k in self._per_model if ":" not in k}
+                        - {"_other"}))
             out: Dict[str, Dict] = {}
             for name in sorted(names):
                 placed = self._placement.get(name)
@@ -1744,11 +1806,19 @@ class FleetRouter:
                 except ReplicaTransportError as exc:
                     self._mark_down(idx, str(exc))
             return 503, {"error": "no routable replica"}
+        if (method == "POST" and path.startswith("/v1/models/")
+                and path.endswith("/explain") and ":" not in path):
+            # REST-style alias, mirroring the replica's own route
+            name = path[len("/v1/models/"):-len("/explain")]
+            if name:
+                return self._forward_predict(name, body, verb="explain")
         if path.startswith("/v1/models/") and ":" in path and method == "POST":
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
             if name and verb == "predict":
                 return self._forward_predict(name, body)
+            if name and verb == "explain":
+                return self._forward_predict(name, body, verb="explain")
             if name and verb in ("publish", "rollback"):
                 return self._broadcast(method, path, body, name, verb)
         return 404, {"error": f"no route for {method} {path}"}

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..explain.paths import forest_phi, pack_contrib_paths
 from ..log import LightGBMError
 from ..objectives import output_transform
 from ..ops.predict import (DEFAULT_BUCKET_LADDER, DEFAULT_TREE_BUCKET_LADDER,
@@ -161,6 +162,11 @@ class CompiledPredictor:
         # stacked cache (serving traffic uses one or two ranges)
         self._subs: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._subs_cap = 8
+        # kind="contrib" needs the tree objects (the per-leaf path tables
+        # are derived host-side, not from StackedTrees); packs are cached
+        # per range like the sub-stacks
+        self._trees = list(trees)
+        self._contrib_subs: "OrderedDict[tuple, object]" = OrderedDict()
         # secondary geometry buckets: every axis an executable's shape
         # depends on is rounded up, so models whose exact geometry
         # differs within a rung still share programs
@@ -181,6 +187,10 @@ class CompiledPredictor:
             # deep tree from padding the loop past its own node axis.
             self._depth_bucket = min(self._node_bucket,
                                      _pow2(int(st.max_depth), floor=8))
+            # leaf axis for contrib path tables: num_leaves = nodes + 1
+            # can land one past a power of two, so it gets its own bucket
+            self._leaf_bucket = _pow2(
+                max([t.num_leaves for t in self._trees] + [1]), floor=8)
 
     # ------------------------------------------------------------------
     def is_stale(self) -> bool:
@@ -260,6 +270,37 @@ class CompiledPredictor:
                 self._subs.popitem(last=False)
         return hit
 
+    def _contrib_pack(self, s: int, e: int):
+        """The ``ContribPack`` for a range: the [s, e) trees' per-leaf
+        path tables padded to the bucketed (tree, leaf, depth) geometry
+        with exact-zero null trees — the contrib-kind peer of
+        ``_padded_range``, cached per range the same way."""
+        keyr = (int(s), int(e))
+        with self._lock:
+            hit = self._contrib_subs.get(keyr)
+            if hit is not None:
+                self._contrib_subs.move_to_end(keyr)
+                return hit
+        k = max(self.num_class, 1)
+        trees = self._trees[s * k:e * k]
+        if self.tree_buckets:
+            # path length never exceeds the traversal depth, so the
+            # depth bucket bounds the step axis too
+            pack = pack_contrib_paths(
+                trees, tree_count=self._tree_bucket_for(s, e) * k,
+                leaf_count=self._leaf_bucket,
+                depth_count=self._depth_bucket, num_class=k)
+        else:
+            pack = pack_contrib_paths(trees, num_class=k)
+        with self._lock:
+            cur = self._contrib_subs.get(keyr)
+            if cur is not None:
+                return cur
+            self._contrib_subs[keyr] = pack
+            while len(self._contrib_subs) > self._subs_cap:
+                self._contrib_subs.popitem(last=False)
+        return pack
+
     def _shared_key(self, key: tuple) -> tuple:
         """Identity of a program in the process-global cache: everything
         the compiled artifact depends on EXCEPT one model's weights and
@@ -269,9 +310,17 @@ class CompiledPredictor:
         padded, _, _ = self._padded_range(s, e)
         geo = tuple((tuple(map(int, a.shape)), str(a.dtype))
                     for a in padded[:9])
-        return (int(bucket), int(tb), int(nfeat), dtype_str, kind,
+        base = (int(bucket), int(tb), int(nfeat), dtype_str, kind,
                 int(self.num_class), self._objective,
                 bool(self._average_output), int(padded.max_depth), geo)
+        if kind != "contrib":
+            return base
+        # the contrib program additionally takes the path-table pack as
+        # an argument: its bucketed (tree, leaf, depth) shapes are part
+        # of the program identity
+        pack = self._contrib_pack(s, e)
+        return base + (tuple((tuple(map(int, a.shape)), str(a.dtype))
+                             for a in pack),)
 
     # ------------------------------------------------------------------
     def _predict_fn(self, key):
@@ -283,6 +332,23 @@ class CompiledPredictor:
         bucket, tb, nfeat, dtype_str, s, e, kind = key
         padded, _, _ = self._padded_range(s, e)
         k = self.num_class
+        if kind == "contrib":
+            # SHAP program: the stacked decision arrays drive go-left on
+            # device, the pack's path tables drive the per-leaf math —
+            # both are ARGUMENTS, so the executable is model-free like
+            # every other kind.  No n_live: contrib output is the
+            # reference PredictContrib layout (never averaged).
+            pack = self._contrib_pack(s, e)
+            nfeat_i = int(nfeat)
+            kk = max(k, 1)
+
+            def cfn(st: StackedTrees, pk, X):
+                return forest_phi(st, pk, X, num_features=nfeat_i,
+                                  num_class=kk)
+
+            x_spec = jax.ShapeDtypeStruct((bucket, nfeat),
+                                          np.dtype(dtype_str))
+            return cfn, (padded, pack, x_spec)
         n_rows = int(padded.root.shape[0])
         iters = n_rows // max(k, 1)
         # raw is [N] single-class / [K, N] multiclass -> class_axis=0
@@ -400,14 +466,19 @@ class CompiledPredictor:
         st_avals = [[list(map(int, a.shape)), str(a.dtype)]
                     if hasattr(a, "shape") else ["static", repr(a)]
                     for a in jax.tree_util.tree_leaves(padded)]
-        return {"kind": "serve_predict", "bucket": int(bucket),
-                "tree_bucket": int(tb),
-                "num_feature": int(nfeat), "dtype": dtype_str,
-                "output": kind, "num_class": int(self.num_class),
-                "objective": self._objective,
-                "average_output": bool(self._average_output),
-                "stacked_avals": st_avals,
-                **runtime_signature()}
+        sig = {"kind": "serve_predict", "bucket": int(bucket),
+               "tree_bucket": int(tb),
+               "num_feature": int(nfeat), "dtype": dtype_str,
+               "output": kind, "num_class": int(self.num_class),
+               "objective": self._objective,
+               "average_output": bool(self._average_output),
+               "stacked_avals": st_avals,
+               **runtime_signature()}
+        if kind == "contrib":
+            pack = self._contrib_pack(s, e)
+            sig["contrib_avals"] = [[list(map(int, a.shape)), str(a.dtype)]
+                                    for a in pack]
+        return sig
 
     def save_bundle(self, bundle_dir: str) -> int:
         """Serialize every cached executable into an AOT bundle; returns
@@ -509,9 +580,16 @@ class CompiledPredictor:
         return self.compile_count - before
 
     def predict(self, data, start_iteration: int = 0,
-                num_iteration: int = -1, raw_score: bool = False) -> np.ndarray:
+                num_iteration: int = -1, raw_score: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
         """Bucket-padded device predict; same signature subset and output
-        conventions as Booster.predict."""
+        conventions as Booster.predict.
+
+        ``pred_contrib=True`` runs the ``kind="contrib"`` program of the
+        same rung: SHAP values in the reference PredictContrib layout
+        ([N, (F+1)*K], per-class blocks of F features + bias), parity-
+        equal to ``Booster.predict(pred_contrib=True)`` within f32
+        honesty — rows sum to the raw prediction."""
         X = np.atleast_2d(np.asarray(data))
         # too-narrow input would silently traverse clamped feature indices
         # under jit and return plausible-looking garbage — reject it here.
@@ -527,6 +605,21 @@ class CompiledPredictor:
         n = X.shape[0]
         k = self.num_class
         s, e = self._iter_range(start_iteration, num_iteration)
+        if pred_contrib:
+            if e <= s or n == 0:
+                # zero trees contribute zero phi AND zero bias, matching
+                # predict_contrib on an empty tree list
+                return np.zeros((n, (self.num_feature + 1) * max(k, 1)))
+            bucket = row_bucket(n, self.buckets)
+            fn = self._get_compiled(self._cache_key(bucket, s, e, "contrib"))
+            padded, _, _ = self._padded_range(s, e)
+            pack = self._contrib_pack(s, e)
+            with timed("serving::predict"):
+                out = fn(padded, pack, jnp.asarray(pad_rows(X, bucket)))
+                out = np.asarray(out, np.float64)
+            if self.metrics is not None:
+                self.metrics.record_device(n)
+            return out[:n]
         kind = "raw" if raw_score else "prob"
         if e <= s or n == 0:
             raw = np.zeros((k, n)) if k > 1 else np.zeros((n,))

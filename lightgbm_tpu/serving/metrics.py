@@ -29,7 +29,8 @@ from typing import Dict, Optional
 from ..telemetry.registry import MetricsRegistry
 from ..timer import global_timer, timers_enabled
 
-__all__ = ["LatencyWindow", "ModelMetrics", "ServingMetrics"]
+__all__ = ["ExplainMetrics", "LatencyWindow", "ModelMetrics",
+           "ServingMetrics"]
 
 _PCTS = (50.0, 95.0, 99.0)
 
@@ -462,6 +463,151 @@ class ModelMetrics:
         return out
 
 
+class ExplainMetrics:
+    """Observables for one model's EXPLAIN lane (pred_contrib serving).
+
+    Explanations are ~D²·L heavier than predict per row, so they ride
+    their own MicroBatcher with their own SLO class — and their own
+    instrument family, because folding them into the predict counters
+    would poison the predict p99/goodput evidence the fleet router and
+    autoscaler act on.  Implements the full batcher-facing metrics
+    interface (record_request/record_batch/record_queue/... — see
+    MicroBatcher), so the explain lane plugs into the same machinery."""
+
+    def __init__(self, name: str = "default",
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+        self.name = name
+        lab = {"model": name}
+        self._requests = reg.counter(
+            "lgbm_serving_explain_requests_total",
+            "user-facing explain (pred_contrib) requests", **lab)
+        self._rows = reg.counter(
+            "lgbm_serving_explain_rows_total",
+            "rows across explain requests", **lab)
+        self._errors = reg.counter(
+            "lgbm_serving_explain_errors_total",
+            "failed explain requests", **lab)
+        self._batches = reg.counter(
+            "lgbm_serving_explain_batches_total",
+            "coalesced explain device flushes", **lab)
+        self._queue_rejections = reg.counter(
+            "lgbm_serving_explain_queue_rejections_total",
+            "explain requests rejected by queue backpressure", **lab)
+        self._deadline_refused = reg.counter(
+            "lgbm_serving_explain_deadline_refused_total",
+            "explain requests refused 504 because their deadline budget "
+            "could not cover the queue", **lab)
+        self._queue_depth = reg.gauge(
+            "lgbm_serving_explain_queue_depth",
+            "rows waiting in the explain micro-batch queue", **lab)
+        self._inflight_rows = reg.gauge(
+            "lgbm_serving_explain_inflight_rows",
+            "real rows in the explain batch currently executing on the "
+            "device (0 when idle)", **lab)
+        self._batch_fill = reg.gauge(
+            "lgbm_serving_explain_batch_fill",
+            "last explain flush's real rows over its padded bucket", **lab)
+        self._queue_wait_hist = reg.histogram(
+            "lgbm_serving_explain_queue_wait_ms",
+            "milliseconds an explain request spent queued before its "
+            "batch launched",
+            buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000,
+                     2000, 5000), **lab)
+        self._latency_hist = reg.histogram(
+            "lgbm_serving_explain_request_latency_seconds",
+            "user-facing explain request latency", **lab)
+        self.latency = LatencyWindow()
+        self.queue_wait = LatencyWindow(512, window_s=30.0)
+        self._queue_wait_cache = (-1e18, 0.0)
+        self.last_active_s = 0.0
+
+    # -- batcher-facing interface (mirrors ModelMetrics) ---------------
+    def record_request(self, rows: int, latency_s: Optional[float] = None,
+                       error: bool = False,
+                       deadline_miss: bool = False) -> None:
+        self._requests.inc()
+        self._rows.inc(int(rows))
+        self.last_active_s = time.time()
+        if error:
+            self._errors.inc()
+        if latency_s is not None:
+            self.latency.observe(latency_s)
+            self._latency_hist.observe(latency_s)
+
+    def record_device(self, rows: int) -> None:
+        # the predictor's own device counters belong to the MODEL
+        # metrics; the explain lane only tracks its own flushes
+        pass
+
+    def record_batch(self, n_requests: int, n_rows: int,
+                     device_s: float, fill: Optional[float] = None) -> None:
+        self._batches.inc()
+        if fill is not None:
+            self._batch_fill.set(float(fill))
+        if timers_enabled():
+            global_timer.add("serving::explain_batch", device_s)
+
+    def record_queue(self, depth: int) -> None:
+        self._queue_depth.set(int(depth))
+
+    def record_queue_wait(self, seconds: float) -> None:
+        self.queue_wait.observe(seconds)
+        self._queue_wait_hist.observe(float(seconds) * 1e3)
+
+    def queue_wait_estimate_s(self) -> float:
+        now = time.monotonic()
+        t, v = self._queue_wait_cache
+        if now - t < 0.05:
+            return v
+        v = self.queue_wait.percentiles()["p50_ms"] / 1e3
+        self._queue_wait_cache = (now, v)
+        return v
+
+    def record_deadline_refusal(self, counted_request: bool = False) -> None:
+        self._deadline_refused.inc()
+
+    def record_inflight(self, rows: int) -> None:
+        self._inflight_rows.set(int(rows))
+
+    def record_rejection(self) -> None:
+        self._queue_rejections.inc()
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
+
+    @property
+    def deadline_refused(self) -> int:
+        return int(self._deadline_refused.value)
+
+    def snapshot(self) -> Dict:
+        out = {
+            "requests": self.requests,
+            "rows": int(self._rows.value),
+            "errors": self.errors,
+            "batches": int(self._batches.value),
+            "queue_depth": self.queue_depth,
+            "queue_rejections": int(self._queue_rejections.value),
+            "deadline_refused": self.deadline_refused,
+            "inflight_rows": int(self._inflight_rows.value),
+            "batch_fill": round(float(self._batch_fill.value), 4),
+            "queue_wait_p50_ms": round(
+                self.queue_wait.percentiles()["p50_ms"], 3),
+        }
+        out.update(self.latency.percentiles())
+        return out
+
+
 class ServingMetrics:
     """name -> ModelMetrics, created on first touch; all models share this
     instance's MetricsRegistry (the Prometheus exporter's source)."""
@@ -469,6 +615,7 @@ class ServingMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
+        self._explain: Dict[str, ExplainMetrics] = {}
         self.registry = registry if registry is not None else MetricsRegistry()
         # construction wall time, exported in fleet_gauges: the router's
         # publish-replay logic uses a CHANGED boot_s as its restart
@@ -484,6 +631,15 @@ class ServingMetrics:
                 m = self._models[name] = ModelMetrics(name, self.registry)
             return m
 
+    def explain(self, name: str) -> ExplainMetrics:
+        """The explain-lane instruments for `name`, minted on first touch
+        like model() — the SLO class is separate all the way down."""
+        with self._lock:
+            m = self._explain.get(name)
+            if m is None:
+                m = self._explain[name] = ExplainMetrics(name, self.registry)
+            return m
+
     def refresh_slo_gauges(self) -> None:
         """Refresh every model's derived SLO gauges (p99 / deadline-miss
         ratio / goodput) — the Prometheus route calls this so scrapes
@@ -497,8 +653,13 @@ class ServingMetrics:
         compile_counts = compile_counts or {}
         with self._lock:
             names = list(self._models.items())
-        return {name: m.snapshot(compile_counts.get(name))
-                for name, m in names}
+            explain = list(self._explain.items())
+        out = {name: m.snapshot(compile_counts.get(name))
+               for name, m in names}
+        for name, m in explain:
+            # additive key, so the per-model dict shape stays intact
+            out[f"{name}:explain"] = m.snapshot()
+        return out
 
     def fleet_gauges(self) -> Dict:
         """Replica-level aggregate of the gauges the fleet router's SLO
@@ -514,10 +675,18 @@ class ServingMetrics:
         scrapes) — reads have no side effects."""
         with self._lock:
             models = list(self._models.items())
+            explain = list(self._explain.values())
         out = {"queue_rows": 0, "inflight_rows": 0, "p99_ms": 0.0,
                "batch_fill": 0.0, "queue_wait_ms": 0.0, "requests": 0,
                "errors": 0, "queue_rejections": 0, "boot_s": self.boot_s}
         now = time.time()
+        for m in explain:
+            # explain lanes share the process's device: their queued and
+            # in-flight rows are real load on this replica, so the
+            # capacity sums see them; their latency evidence stays OUT of
+            # p99/fill — the fleet SLO is the predict SLO class
+            out["queue_rows"] += m.queue_depth
+            out["inflight_rows"] += int(m._inflight_rows.value)
         for name, m in models:
             out["queue_rows"] += m.queue_depth
             out["inflight_rows"] += int(m._inflight_rows.value)

@@ -76,7 +76,7 @@ _MAX_HISTORY = 256
 
 class ModelRegistry:
     def __init__(self, metrics=None, buckets=None, dtype=None,
-                 cascade=None):
+                 cascade=None, explain_warmup: bool = False):
         self._lock = threading.Lock()
         self._models: Dict[str, _Model] = {}
         self._metrics = metrics
@@ -86,6 +86,11 @@ class ModelRegistry:
         # None): publish-time warmup must pre-compile the PREFIX rung too,
         # or the first cascade flush eats a compile in steady state
         self._cascade = cascade
+        # explain_warmup: pre-compile the kind="contrib" ladder at
+        # publish too, so the first explain request on a new version pays
+        # no compile.  Off by default — replicas that never serve
+        # explanations shouldn't spend publish latency on the programs
+        self._explain_warmup = bool(explain_warmup)
         from ..telemetry.registry import REGISTRY
         reg = (metrics.registry if metrics is not None
                and hasattr(metrics, "registry") else REGISTRY)
@@ -158,6 +163,11 @@ class ModelRegistry:
             predictor.load_bundle(aot_bundle_dir)
         if warmup:
             predictor.warmup()
+            if self._explain_warmup:
+                # explain lane rides the same ladder: warm the contrib
+                # programs so a published model's first explain is as
+                # compile-free as its first predict
+                predictor.warmup(kinds=("contrib",))
             casc = self._cascade
             if casc is not None and getattr(casc, "enabled", False):
                 # warm the cascade's prefix rung as RAW programs (the

@@ -67,11 +67,15 @@ class _RegistryDispatch:
     were in fact computed successfully."""
 
     def __init__(self, registry: ModelRegistry, name: str,
-                 cascade: Optional[CascadeConfig] = None, metrics=None):
+                 cascade: Optional[CascadeConfig] = None, metrics=None,
+                 pred_contrib: bool = False):
         self._registry = registry
         self._name = name
         self._cascade = cascade
         self._metrics = metrics
+        # explain-lane dispatch: flushes run the kind="contrib" program
+        # (SHAP layout, never cascaded — there is no prefix bound on phi)
+        self._pred_contrib = bool(pred_contrib)
         # advisory width + bucket ladder for the server's pre-coalesce
         # check and the batcher's fill gauge, refreshed at every flush so
         # the hot path never takes the registry lock just to read them;
@@ -85,6 +89,8 @@ class _RegistryDispatch:
         with self._registry.acquire(self._name) as (pred, version):
             self.num_feature = pred.num_feature
             self.buckets = pred.buckets
+            if self._pred_contrib:
+                return pred.predict(X, pred_contrib=True), version
             casc = self._cascade
             # the band cascade only pays when rows can actually exit
             # (epsilon > 0); epsilon<=0 would run prefix + completion on
@@ -115,7 +121,11 @@ class ServingApp:
                  tracer=None,
                  cascade_mode: str = "off",
                  cascade_prefix_trees: int = 0,
-                 cascade_epsilon: float = 0.0):
+                 cascade_epsilon: float = 0.0,
+                 explain_max_batch: int = 256,
+                 explain_max_wait_ms: float = 4.0,
+                 explain_default_deadline_ms: float = 0.0,
+                 explain_warmup: bool = False):
         self.metrics = metrics or ServingMetrics()
         # early-exit cascade (serving/cascade.py): band mode exits
         # confident rows after the forest prefix inside coalesced
@@ -123,8 +133,9 @@ class ServingApp:
         # (prefix-only answer instead of a deadline 504)
         self.cascade = CascadeConfig(cascade_mode, cascade_prefix_trees,
                                      cascade_epsilon)
-        self.registry = registry or ModelRegistry(metrics=self.metrics,
-                                                  cascade=self.cascade)
+        self.registry = registry or ModelRegistry(
+            metrics=self.metrics, cascade=self.cascade,
+            explain_warmup=explain_warmup)
         self.batching = batching
         # distributed tracing (telemetry/trace.py): adopts the wire
         # context a router forwarded in the request body, or roots a new
@@ -139,6 +150,17 @@ class ServingApp:
                                max_queue_rows=max_queue_rows,
                                continuous=continuous)
         self._batchers: Dict[str, MicroBatcher] = {}
+        # the explain lane's OWN SLO class: explanations are ~D²·L
+        # heavier than predict per row, so they get their own batcher
+        # (smaller batches, longer coalesce window, separate deadline
+        # default) and never queue behind — or ahead of — latency-
+        # critical predicts
+        self.explain_default_deadline_ms = float(explain_default_deadline_ms)
+        self._explain_cfg = dict(max_batch=explain_max_batch,
+                                 max_wait_ms=explain_max_wait_ms,
+                                 max_queue_rows=max_queue_rows,
+                                 continuous=continuous)
+        self._explain_batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._closed = False
         # admitted predict-request counter, feeding env-driven fault
@@ -174,6 +196,20 @@ class ServingApp:
                     metrics=self.metrics.model(name), **self._batch_cfg)
             return b
 
+    def _explain_batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("ServingApp is closed")
+            b = self._explain_batchers.get(name)
+            if b is None:
+                # same 404-before-allocation invariant as _batcher: the
+                # dispatch ctor's acquire raises for unpublished names
+                b = self._explain_batchers[name] = MicroBatcher(
+                    _RegistryDispatch(self.registry, name,
+                                      pred_contrib=True),
+                    metrics=self.metrics.explain(name), **self._explain_cfg)
+            return b
+
     def close(self) -> None:
         """Stop admitting requests, then DRAIN: every request already
         admitted (queued or in flight in some batcher) resolves its
@@ -183,7 +219,11 @@ class ServingApp:
         with self._lock:
             self._closed = True
             batchers, self._batchers = dict(self._batchers), {}
+            explain, self._explain_batchers = \
+                dict(self._explain_batchers), {}
         for b in batchers.values():
+            b.close()
+        for b in explain.values():
             b.close()
 
     # ------------------------------------------------------------------
@@ -254,12 +294,20 @@ class ServingApp:
                 return 404, {"error": f"no trace {tid!r} in this "
                                       "process's flight recorder"}
             return 200, own
+        if (method == "POST" and path.startswith("/v1/models/")
+                and path.endswith("/explain") and ":" not in path):
+            # REST-style alias for the explain verb
+            name = path[len("/v1/models/"):-len("/explain")]
+            if name:
+                return self._explain(name, body)
         if path.startswith("/v1/models/") and ":" in path:
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
             if method == "POST" and name:
                 if verb == "predict":
                     return self._predict(name, body)
+                if verb == "explain":
+                    return self._explain(name, body)
                 if verb == "publish":
                     return self._publish(name, body)
                 if verb == "rollback":
@@ -358,6 +406,106 @@ class ServingApp:
         span.finish_request(status=status)
         return status, payload
 
+    def _explain(self, name: str, body: dict) -> Tuple[int, dict]:
+        """Trace wrapper around the explain path (same outcome mapping
+        discipline as _predict, its own span name)."""
+        ctx = body.get(_trace.BODY_KEY)
+        span = self.tracer.start_request(
+            "replica.explain", ctx=ctx if isinstance(ctx, dict) else None,
+            model=name)
+        if span is None:
+            return self._explain_inner(name, body, None)
+        try:
+            with _trace.activate(span):
+                status, payload = self._explain_inner(name, body, span)
+        except QueueFullError:
+            span.finish_request(status=429)
+            raise
+        except DeadlineExceededError:
+            span.finish_request(status=504)
+            raise
+        except ServingClosedError:
+            span.finish_request(status=503)
+            raise
+        except LightGBMError as exc:
+            span.finish_request(
+                status=404 if "no model published" in str(exc) else 400,
+                error=str(exc))
+            raise
+        except (KeyError, ValueError, TypeError, OSError) as exc:
+            span.finish_request(status=400, error=f"{type(exc).__name__}")
+            raise
+        except Exception as exc:
+            span.finish_request(status=500, error=repr(exc))
+            raise
+        if isinstance(payload, dict):
+            span.set(version=payload.get("version"))
+            payload.setdefault("trace_id", span.trace_id)
+        span.finish_request(status=status)
+        return status, payload
+
+    def _explain_inner(self, name: str, body: dict,
+                       span) -> Tuple[int, dict]:
+        """pred_contrib as a served output: SHAP values in the reference
+        layout (per-class blocks of [F features + bias]), coalesced on
+        the model's EXPLAIN lane with its own SLO class."""
+        self._fault_latch.maybe_inject(next(self._served))
+        rows = np.asarray(body["rows"], dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
+        t0 = time.perf_counter()
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None and self.explain_default_deadline_ms > 0:
+            deadline_ms = self.explain_default_deadline_ms
+        deadline_t = None
+        if deadline_ms is not None:
+            deadline_t = t0 + float(deadline_ms) / 1e3
+            if float(deadline_ms) <= 0:
+                self.registry.current_version(name)   # 404 before metrics
+                self.metrics.explain(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget already spent "
+                    f"({float(deadline_ms):g}ms)")
+        kwargs = {}
+        for key in ("start_iteration", "num_iteration"):
+            if key in body:
+                kwargs[key] = int(body[key])
+        version = body.get("version")
+        if not kwargs and version is None and self.batching:
+            batcher = self._explain_batcher(name)
+            nfeat = batcher.predictor.num_feature
+            if rows.shape[1] < nfeat:
+                raise LightGBMError(
+                    f"explain called with {rows.shape[1]} features; model "
+                    f"{name!r} expects {nfeat}")
+            out, meta = batcher.predict(rows, deadline_t=deadline_t,
+                                        trace_span=span)
+            served_version = meta
+        else:
+            if (deadline_t is not None
+                    and time.perf_counter() >= deadline_t):
+                self.registry.current_version(name)
+                self.metrics.explain(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget ({float(deadline_ms):g}ms) spent "
+                    "before dispatch")
+            dspan = (None if span is None
+                     else span.child("replica.device.contrib",
+                                     rows=int(rows.shape[0])))
+            try:
+                with self.registry.acquire(name, version) as (pred, v):
+                    out = pred.predict(rows, pred_contrib=True, **kwargs)
+                    served_version = v
+            finally:
+                if dspan is not None:
+                    dspan.finish()
+            self.metrics.explain(name).record_request(
+                rows.shape[0], latency_s=time.perf_counter() - t0)
+        return 200, {"name": name, "version": served_version,
+                     "contributions": np.asarray(out).tolist()}
+
     def _predict_inner(self, name: str, body: dict,
                        span) -> Tuple[int, dict]:
         # fault injection BEFORE serving: a killed replica loses this
@@ -445,6 +593,56 @@ class ServingApp:
             if info is not None:
                 resp["exited_early"] = [bool(x) for x in info["exited"]]
                 resp["prefix_iterations"] = int(info["prefix_iterations"])
+            return 200, resp
+        req_eps = body.get("cascade_epsilon")
+        if req_eps is not None:
+            # per-request cascade epsilon: the client picks its own
+            # accuracy/latency trade inside the operator's bound.
+            # Clamped to the server-configured epsilon (the max a client
+            # may loosen to; 0.0 when the cascade is off) and echoed as
+            # "cascade_epsilon" so callers see what was actually applied.
+            # Direct path — a coalesced flush shares ONE epsilon, so a
+            # request pinning its own cannot ride the shared queue.
+            eff = 0.0
+            if self.cascade.enabled:
+                eff = min(max(float(req_eps), 0.0),
+                          float(self.cascade.epsilon))
+            if (deadline_t is not None
+                    and time.perf_counter() >= deadline_t):
+                self.registry.current_version(name)
+                self.metrics.model(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget ({float(deadline_ms):g}ms) spent "
+                    "before dispatch")
+            dspan = (None if span is None
+                     else span.child("replica.device",
+                                     rows=int(rows.shape[0])))
+            info = None
+            try:
+                with self.registry.acquire(name, version) as (pred, v):
+                    served_version = v
+                    if (eff > 0.0
+                            and not getattr(pred, "_average_output",
+                                            False)):
+                        out, info = pred.predict_cascade(
+                            rows,
+                            prefix_iterations=self.cascade.prefix_trees,
+                            epsilon=eff, **kwargs)
+                    else:
+                        out = pred.predict(rows, **kwargs)
+            finally:
+                if dspan is not None:
+                    dspan.finish()
+            m = self.metrics.model(name)
+            resp = {"name": name, "version": served_version,
+                    "predictions": np.asarray(out).tolist(),
+                    "cascade_epsilon": eff}
+            if info is not None:
+                m.record_early_exit(info["n_exited"], rows.shape[0])
+                resp["exited_early"] = [bool(x) for x in info["exited"]]
+                resp["prefix_iterations"] = int(info["prefix_iterations"])
+            m.record_request(rows.shape[0],
+                             latency_s=time.perf_counter() - t0)
             return 200, resp
         if default_call and self.batching:
             # reject too-narrow bodies BEFORE coalescing so the error is

@@ -279,8 +279,14 @@ class Tree:
                  f"leaf_value={arr(self.leaf_value[:n], '{:.17g}')}",
                  f"leaf_weight={arr(self.leaf_weight[:n], '{:.17g}')}",
                  f"leaf_count={arr(self.leaf_count[:n], '{:d}')}",
-                 f"internal_value={arr(self.internal_value[:ni], '{:g}')}",
-                 f"internal_weight={arr(self.internal_weight[:ni], '{:g}')}",
+                 # full precision, NOT %g: pred_contrib reads
+                 # internal_value/internal_weight as the per-node
+                 # expected values, so a save/load round-trip must not
+                 # drift a loaded model's explanations off the trained
+                 # model's (predictions never read these, which is how
+                 # the loss hid)
+                 f"internal_value={arr(self.internal_value[:ni], '{:.17g}')}",
+                 f"internal_weight={arr(self.internal_weight[:ni], '{:.17g}')}",
                  f"internal_count={arr(self.internal_count[:ni], '{:d}')}"]
         if self.num_cat > 0:
             lines.append(f"cat_boundaries={arr(self.cat_boundaries, '{:d}')}")

@@ -1,0 +1,392 @@
+"""Explanation serving tier tests (ISSUE 18).
+
+Four contracts, each tested in isolation:
+
+1. **Device/host parity** — the ladder-compiled ``kind="contrib"``
+   program (explain/paths.py packed per-leaf path tables, null-padded to
+   the tree bucket) matches ``Booster.predict(pred_contrib=True)`` within
+   f32 honesty across regression/multiclass/categorical/NaN inputs, and
+   every row's contributions sum to its raw score.
+2. **Zero compiles on a warm rung** — contrib programs ride the same
+   shared tree-bucket ladder as predict: post-warmup traffic compiles
+   nothing, a second same-config model adopts the rung for free, and the
+   traced program embeds no large constants (the jaxpr-const discipline
+   tests/test_placement.py enforces for predict).
+3. **Serving product** — ``POST /v1/models/<name>:explain`` (and the
+   ``/explain`` REST alias) on replica and router, with the explain
+   lane's own SLO class: separate batcher, deadline default, and
+   ``lgbm_{serving,fleet}_explain_*`` metric families that never mix
+   with the predict lane's.
+4. **Attribution drift** — the AttributionSketch flags covariate shift
+   from per-feature mean-|phi| profiles without labels, and the publish
+   gate can hold publishes while the alarm is pending.
+
+Everything runs in-process on the CPU backend; router tests use
+transport-free replicas, mirroring tests/test_fleet_gray.py.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.continuous.gate import PublishGate
+from lightgbm_tpu.explain import AttributionSketch
+from lightgbm_tpu.fleet import FleetRouter
+from lightgbm_tpu.serving.compiled import clear_shared_programs
+from lightgbm_tpu.serving.registry import ModelRegistry
+from lightgbm_tpu.serving.server import ServingApp
+from lightgbm_tpu.telemetry import MetricsRegistry
+
+RNG = np.random.RandomState(18)
+
+
+def _train_reg(n=400, nfeat=4, rounds=5):
+    X = RNG.randn(n, nfeat)
+    y = (X[:, 0] + 0.5 * X[:, 1] * (X[:, 2] > 0)
+         + 0.1 * RNG.randn(n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+              "min_data_in_leaf": 20, "learning_rate": 0.5}
+    return lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds), X
+
+
+@pytest.fixture(scope="module")
+def reg_booster():
+    return _train_reg()
+
+
+@pytest.fixture(scope="module")
+def mc_booster():
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbosity": -1, "min_data_in_leaf": 10}
+    return lgb.train(params, lgb.Dataset(X, y.astype(np.float32)),
+                     num_boost_round=3), X
+
+
+def _assert_contrib_parity(bst, Xq, atol=5e-6):
+    """Device ladder contrib vs host reference, plus the sum-to-raw
+    identity (per class, within f32 honesty)."""
+    host = bst.predict(Xq, pred_contrib=True)
+    pred = bst.to_compiled()
+    dev = pred.predict(Xq, pred_contrib=True)
+    assert host.shape == dev.shape
+    np.testing.assert_allclose(dev, host, atol=atol, rtol=1e-5)
+    k = bst.num_model_per_iteration()
+    f = pred.num_feature
+    raw = bst.predict(Xq, raw_score=True)
+    raw = raw.reshape(len(Xq), k) if k > 1 else raw[:, None]
+    rows = dev.reshape(len(Xq), k, f + 1).sum(axis=2)
+    np.testing.assert_allclose(rows, raw, atol=atol, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Device/host parity
+# ---------------------------------------------------------------------------
+def test_contrib_parity_regression_with_nan(reg_booster):
+    bst, X = reg_booster
+    Xq = X[:32].copy()
+    Xq[3, 1] = np.nan
+    _assert_contrib_parity(bst, Xq)
+
+
+def test_contrib_parity_multiclass(mc_booster):
+    bst, X = mc_booster
+    Xq = X[:16].copy()
+    Xq[2, 0] = np.nan
+    _assert_contrib_parity(bst, Xq)
+
+
+def test_contrib_parity_categorical():
+    rng = np.random.RandomState(11)
+    X = rng.randn(500, 4)
+    X[:, 0] = rng.randint(0, 8, size=500)
+    y = (X[:, 0] % 3 == 1).astype(float) + 0.3 * X[:, 1]
+    bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y.astype(np.float32),
+                                categorical_feature=[0]),
+                    num_boost_round=4)
+    Xq = X[:24].copy()
+    Xq[1, 0] = np.nan
+    _assert_contrib_parity(bst, Xq)
+
+
+def test_loaded_vs_trained_contrib_bitwise(reg_booster):
+    """Satellite bugfix: internal_value/internal_weight serialize at
+    full %.17g precision, so a save/load round-trip's explanations are
+    BIT-equal to the trained model's (predictions never read those
+    fields, which is how the %g loss hid)."""
+    bst, X = reg_booster
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    a = bst.predict(X[:50], pred_contrib=True)
+    b = loaded.predict(X[:50], pred_contrib=True)
+    assert np.array_equal(a, b), float(np.abs(a - b).max())
+
+
+# ---------------------------------------------------------------------------
+# Program ladder: zero compiles on a warm rung, const discipline
+# ---------------------------------------------------------------------------
+def test_contrib_zero_compiles_after_warmup(reg_booster):
+    clear_shared_programs()
+    bst, X = reg_booster
+    pred = bst.to_compiled(buckets=(8, 64))
+    assert pred.warmup(kinds=("contrib",)) > 0
+    before = pred.compile_count
+    rng = np.random.RandomState(5)
+    for size in (1, 7, 8, 33, 64):
+        pred.predict(rng.randn(size, 4), pred_contrib=True)
+    assert pred.compile_count == before
+    # a second same-config model adopts the shared rung for free
+    bst2, _ = _train_reg(rounds=5)
+    pred2 = bst2.to_compiled(buckets=(8, 64))
+    assert pred2.warmup(kinds=("contrib",)) == 0
+    assert pred2.compile_count == 0
+
+
+def test_contrib_program_embeds_no_large_constants(reg_booster):
+    """Same discipline test_placement.py enforces for predict programs:
+    the traced contrib program must carry the path tables as ARGUMENTS,
+    not baked-in jaxpr constants (a constant per model would defeat
+    rung sharing and bloat every executable)."""
+    import jax
+
+    bst, _ = reg_booster
+    pred = bst.to_compiled()
+    key = pred._cache_key(64, 0, pred.n_iterations, "contrib")
+    fn, args = pred._predict_fn(key)
+    closed = jax.make_jaxpr(fn)(*args)
+    sizes = [int(np.size(c)) for c in closed.consts if hasattr(c, "shape")]
+    assert max(sizes, default=0) <= 64, sizes
+
+
+# ---------------------------------------------------------------------------
+# Replica serving: routes, SLO class, metrics
+# ---------------------------------------------------------------------------
+def test_explain_route_verb_and_alias(reg_booster):
+    bst, X = reg_booster
+    app = ServingApp()
+    st, _ = app.handle("POST", "/v1/models/m:publish",
+                       {"model_str": bst.model_to_string()})
+    assert st == 200
+    host = bst.predict(X[:6], pred_contrib=True)
+    st, r = app.handle("POST", "/v1/models/m:explain",
+                       {"rows": X[:6].tolist()})
+    assert st == 200, r
+    got = np.asarray(r["contributions"])
+    assert got.shape == host.shape
+    np.testing.assert_allclose(got, host, atol=5e-6, rtol=1e-5)
+    assert r["version"] == 1
+    st, r = app.handle("POST", "/v1/models/m/explain",
+                       {"rows": X[:3].tolist()})
+    assert st == 200 and np.asarray(r["contributions"]).shape == (3, 5)
+    st, _ = app.handle("POST", "/v1/models/nope:explain",
+                       {"rows": X[:2].tolist()})
+    assert st == 404
+    app.close()
+
+
+def test_explain_lane_deadline_and_metrics(reg_booster):
+    bst, X = reg_booster
+    app = ServingApp(explain_default_deadline_ms=5000.0)
+    app.handle("POST", "/v1/models/m:publish",
+               {"model_str": bst.model_to_string()})
+    st, r = app.handle("POST", "/v1/models/m:explain",
+                       {"rows": X[:4].tolist()})
+    assert st == 200, r
+    # an already-spent budget is refused up front, and counted in the
+    # explain lane's OWN family
+    st, _ = app.handle("POST", "/v1/models/m:explain",
+                       {"rows": X[:2].tolist(), "deadline_ms": 0})
+    assert st == 504
+    em = app.metrics.explain("m")
+    assert em.requests >= 1 and em.deadline_refused == 1
+    st, snap = app.handle("GET", "/v1/metrics", None)
+    assert "m:explain" in snap
+    assert snap["m:explain"]["deadline_refused"] == 1
+    # predict-lane metrics stay untouched by explain traffic
+    assert snap["m"]["requests"] == 0
+    st, prom = app.handle("GET", "/v1/metrics/prometheus", None)
+    text = prom["text"] if isinstance(prom, dict) else prom
+    assert "lgbm_serving_explain_requests_total" in text
+    assert "lgbm_serving_explain_deadline_refused_total" in text
+    app.close()
+
+
+def test_per_request_cascade_epsilon_clamped_and_echoed(reg_booster):
+    """Satellite: a predict body's cascade_epsilon widens/narrows the
+    band PER REQUEST, clamped to the server's configured maximum, and
+    the effective value is echoed back."""
+    bst, X = reg_booster
+    app = ServingApp(cascade_mode="band", cascade_prefix_trees=2,
+                     cascade_epsilon=0.1)
+    app.handle("POST", "/v1/models/m:publish",
+               {"model_str": bst.model_to_string()})
+    st, r = app.handle("POST", "/v1/models/m:predict",
+                       {"rows": X[:8].tolist(), "cascade_epsilon": 99.0})
+    assert st == 200 and r["cascade_epsilon"] == 0.1
+    assert "exited_early" in r and "prefix_iterations" in r
+    st, r = app.handle("POST", "/v1/models/m:predict",
+                       {"rows": X[:8].tolist(), "cascade_epsilon": 0.02})
+    assert st == 200 and r["cascade_epsilon"] == 0.02
+    st, r = app.handle("POST", "/v1/models/m:predict",
+                       {"rows": X[:8].tolist(), "cascade_epsilon": -5})
+    assert st == 200 and r["cascade_epsilon"] == 0.0
+    # answers with epsilon clamped off are bit-identical to plain serving
+    plain = bst.to_compiled().predict(X[:8])
+    assert np.array_equal(np.asarray(r["predictions"]), plain)
+    app.close()
+    # cascade off: the knob echoes 0.0 and changes nothing
+    app2 = ServingApp()
+    app2.handle("POST", "/v1/models/m:publish",
+                {"model_str": bst.model_to_string()})
+    st, r = app2.handle("POST", "/v1/models/m:predict",
+                        {"rows": X[:4].tolist(), "cascade_epsilon": 0.5})
+    assert st == 200 and r["cascade_epsilon"] == 0.0
+    app2.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet router forwarding
+# ---------------------------------------------------------------------------
+class _AppReplica:
+    """Transport-free endpoint over a real in-process ServingApp."""
+
+    def __init__(self, name, app):
+        self.name = name
+        self.app = app
+
+    def health(self, timeout_s=2.0):
+        st, body = self.app.handle("GET", "/v1/fleet/health", None)
+        return body.get("gauges", {}) if st == 200 else None
+
+    def request(self, method, path, body=None, timeout_s=None):
+        return self.app.handle(method, path, body)
+
+
+def test_router_forwards_explain_with_own_metric_family(reg_booster):
+    bst, X = reg_booster
+    apps = [ServingApp(), ServingApp()]
+    router = FleetRouter(
+        [_AppReplica(f"r{i}", a) for i, a in enumerate(apps)],
+        poll_interval_ms=0, autostart=False)
+    router.poll_once()
+    st, _ = router.handle("POST", "/v1/models/m:publish",
+                          {"model_str": bst.model_to_string()})
+    assert st == 200
+    host = bst.predict(X[:6], pred_contrib=True)
+    st, r = router.handle("POST", "/v1/models/m:explain",
+                          {"rows": X[:6].tolist()})
+    assert st == 200, r
+    np.testing.assert_allclose(np.asarray(r["contributions"]), host,
+                               atol=5e-6, rtol=1e-5)
+    st, r = router.handle("POST", "/v1/models/m/explain",
+                          {"rows": X[:3].tolist()})
+    assert st == 200
+    st, _ = router.handle("POST", "/v1/models/m:explain",
+                          {"rows": X[:2].tolist(), "deadline_ms": 0})
+    assert st == 504
+    st, _ = router.handle("POST", "/v1/models/m:predict",
+                          {"rows": X[:4].tolist()})
+    assert st == 200
+    snap = router.registry.snapshot()
+    assert snap["lgbm_fleet_explain_requests_total"]["model=m"] == 3.0
+    assert snap["lgbm_fleet_explain_deadline_missed_total"]["model=m"] == 1.0
+    # the predict family counts ONLY the predict
+    assert snap["lgbm_fleet_requests_total"]["model=m"] == 1.0
+    # the explain stats row must not mint a phantom model-table entry
+    st, tbl = router.handle("GET", "/v1/fleet/models", None)
+    assert sorted(tbl["models"]) == ["m"]
+    router.refresh_model_gauges()
+    snap = router.registry.snapshot()
+    assert "lgbm_fleet_explain_p99_ms" in snap
+    router.close()
+    for a in apps:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# Attribution drift: sketch + gate
+# ---------------------------------------------------------------------------
+def test_attribution_sketch_pins_reference_then_scores_shift():
+    rng = np.random.RandomState(0)
+    sk = AttributionSketch(3, ref_windows=2)
+    base = np.abs(rng.randn(100, 3))
+    for _ in range(4):
+        sk.observe(np.abs(rng.randn(100, 3)))
+    assert sk.max_score() < 0.2
+    shifted = np.abs(rng.randn(100, 3))
+    shifted[:, 1] *= 4.0
+    for _ in range(3):
+        sk.observe(shifted)
+    scores = sk.scores()
+    assert np.argmax(scores) == 1 and scores[1] > 0.5
+    # state round-trip preserves the verdict
+    sk2 = AttributionSketch(3, ref_windows=2)
+    sk2.load_state(sk.state_dict())
+    np.testing.assert_allclose(sk2.scores(), scores)
+    with pytest.raises(Exception):
+        sk2.load_state({**sk.state_dict(), "ref_sum": np.zeros(5)})
+    del base
+
+
+def test_gate_attrib_alarm_gates_publish_until_settled():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + 0.8 * X[:, 1] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=10)
+    mstr = bst.model_to_string()
+    reg = MetricsRegistry()
+    gate = PublishGate(ModelRegistry(), "m", min_auc=0.5,
+                       metrics_registry=reg, attrib_threshold=0.3,
+                       attrib_sample=128, attrib_gate=True)
+    assert gate.consider(mstr, 0.9, cycle=0)["action"] == "publish"
+    # stable windows: reference pins, no alarm
+    for _ in range(4):
+        assert gate.watch_attribution(rng.randn(200, 5)) is None
+    # covariate shift on feature 1 fires the label-free alarm
+    Xs = rng.randn(200, 5)
+    Xs[:, 1] = 4.0
+    ev = gate.watch_attribution(Xs)
+    assert ev is not None and ev["action"] == "attrib-alarm"
+    assert ev["top"]["top_features"][0]["feature"] == 1
+    assert reg.snapshot()["lgbm_continuous_attrib_alarm_total"]["_"] >= 1
+    # pending alarm holds publishes (reason attrib-drift)...
+    ev = gate.consider(mstr, 0.9, cycle=1)
+    assert ev["action"] == "reject" and ev["reason"] == "attrib-drift"
+    # ...until the profile settles back under the threshold
+    for _ in range(6):
+        gate.watch_attribution(rng.randn(200, 5))
+    assert gate.consider(mstr, 0.9, cycle=2)["action"] == "publish"
+
+
+def test_gate_attrib_off_by_default_and_warn_only_mode():
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=5)
+    mstr = bst.model_to_string()
+    # threshold 0 = off: no sketch, no explain cost
+    gate = PublishGate(ModelRegistry(), "m", min_auc=0.5)
+    gate.consider(mstr, 0.9)
+    assert gate.watch_attribution(rng.randn(50, 4)) is None
+    assert gate.sketch is None
+    # warn-only (attrib_gate=False): alarm fires but publish still flows
+    gate = PublishGate(ModelRegistry(), "m", min_auc=0.5,
+                       attrib_threshold=0.05, attrib_sample=64)
+    gate.consider(mstr, 0.9, cycle=0)
+    for _ in range(3):
+        gate.watch_attribution(rng.randn(100, 4))
+    # pin the driving feature AT the decision boundary: its attributions
+    # collapse toward zero — a large mean-|phi| profile shift
+    Xs = rng.randn(100, 4)
+    Xs[:, 0] = 0.0
+    for _ in range(3):
+        gate.watch_attribution(Xs)
+    assert gate._attrib_alarm_pending
+    assert gate.consider(mstr, 0.9, cycle=1)["action"] == "publish"

@@ -861,7 +861,8 @@ def test_fleet_and_serving_params_documented():
     with open(readme, encoding="utf-8") as fh:
         text = fh.read()
     scoped = [p for p in _PARAMS
-              if p.name.startswith(("fleet_", "serving_", "cascade_"))]
+              if p.name.startswith(("fleet_", "serving_", "cascade_",
+                                    "explain_", "continuous_attrib_"))]
     assert len(scoped) >= 34      # the guard guards something real
     # ISSUE-16: the multi-tenant control plane shipped its own knob
     # families — placement + autoscaling must stay covered by this guard
@@ -871,14 +872,20 @@ def test_fleet_and_serving_params_documented():
     # ISSUE-17: the early-exit cascade's knob family
     casc = [p.name for p in scoped if p.name.startswith("cascade_")]
     assert len(casc) >= 3, casc
+    # ISSUE-18: the explanation serving tier's knob families
+    expl = [p.name for p in scoped if p.name.startswith("explain_")]
+    assert len(expl) >= 4, expl
+    attrib = [p.name for p in scoped
+              if p.name.startswith("continuous_attrib_")]
+    assert len(attrib) >= 3, attrib
     missing_desc = [p.name for p in scoped if not (p.desc or "").strip()]
     assert not missing_desc, (
-        f"fleet_*/serving_*/cascade_* params without a desc: "
-        f"{missing_desc}")
+        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_* "
+        f"params without a desc: {missing_desc}")
     missing_doc = [p.name for p in scoped if p.name not in text]
     assert not missing_doc, (
-        f"fleet_*/serving_*/cascade_* params not mentioned in README.md: "
-        f"{missing_doc}")
+        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_* "
+        f"params not mentioned in README.md: {missing_doc}")
 
 
 def test_compiled_predictor_cache_key_carries_tree_bucket():
