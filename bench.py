@@ -48,6 +48,17 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  `aot` adds fused_per_iter_s / aot_load_s /
                  compiles_steady from a cold-start-with-bundle probe
                  (lightgbm_tpu/aot/; compiles_steady == 0 is the bar).
+- train_multiclass  class-parallel fused multiclass training proof
+                 (run_train_multiclass): pair-trains the SAME multiclass
+                 workload through the legacy sequential per-class loop
+                 (fusion force-disabled for that arm) and the
+                 class-parallel fused block, reporting per-iter wall
+                 clock for both arms, device dispatches per iteration
+                 (lgbm_train_device_dispatches_total deltas — the hard
+                 gate: num_class per round sequential vs 1/K fused),
+                 steady-state compiles on the measured fused run (bar:
+                 0), and bit-identity of the two models.  Knobs:
+                 BENCH_MC_{ROWS,CLASSES,ITERS,LEAVES,FUSED_ROUNDS}.
 - serve          serving throughput/latency through lightgbm_tpu/serving/:
                  sustained rows/s, p50/p99 latency, batch-fill ratio, a
                  steady-state compile count, and a cold-start-with-bundle
@@ -529,6 +540,132 @@ def run_training():
         "per_iter_s": round(elapsed / max(iters, 1), 4),
         "backend": backend,
         "n_trees": n_trees,
+    }), flush=True)
+
+
+def run_train_multiclass():
+    """Child body for BENCH_STAGE=train_multiclass: prove the
+    class-parallel fused multiclass block (ISSUE 19).
+
+    The pre-ISSUE trainer ran ONE grower program per (round, class) from
+    a host loop; the fused block grows all num_class trees per round
+    inside the K-round scan, so dispatches/iter drop from num_class to
+    1/K.  Both arms train the identical workload; the sequential arm
+    force-disables fusion (the legacy `_can_fuse() -> num_class == 1`
+    gate, reinstated for the measurement) rather than attaching a valid
+    set, so it pays no observer overhead the old path didn't.  Hard
+    gates: the dispatch counts, zero steady compiles on the measured
+    fused run, and model bit-identity between the arms."""
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.telemetry.registry import get_counter
+    from lightgbm_tpu.telemetry.training import compile_tracker
+
+    # sized so two arms x 8 iters fit the default 520 s parent budget on
+    # CPU; raise BENCH_MC_ROWS on real hardware
+    rows = int(os.environ.get("BENCH_MC_ROWS", 20_000))
+    num_class = int(os.environ.get("BENCH_MC_CLASSES", 5))
+    max_iters = int(os.environ.get("BENCH_MC_ITERS", 24))
+    leaves = int(os.environ.get("BENCH_MC_LEAVES", 31))
+    fused_k = int(os.environ.get("BENCH_MC_FUSED_ROUNDS", 8))
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, N_FEATURES).astype(np.float32)
+    W = rng.randn(N_FEATURES, num_class).astype(np.float32)
+    logits = X @ W + 0.8 * rng.randn(rows, num_class).astype(np.float32)
+    y = np.argmax(logits, axis=1).astype(np.float64)
+
+    params = {"objective": "multiclass", "num_class": num_class,
+              "num_leaves": leaves, "learning_rate": 0.1,
+              "verbosity": -1, "min_data_in_leaf": 100,
+              "max_bin": MAX_BIN}
+    train_set = lgb.Dataset(X, y)
+    train_set.construct()
+    disp = get_counter(None, "lgbm_train_device_dispatches_total")
+    compile_tracker.install()
+    fp = dict(params, fused_rounds=fused_k)
+
+    # warmups compile both arms' programs OUTSIDE the clocks (the hist
+    # stage's timeit convention) and size the measured runs to the budget
+    t0 = time.time()
+    lgb.train(fp, train_set, num_boost_round=fused_k).num_trees()
+    fused_warm_s = time.time() - t0
+    orig_can_fuse = GBDT._can_fuse
+    try:
+        GBDT._can_fuse = lambda self: False
+        t0 = time.time()
+        lgb.train(params, train_set, num_boost_round=2).num_trees()
+        seq_warm_per_iter = max((time.time() - t0) / 2.0, 1e-4)
+    finally:
+        GBDT._can_fuse = orig_can_fuse
+    per_iter_est = seq_warm_per_iter + fused_warm_s / fused_k
+    budget = (deadline - time.time()) - 20.0
+    iters = int(min(max_iters, max(fused_k, budget / per_iter_est)))
+    iters -= iters % fused_k          # whole blocks: exact dispatch math
+    iters = max(iters, fused_k)
+    print(f"BENCH_PLAN iters={iters} per_iter_est={per_iter_est:.3f}s",
+          flush=True)
+
+    # measured fused arm: warm programs -> the compile bar is 0
+    c0 = compile_tracker.snapshot()[0]
+    d0 = disp.value
+    t0 = time.time()
+    bst_fused = lgb.train(fp, train_set, num_boost_round=iters)
+    bst_fused.num_trees()             # forces the lazy flush -> full sync
+    fused_s = time.time() - t0
+    fused_disp = disp.value - d0
+    steady_compiles = compile_tracker.snapshot()[0] - c0
+
+    # measured sequential arm: the legacy per-class host loop
+    orig_can_fuse = GBDT._can_fuse
+    try:
+        GBDT._can_fuse = lambda self: False
+        d0 = disp.value
+        t0 = time.time()
+        bst_seq = lgb.train(params, train_set, num_boost_round=iters)
+        bst_seq.num_trees()
+        seq_s = time.time() - t0
+        seq_disp = disp.value - d0
+    finally:
+        GBDT._can_fuse = orig_can_fuse
+
+    # the class axis must not change a single split: fused_rounds rides
+    # params (ignored by the model printer), so full strings compare
+    bit_identical = (bst_seq.model_to_string().split("\n\n", 1)[1]
+                     == bst_fused.model_to_string().split("\n\n", 1)[1])
+    bars = {
+        "dispatches_per_iter_sequential_is_num_class":
+            seq_disp == iters * num_class,
+        "dispatches_per_iter_fused_is_one_per_block":
+            fused_disp == iters // fused_k,
+        "zero_steady_compiles": steady_compiles == 0,
+        "bit_identical": bit_identical,
+    }
+    print("BENCH_RESULT " + json.dumps({
+        "metric": f"train_multiclass_{rows}rows_{num_class}class_"
+                  f"{iters}iters_{leaves}leaves",
+        "value": round(fused_s / iters, 4),
+        "unit": "s_per_iter_fused",
+        "vs_baseline": round(seq_s / fused_s, 4) if fused_s > 0 else 0.0,
+        "bars": bars,
+        "sequential_per_iter_s": round(seq_s / iters, 4),
+        "fused_per_iter_s": round(fused_s / iters, 4),
+        "dispatches_per_iter_sequential": round(seq_disp / iters, 4),
+        "dispatches_per_iter_fused": round(fused_disp / iters, 4),
+        "steady_compiles": steady_compiles,
+        "fused_rounds": fused_k,
+        "num_class": num_class,
+        "iters": iters,
+        "rows": rows,
+        "backend": backend,
     }), flush=True)
 
 
@@ -3459,6 +3596,8 @@ if __name__ == "__main__":
         stage = os.environ.get("BENCH_STAGE")
         if stage == "serve":
             run_serving()
+        elif stage == "train_multiclass":
+            run_train_multiclass()
         elif stage == "hist":
             run_hist()
         elif stage == "fleet":

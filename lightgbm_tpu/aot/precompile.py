@@ -47,7 +47,7 @@ def precompile_training(params: Dict, train_set, bundle_dir: str,
     out["bundle_dir"] = str(bundle_dir)
     if not out.get("supported"):
         log_info("aot precompile: this config has no fused training "
-                 "program (parallel learner, multiclass, custom objective "
+                 "program (parallel learner, custom objective, valid sets "
                  "or telemetry=on) — nothing to bundle for training")
     else:
         log_info(f"aot precompile: {out['programs']} training program(s) "

@@ -257,6 +257,8 @@ class Application:
                          cascade_mode=cfg.cascade_mode,
                          cascade_prefix_trees=cfg.cascade_prefix_trees,
                          cascade_epsilon=cfg.cascade_epsilon,
+                         cascade_adaptive_prefix=bool(
+                             cfg.cascade_adaptive_prefix),
                          explain_max_batch=cfg.explain_max_batch,
                          explain_max_wait_ms=cfg.explain_max_wait_ms,
                          explain_default_deadline_ms=(
@@ -360,6 +362,8 @@ class Application:
                          cascade_mode=cfg.cascade_mode,
                          cascade_prefix_trees=cfg.cascade_prefix_trees,
                          cascade_epsilon=cfg.cascade_epsilon,
+                         cascade_adaptive_prefix=bool(
+                             cfg.cascade_adaptive_prefix),
                          explain_max_batch=cfg.explain_max_batch,
                          explain_max_wait_ms=cfg.explain_max_wait_ms,
                          explain_default_deadline_ms=(

@@ -11,6 +11,7 @@ keeps the reference format (gbdt_model_text.cpp:311 SaveModelToString).
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -37,8 +38,10 @@ __all__ = ["GBDT"]
 # gates AOT bundle loads — every fact the program is specialized on,
 # argument avals included.  With row-bucket padding the avals are stable
 # while the pool grows inside its bucket, so steady-state cycles compile
-# nothing.
-_FUSED_EXEC_CACHE: Dict[str, object] = {}
+# nothing.  True LRU: hits move-to-end, eviction pops the least recently
+# USED entry — two alternating signatures past the cap must not thrash
+# recompiles the way plain FIFO insertion order would.
+_FUSED_EXEC_CACHE: "OrderedDict[str, object]" = OrderedDict()
 _FUSED_EXEC_CACHE_CAP = 8
 
 
@@ -84,6 +87,7 @@ class GBDT:
         if self.telemetry is not None:
             from ..telemetry.training import hist_path_of
             self.telemetry.hist_path = hist_path_of(self.tree_learner)
+            self.telemetry.num_class = self.num_class
 
         n = train_data.num_data
         k = self.num_class
@@ -146,6 +150,7 @@ class GBDT:
         if self.telemetry is not None:
             from ..telemetry.training import hist_path_of
             self.telemetry.hist_path = hist_path_of(self.tree_learner)
+            self.telemetry.num_class = self.num_class
         self.train_metrics = create_metrics(config, self.objective)
         self._fused_step = None        # recompile against the new config
         self._fused_const = None
@@ -285,13 +290,20 @@ class GBDT:
     _fusable = True
 
     def _can_fuse(self) -> bool:
+        # multiclass fuses too: the block grows all num_class trees per
+        # round on device (class axis scanned inside the round body).
+        # The remaining exclusions are structural, not class-count:
+        # renew_tree_output refits leaves host-side over real rows,
+        # linear trees fit per-leaf models on host, valid sets need
+        # per-round score updates, and CEGB's feature-used state couples
+        # classes through host bookkeeping (the reference DeltaGain reads
+        # the live feature_used set between same-iteration class trees).
         from ..tree_learner import SerialTreeLearner
         return (self._fusable
                 # per-stage attribution needs the host boundaries the
                 # fused step removes — telemetry=on opts out of fusing
                 and self.telemetry is None
                 and type(self)._grow_and_apply is GBDT._grow_and_apply
-                and self.num_class == 1
                 and not self.objective.need_renew_tree_output
                 and not self.valid_sets
                 and not self.config.linear_tree
@@ -356,35 +368,87 @@ class GBDT:
         ``lax.scan`` over rounds carrying the raw score, with gradients,
         histogram build, split scan and partition all inside the scan body
         (grow_tree/grow_tree_compact traced through).  Only non-array state
-        (objective methods, the static GrowerConfig) is closed over."""
+        (objective methods, the static GrowerConfig) is closed over.
+
+        Multiclass (num_class > 1) carries the full [C, N] score and grows
+        all C trees per round with an inner ``lax.scan`` over the class
+        axis — not ``vmap``: batching the compact grower's ``lax.switch``
+        bucket ladder would execute every branch per class, while the
+        class scan runs the IDENTICAL single-class grower program per
+        class, which is what makes the fused result bit-identical to the
+        sequential per-class loop.  Gradients are computed ONCE per round
+        from the pre-round score (like the sequential path, which applies
+        per-class score deltas only after its gradient call), the bagging/
+        GOSS row mask is shared across classes, and the grower RNG key is
+        the per-iteration key for every class; only the column-sampling
+        feature mask is per (round, class)."""
         obj = self.objective
         cfg = self.tree_learner.grower_cfg
         compact = self.config.grow_strategy == "compact"
         booster = self
 
+        if self.num_class == 1:
+            def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
+                      igroups, gscale, hlayout, forced, pack_map, qbounds,
+                      score_row, lr, masks, fmasks, keys, adjust_keys):
+                grow = grow_tree_compact if compact else grow_tree
+
+                def body(score, per_round):
+                    mask, fmask, key, akey = per_round
+                    g, h = obj.get_gradients(score, label, weight)
+                    g2, h2, mask2 = booster._fused_gradient_adjust(
+                        g[None, :], h[None, :], mask, akey, variant)
+                    kw = {"forced": forced} if compact else {}
+                    state = grow(cfg, bins, g2[0], h2[0], mask2, nbf, hmf,
+                                 fmask, monotone, key, is_cat, bmap, igroups,
+                                 gscale, None, hist_layout=hlayout,
+                                 pack_map=pack_map, quant_bounds=qbounds,
+                                 **kw)
+                    delta = jnp.where(state.n_leaves > 1,
+                                      (state.leaf_value * lr)[state.row_leaf],
+                                      jnp.zeros_like(score))
+                    # drop the [N]-sized fields before the state is retained
+                    slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
+                    return score + delta, slim
+
+                return jax.lax.scan(body, score_row,
+                                    (masks, fmasks, keys, adjust_keys))
+
+            return block
+
         def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
                   igroups, gscale, hlayout, forced, pack_map, qbounds,
-                  score_row, lr, masks, fmasks, keys, adjust_keys):
+                  score, lr, masks, fmasks, keys, adjust_keys):
             grow = grow_tree_compact if compact else grow_tree
+            kw = {"forced": forced} if compact else {}
 
             def body(score, per_round):
-                mask, fmask, key, akey = per_round
-                g, h = obj.get_gradients(score, label, weight)
+                mask, fmask, key, akey = per_round      # fmask: [C, F]
+                g, h = obj.get_gradients(score, label, weight)   # [C, N]
+                # GOSS top-row selection sums |g*h| over the class axis
+                # (goss.py goss_adjust) — the same [C, N] call the
+                # sequential _adjust_gradients makes, shared row mask out
                 g2, h2, mask2 = booster._fused_gradient_adjust(
-                    g[None, :], h[None, :], mask, akey, variant)
-                kw = {"forced": forced} if compact else {}
-                state = grow(cfg, bins, g2[0], h2[0], mask2, nbf, hmf,
-                             fmask, monotone, key, is_cat, bmap, igroups,
-                             gscale, None, hist_layout=hlayout,
-                             pack_map=pack_map, quant_bounds=qbounds, **kw)
-                delta = jnp.where(state.n_leaves > 1,
-                                  (state.leaf_value * lr)[state.row_leaf],
-                                  jnp.zeros_like(score))
-                # drop the [N]-sized fields before the state is retained
-                slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
-                return score + delta, slim
+                    g, h, mask, akey, variant)
 
-            return jax.lax.scan(body, score_row,
+                def grow_one(carry, cls_in):
+                    g_c, h_c, fm_c = cls_in
+                    state = grow(cfg, bins, g_c, h_c, mask2, nbf, hmf,
+                                 fm_c, monotone, key, is_cat, bmap, igroups,
+                                 gscale, None, hist_layout=hlayout,
+                                 pack_map=pack_map, quant_bounds=qbounds,
+                                 **kw)
+                    delta = jnp.where(state.n_leaves > 1,
+                                      (state.leaf_value * lr)[state.row_leaf],
+                                      jnp.zeros_like(g_c))
+                    slim = state._replace(row_leaf=jnp.zeros((0,), jnp.int32))
+                    return carry, (delta, slim)
+
+                _, (deltas, slims) = jax.lax.scan(grow_one, None,
+                                                  (g2, h2, fmask))
+                return score + deltas, slims
+
+            return jax.lax.scan(body, score,
                                 (masks, fmasks, keys, adjust_keys))
 
         return block
@@ -414,6 +478,9 @@ class GBDT:
             "top_rate", "other_rate")}
         return {
             "kind": "fused_train_block", "k": int(k), "variant": int(variant),
+            # the class axis also shows in args_avals (score/fmask shapes),
+            # but an explicit key makes bundle mismatch logs readable
+            "num_class": int(self.num_class),
             "boosting": self.config.boosting,
             "objective": self.objective.to_string(),
             "objective_params": semantics,
@@ -457,13 +524,18 @@ class GBDT:
             ck = _fused_exec_cache_key(self._fused_signature(variant, k,
                                                              args))
             fn = _FUSED_EXEC_CACHE.get(ck)
-            if fn is None:
+            if fn is not None:
+                # touch-on-hit: eviction order is recency of USE, so a
+                # working set of alternating signatures at the cap stays
+                # resident instead of thrashing recompiles
+                _FUSED_EXEC_CACHE.move_to_end(ck)
+            else:
                 fn = jax.jit(builder).lower(*args).compile()
                 if len(_FUSED_EXEC_CACHE) >= _FUSED_EXEC_CACHE_CAP:
-                    # tiny FIFO bound: executables are small (the jaxpr
+                    # tiny LRU bound: executables are small (the jaxpr
                     # guard keeps data out of the program), but unbounded
                     # growth across shape-churning test suites isn't free
-                    _FUSED_EXEC_CACHE.pop(next(iter(_FUSED_EXEC_CACHE)))
+                    _FUSED_EXEC_CACHE.popitem(last=False)
                 _FUSED_EXEC_CACHE[ck] = fn
         self._fused_step[key] = fn
         return fn
@@ -473,14 +545,22 @@ class GBDT:
         touching stateful sampling RNGs (precompile must be side-effect
         free; masks are data, not program, so all-ones stands in)."""
         f = self.train_data.num_features
+        C = self.num_class
         masks = jnp.ones((k, self._n_rows_device), jnp.float32)
-        fmasks = np.ones((k, f), bool)
+        if C == 1:
+            fmasks = np.ones((k, f), bool)
+            score = self.train_score[0]
+        else:
+            # multiclass block signature: [C, N] score carry and one
+            # column mask per (round, class)
+            fmasks = np.ones((k, C, f), bool)
+            score = self.train_score
         keys = jnp.stack([self.tree_learner.iter_key(i) for i in range(k)])
         akeys = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs),
             *[self._fused_adjust_payload_at(i) for i in range(k)])
         return self._fused_const_args() + (
-            self.train_score[0], jnp.float32(self.shrinkage_rate),
+            score, jnp.float32(self.shrinkage_rate),
             masks, fmasks, keys, akeys)
 
     def precompile_fused(self, rounds: Optional[int] = None) -> Dict:
@@ -535,26 +615,42 @@ class GBDT:
             # (a few iterations later than the reference's immediate stop,
             # gbdt.cpp:418-434; the extra stump trees add zero score)
             return 0, True
-        init = self._boost_from_average(0)
+        C = self.num_class
+        inits = tuple(self._boost_from_average(c) for c in range(C))
         variant = self._fused_variant()
         learner = self.tree_learner
         base = self.iter_
         masks = jnp.stack([self._bagging_mask(base + i) for i in range(k)])
-        fmasks = np.stack([learner.feature_mask() for _ in range(k)])
+        if C == 1:
+            fmasks = np.stack([learner.feature_mask() for _ in range(k)])
+            score = self.train_score[0]
+        else:
+            # round-major, class-minor draws: the sequential per-class loop
+            # calls feature_mask() once per class per round, so the column-
+            # sampling RNG must advance in exactly that order for the fused
+            # model to be bit-identical
+            fmasks = np.stack([np.stack([learner.feature_mask()
+                                         for _ in range(C)])
+                               for _ in range(k)])
+            score = self.train_score
         keys = jnp.stack([learner.iter_key(base + i) for i in range(k)])
         akeys = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs),
             *[self._fused_adjust_payload_at(base + i) for i in range(k)])
         args = self._fused_const_args() + (
-            self.train_score[0], jnp.float32(self.shrinkage_rate),
+            score, jnp.float32(self.shrinkage_rate),
             masks, fmasks, keys, akeys)
         step = self._fused_block_callable(variant, k, args)
         with timed("fused_train_block"):
             new_score, slims = step(*args)
-        self.train_score = new_score[None, :]
+        # ONE device program launch grew k*C trees (the sequential path
+        # dispatches one grower per class per round)
+        self._count_dispatches(1)
+        self.train_score = new_score[None, :] if C == 1 else new_score
+        zeros = (0.0,) * C
         for i in range(k):
             slim = jax.tree_util.tree_map(lambda x, i=i: x[i], slims)
-            self._pending.append((slim, float(init) if i == 0 else 0.0,
+            self._pending.append((slim, inits if i == 0 else zeros,
                                   self.shrinkage_rate))
         self.iter_ += k
         # stall check on iterations that finished >= lag rounds ago, so
@@ -565,18 +661,35 @@ class GBDT:
         # the block's end, so fused-K may append up to K-1 more zero-score
         # stump trees than fused-1 before stopping (the same class of
         # accepted deviation as the lag itself vs the reference's immediate
-        # stop, gbdt.cpp:418-434).
+        # stop, gbdt.cpp:418-434).  Multiclass stalls only when NO class
+        # split that round (max over the [C] n_leaves), matching the
+        # sequential any_split stop.
         lag = 8
         start = getattr(self, "_stall_checked", 0)
         end = len(self._pending) - lag + 1
         if end > start:
-            stalled = any(int(self._pending[j][0].n_leaves) <= 1
-                          for j in range(start, end))
+            stalled = any(
+                int(np.max(np.asarray(self._pending[j][0].n_leaves))) <= 1
+                for j in range(start, end))
             self._stall_checked = end
             if stalled:
                 self._flush_pending()
                 return k, True
         return k, getattr(self, "_saw_stump", False)
+
+    def _count_dispatches(self, n: int = 1) -> None:
+        """Fold training device-program launches into the process counter
+        (telemetry/registry): one per grower call on the sequential path,
+        one per fused block — the multiclass fused win's hard evidence."""
+        c = getattr(self, "_dispatch_counter", None)
+        if c is None:
+            from ..telemetry.registry import get_counter
+            c = get_counter(None, "lgbm_train_device_dispatches_total",
+                            "training device-program launches (per-class "
+                            "grower calls on the sequential path, one per "
+                            "fused multi-round block)")
+            self._dispatch_counter = c
+        c.inc(int(n))
 
     def _flush_pending(self) -> None:
         if not self._pending:
@@ -585,22 +698,35 @@ class GBDT:
         self._stall_checked = 0
         with timed("flush_states_to_host"):
             states = jax.device_get([p[0] for p in pending])
+        C = self.num_class
         if (self.tree_learner is not None
                 and getattr(self.tree_learner.grower_cfg, "quantized",
                             False)):
-            self._drain_quant_clips(sum(int(s.quant_clips) for s in states))
-        for state, (_, init, lr) in zip(states, pending):
-            tree = state_to_tree(state, self.train_data.feature_mappers,
-                                 self.train_data.real_feature_index)
-            if tree.num_leaves > 1:
-                tree.shrinkage(lr)
-                if init != 0.0:
-                    tree.add_bias(init)
-            else:
+            # np.sum: multiclass states carry a [C] clip count per round
+            self._drain_quant_clips(
+                sum(int(np.sum(s.quant_clips)) for s in states))
+        for state, (_, inits, lr) in zip(states, pending):
+            all_stump = True
+            for cls in range(C):
+                s = (state if C == 1 else
+                     jax.tree_util.tree_map(lambda x, c=cls: x[c], state))
+                tree = state_to_tree(s, self.train_data.feature_mappers,
+                                     self.train_data.real_feature_index)
+                init = inits[cls]
+                if tree.num_leaves > 1:
+                    all_stump = False
+                    tree.shrinkage(lr)
+                    if init != 0.0:
+                        tree.add_bias(init)
+                else:
+                    # a stump for ONE class is normal multiclass output;
+                    # only an all-class stump round means training stalled
+                    # (the sequential path's any_split stop)
+                    if init != 0.0:
+                        tree.leaf_value[0] = init
+                self._models.append(tree)
+            if all_stump:
                 self._saw_stump = True
-                if init != 0.0:
-                    tree.leaf_value[0] = init
-            self._models.append(tree)
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -741,6 +867,7 @@ class GBDT:
                     grad[cls], hess[cls], mask, self.iter_,
                     gain_penalty=cegb_pen,
                     quant_bounds=self._quant_bounds_arr())
+                self._count_dispatches(1)   # one grower program per class
                 if tele:
                     jax.block_until_ready(state.n_leaves)
                     tele.add("grow_s", time.perf_counter() - t0)
@@ -820,6 +947,21 @@ class GBDT:
                 # linear leaves: per-row fitted outputs (already shrinkage-
                 # scaled and bias-adjusted by the caller)
                 self.train_score = self.train_score.at[cls].add(row_out)
+            elif (not self.bias_before_score_update
+                  and not self.objective.need_renew_tree_output):
+                # the same delta arithmetic as the fused block
+                # ((state.leaf_value * lr)[row_leaf], ONE f32 rounding of
+                # the shrink product) so the train-score stream is
+                # bit-identical whether rounds run fused or per class on
+                # host.  The host tree's leaf values are shrunk in f64 and
+                # cast to f32 at the add — off by an ulp from the f32
+                # product often enough to drift later trees.  Excluded
+                # above: RF folds the init bias into the tree before this
+                # call and renew-output objectives refit the leaves — for
+                # both, the TREE is the source of truth, and neither fuses.
+                delta = state.leaf_value * jnp.float32(self.shrinkage_rate)
+                self.train_score = self.train_score.at[cls].add(
+                    delta[state.row_leaf])
             else:
                 self.train_score = self.train_score.at[cls].add(
                     leaf_vals[state.row_leaf])

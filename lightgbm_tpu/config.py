@@ -300,6 +300,16 @@ _PARAMS: List[ParamSpec] = [
        "0 = band=infinity: every row completes (bit-identical answers, "
        "cascade plumbing exercised); exits count "
        "lgbm_serving_early_exit_total"),
+    _p("cascade_adaptive_prefix", bool, False, (),
+       desc="let the AUTO cascade prefix (cascade_prefix_trees=0) adapt "
+            "to traffic: an EMA of the per-flush exit fraction "
+            "(lgbm_serving_exit_fraction) steps the prefix one rung "
+            "along an exact-binary ladder (1/16..1/2 of the forest) — "
+            "shorter when nearly every row already exits, longer when "
+            "almost none do.  Steps happen only between publishes (the "
+            "rung is re-warmed there), need a full observation window, "
+            "and hold inside a dead band (hysteresis).  An explicit "
+            "cascade_prefix_trees disables adaptation"),
     # ---- Explanation serving (POST :explain; lightgbm_tpu/explain/) ----
     _p("explain_max_batch", int, 256, (), ">0",
        "row cap per device dispatch on the explain lane (its own "
@@ -666,7 +676,11 @@ _PARAMS: List[ParamSpec] = [
        "(lax.scan over rounds, lightgbm_tpu/aot/) when nothing observes "
        "per-iteration state — no valid sets, per-iteration callbacks, "
        "telemetry, or custom objective; configs the fused body can't "
-       "express fall back to per-round steps automatically.  1 disables "
+       "express fall back to per-round steps automatically.  Multiclass "
+       "fuses too: the block grows all num_class trees per round from "
+       "the [num_class, N] gradients (an inner scan over the class "
+       "axis), bit-identical to the per-class loop at one device "
+       "dispatch per block instead of num_class per round.  1 disables "
        "multi-round fusing"),
     _p("aot_bundle_dir", str, "", (),
        desc="directory holding an AOT program bundle (manifest + "

@@ -32,13 +32,15 @@ it just no longer gates.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..log import LightGBMError
 from ..objectives import output_transform
 
-__all__ = ["CascadeConfig", "resolve_prefix_iterations",
-           "served_delta_bound"]
+__all__ = ["AdaptivePrefixController", "CascadeConfig",
+           "resolve_prefix_iterations", "served_delta_bound"]
 
 # exp() saturates float64 around 709; tails this large mean "the prefix
 # knows nothing" and must read as a ~1.0 probability bound, not an
@@ -46,14 +48,92 @@ __all__ = ["CascadeConfig", "resolve_prefix_iterations",
 _EXP_CAP = 500.0
 
 
+class AdaptivePrefixController:
+    """Steps the AUTO prefix fraction between publishes, driven by the
+    observed early-exit fraction (the signal behind the
+    ``lgbm_serving_exit_fraction`` gauge).
+
+    The exit fraction is the cascade's efficiency readout: near 1.0 the
+    prefix is over-provisioned (almost every row already fits the
+    epsilon band — a shorter prefix would serve the same answers
+    cheaper); near 0.0 it is too weak (nearly every row pays prefix AND
+    completion, strictly worse than one full pass).  The controller
+    keeps an EMA of per-flush fractions and, when asked at publish
+    time, moves ONE rung along an exact-binary fraction ladder.
+
+    Deliberately conservative, because the prefix RAW program is warmed
+    per rung at publish (registry.publish) and a mid-traffic rung change
+    would serve cold:
+
+    - steps only at ``maybe_step()`` (called between publishes), never
+      inside the serving path;
+    - needs a full observation window (``min_observations`` flushes)
+      before it may move, and the window resets after every step —
+      hysteresis, so one step cannot immediately cascade into another;
+    - holds inside the [step_longer_at, step_shorter_at] dead band;
+    - bounded by the ladder ends (1/16 .. 1/2 of the forest).
+    """
+
+    # exact binary fractions: K = round(n * f) is reproducible across
+    # platforms, and the middle rung equals the static auto default
+    # (n // 4) for every forest size that matters
+    LADDER = (1 / 16, 1 / 8, 1 / 4, 1 / 2)
+    _START = 2  # 1/4 — identical to static auto until evidence arrives
+
+    def __init__(self, alpha: float = 0.2, min_observations: int = 8,
+                 step_shorter_at: float = 0.92,
+                 step_longer_at: float = 0.55):
+        self.alpha = float(alpha)
+        self.min_observations = int(min_observations)
+        self.step_shorter_at = float(step_shorter_at)
+        self.step_longer_at = float(step_longer_at)
+        self._lock = threading.Lock()
+        self._idx = self._START
+        self._ema = None
+        self._obs = 0
+
+    @property
+    def fraction(self) -> float:
+        return self.LADDER[self._idx]
+
+    @property
+    def ema(self):
+        return self._ema
+
+    def observe(self, exit_fraction: float) -> None:
+        """One cascade flush's exit fraction (n_exited / n_total)."""
+        f = min(max(float(exit_fraction), 0.0), 1.0)
+        with self._lock:
+            self._ema = (f if self._ema is None
+                         else self._ema + self.alpha * (f - self._ema))
+            self._obs += 1
+
+    def maybe_step(self) -> bool:
+        """Move one rung if a full window of evidence says so.  Returns
+        True when the fraction changed (caller re-warms the new rung)."""
+        with self._lock:
+            if self._ema is None or self._obs < self.min_observations:
+                return False
+            if (self._ema >= self.step_shorter_at
+                    and self._idx > 0):
+                self._idx -= 1
+            elif (self._ema <= self.step_longer_at
+                    and self._idx < len(self.LADDER) - 1):
+                self._idx += 1
+            else:
+                return False
+            self._obs = 0
+            return True
+
+
 class CascadeConfig:
-    """The three cascade knobs, validated once and carried as a unit
+    """The cascade knobs, validated once and carried as a unit
     (ServingApp -> ModelRegistry warmup -> per-flush dispatch)."""
 
-    __slots__ = ("mode", "prefix_trees", "epsilon")
+    __slots__ = ("mode", "prefix_trees", "epsilon", "controller")
 
     def __init__(self, mode: str = "off", prefix_trees: int = 0,
-                 epsilon: float = 0.0):
+                 epsilon: float = 0.0, adaptive: bool = False):
         mode = str(mode or "off")
         if mode not in ("off", "band", "deadline"):
             raise LightGBMError(
@@ -61,28 +141,69 @@ class CascadeConfig:
         self.mode = mode
         self.prefix_trees = int(prefix_trees)
         self.epsilon = float(epsilon)
+        # adaptive prefix only governs AUTO mode: an operator-pinned
+        # cascade_prefix_trees is a promise we keep verbatim
+        self.controller = (AdaptivePrefixController()
+                           if adaptive and mode != "off"
+                           and self.prefix_trees <= 0 else None)
 
     @property
     def enabled(self) -> bool:
         return self.mode != "off"
 
+    @property
+    def adaptive(self) -> bool:
+        return self.controller is not None
+
+    def resolve(self, n_iterations: int) -> int:
+        """Effective prefix K for a served range, honoring the adaptive
+        controller's current rung in auto mode."""
+        frac = self.controller.fraction if self.controller else None
+        return resolve_prefix_iterations(n_iterations, self.prefix_trees,
+                                         fraction=frac)
+
+    def prefix_for(self, predictor) -> int:
+        """Resolved prefix K for a predictor's full served range — the
+        value to pass as predict_cascade(prefix_iterations=...) so the
+        dispatch rung matches what publish warmed."""
+        s, e = predictor._iter_range(0, -1)
+        return self.resolve(e - s)
+
+    def observe(self, n_exited: int, n_total: int) -> None:
+        """Feed one band flush's exit fraction to the controller."""
+        if self.controller is not None and n_total:
+            self.controller.observe(float(n_exited) / float(n_total))
+
+    def maybe_step(self) -> bool:
+        """Let the controller move a rung (publish-time only)."""
+        return (self.controller.maybe_step()
+                if self.controller is not None else False)
+
     def __repr__(self) -> str:
         return (f"CascadeConfig(mode={self.mode!r}, "
                 f"prefix_trees={self.prefix_trees}, "
-                f"epsilon={self.epsilon:g})")
+                f"epsilon={self.epsilon:g}, "
+                f"adaptive={self.adaptive})")
 
 
-def resolve_prefix_iterations(n_iterations: int,
-                              prefix_trees: int = 0) -> int:
+def resolve_prefix_iterations(n_iterations: int, prefix_trees: int = 0,
+                              fraction=None) -> int:
     """Effective prefix length K for a served range of ``n_iterations``:
     ``cascade_prefix_trees`` clamped into [1, n_iterations], with 0 =
     auto (a quarter of the forest, at least one iteration) — the same
     resolution warmup and the per-flush dispatch must share, or the
-    prefix program warms on one rung and serves on another."""
+    prefix program warms on one rung and serves on another.
+
+    ``fraction`` (adaptive auto mode) replaces the fixed quarter with
+    the controller's current ladder rung; an explicit ``prefix_trees``
+    still wins."""
     n = max(int(n_iterations), 1)
     k = int(prefix_trees)
     if k <= 0:
-        k = max(n // 4, 1)
+        if fraction is not None:
+            k = max(int(round(n * float(fraction))), 1)
+        else:
+            k = max(n // 4, 1)
     return min(k, n)
 
 

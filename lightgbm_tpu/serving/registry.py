@@ -175,10 +175,19 @@ class ModelRegistry:
                 # host) so prefix flushes and deadline-degrade serves
                 # compile nothing post-warmup.  Same K resolution as the
                 # dispatch — a different K here would warm a dead rung.
+                # Publish is ALSO the only place the adaptive controller
+                # may step: the rung is stable between publishes, so the
+                # program warmed here is the one every flush dispatches.
                 from .cascade import resolve_prefix_iterations
+                step = getattr(casc, "maybe_step", None)
+                if step is not None:
+                    step()
                 s, e = predictor._iter_range(0, -1)
                 if e > s:
-                    k = resolve_prefix_iterations(e - s, casc.prefix_trees)
+                    resolve = getattr(casc, "resolve", None)
+                    k = (resolve(e - s) if resolve is not None else
+                         resolve_prefix_iterations(e - s,
+                                                   casc.prefix_trees))
                     predictor.warmup(kinds=("raw",), num_iteration=k)
         with self._lock:
             model = self._models.get(name)

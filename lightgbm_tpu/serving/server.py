@@ -100,8 +100,12 @@ class _RegistryDispatch:
             if (casc is not None and casc.enabled and casc.epsilon > 0
                     and not getattr(pred, "_average_output", False)):
                 out, info = pred.predict_cascade(
-                    X, prefix_iterations=casc.prefix_trees,
+                    X, prefix_iterations=casc.prefix_for(pred),
                     epsilon=casc.epsilon)
+                # the band flush is the adaptive controller's ONLY
+                # signal: server-epsilon, full-range — the steady-state
+                # traffic the prefix rung should be sized for
+                casc.observe(info["n_exited"], X.shape[0])
                 if self._metrics is not None:
                     self._metrics.record_early_exit(
                         info["n_exited"], X.shape[0])
@@ -122,6 +126,7 @@ class ServingApp:
                  cascade_mode: str = "off",
                  cascade_prefix_trees: int = 0,
                  cascade_epsilon: float = 0.0,
+                 cascade_adaptive_prefix: bool = False,
                  explain_max_batch: int = 256,
                  explain_max_wait_ms: float = 4.0,
                  explain_default_deadline_ms: float = 0.0,
@@ -130,9 +135,12 @@ class ServingApp:
         # early-exit cascade (serving/cascade.py): band mode exits
         # confident rows after the forest prefix inside coalesced
         # flushes; any enabled mode also honors a router's degrade=true
-        # (prefix-only answer instead of a deadline 504)
+        # (prefix-only answer instead of a deadline 504).  With
+        # cascade_adaptive_prefix the AUTO prefix rung follows the
+        # observed exit fraction, stepping only at publish time
         self.cascade = CascadeConfig(cascade_mode, cascade_prefix_trees,
-                                     cascade_epsilon)
+                                     cascade_epsilon,
+                                     adaptive=cascade_adaptive_prefix)
         self.registry = registry or ModelRegistry(
             metrics=self.metrics, cascade=self.cascade,
             explain_warmup=explain_warmup)
@@ -565,9 +573,13 @@ class ServingApp:
                         out = pred.predict(rows)
                         degraded, info = False, None
                     else:
+                        # degrade serves the warmed rung too; forced
+                        # exits are NOT fed to the adaptive controller
+                        # (every row "exits" by fiat, not confidence)
                         out, info = pred.predict_cascade(
                             rows,
-                            prefix_iterations=self.cascade.prefix_trees,
+                            prefix_iterations=self.cascade.prefix_for(
+                                pred),
                             epsilon=self.cascade.epsilon,
                             force_prefix=True)
                         degraded = True
@@ -624,9 +636,15 @@ class ServingApp:
                     if (eff > 0.0
                             and not getattr(pred, "_average_output",
                                             False)):
+                        # full-range request: serve the warmed adaptive
+                        # rung; a sub-range request keeps the static
+                        # knob (prefix_for resolves the FULL range).
+                        # Per-request epsilons are not controller signal
+                        pfx = (self.cascade.prefix_for(pred)
+                               if not kwargs else
+                               self.cascade.prefix_trees)
                         out, info = pred.predict_cascade(
-                            rows,
-                            prefix_iterations=self.cascade.prefix_trees,
+                            rows, prefix_iterations=pfx,
                             epsilon=eff, **kwargs)
                     else:
                         out = pred.predict(rows, **kwargs)

@@ -394,6 +394,10 @@ class TrainingTelemetry:
         # ACTIVE histogram-path label (hist_path_of): set by the booster
         # once the learner exists; stamped on every record + the summary
         self.hist_path: Optional[str] = None
+        # trees grown per iteration (objective num_model_per_iteration):
+        # stamped on records so per-iteration times across multiclass vs
+        # binary runs are never compared per-tree by accident
+        self.num_class: int = 1
         self._cur: Optional[Dict] = None
         self._t0 = 0.0
         self._span_cm = None
@@ -416,6 +420,7 @@ class TrainingTelemetry:
                      "comm_s": 0.0, "checkpoint_s": 0.0,
                      "hist_s": None, "split_s": None, "partition_s": None,
                      "hist_path": self.hist_path,
+                     "num_class": int(self.num_class),
                      "_cc": cc, "_cs": cs}
         self._t0 = time.perf_counter()
         self._span_cm = spans.span("train::iteration", iteration=iteration)
@@ -520,6 +525,7 @@ class TrainingTelemetry:
         for key in ("iter_s",) + PHASE_KEYS:
             out[key] = mean(key)
         out["hist_path"] = self.hist_path
+        out["num_class"] = int(self.num_class)
         out["compile_count"] = sum(int(r.get("compile_count") or 0)
                                    for r in recs)
         out["compile_s"] = round(sum(float(r.get("compile_s") or 0.0)

@@ -140,6 +140,54 @@ def test_cascade_config_validates_mode():
         CascadeConfig(mode="sometimes")
 
 
+def test_adaptive_prefix_controller_steps_at_publish_only():
+    """The adaptive auto-prefix: a window of near-total exits steps the
+    rung SHORTER (the prefix is over-provisioned), near-zero exits step
+    it LONGER, the dead band holds, and each step resets the window
+    (hysteresis) so rungs can't cascade within one publish."""
+    cc = CascadeConfig(mode="band", epsilon=0.05, adaptive=True)
+    assert cc.adaptive
+    # starts on the static auto rung: identical behavior without evidence
+    assert cc.resolve(64) == 16 == resolve_prefix_iterations(64, 0)
+    for _ in range(8):
+        cc.observe(99, 100)
+    assert cc.resolve(64) == 16      # observing never moves the rung
+    assert cc.maybe_step() is True   # ...only the publish-time step does
+    assert cc.resolve(64) == 8
+    assert cc.maybe_step() is False  # window reset: hysteresis
+    # low exit fraction walks the other way, bounded at the ladder top
+    lo = CascadeConfig(mode="band", epsilon=0.05, adaptive=True)
+    for _ in range(40):
+        lo.observe(1, 100)
+    assert lo.maybe_step() is True
+    assert lo.controller.fraction == 1 / 2
+    for _ in range(8):
+        lo.observe(1, 100)
+    assert lo.maybe_step() is False  # already at the longest rung
+    # mid-band fractions hold
+    mid = CascadeConfig(mode="band", epsilon=0.05, adaptive=True)
+    for _ in range(20):
+        mid.observe(70, 100)
+    assert mid.maybe_step() is False
+    assert mid.resolve(64) == 16
+
+
+def test_adaptive_prefix_disabled_by_pinned_knob():
+    """An operator-pinned cascade_prefix_trees is a promise: adaptive
+    mode must not fight it, and off-mode configs grow no controller."""
+    pinned = CascadeConfig(mode="band", prefix_trees=12, epsilon=0.05,
+                           adaptive=True)
+    assert not pinned.adaptive
+    pinned.observe(99, 100)          # no-ops, never raises
+    assert pinned.maybe_step() is False
+    assert pinned.resolve(64) == 12
+    off = CascadeConfig(mode="off", adaptive=True)
+    assert not off.adaptive
+    # fraction override in resolve_prefix_iterations: explicit still wins
+    assert resolve_prefix_iterations(100, 0, fraction=1 / 16) == 6
+    assert resolve_prefix_iterations(100, 5, fraction=1 / 16) == 5
+
+
 # ---------------------------------------------------------------------------
 # predict_cascade on the compiled predictor
 # ---------------------------------------------------------------------------

@@ -1,0 +1,217 @@
+"""Class-parallel fused multiclass training.
+
+The fused multi-round block (boosting/gbdt.py `_build_fused_block`) now
+carries a class axis: one device program grows all ``num_class`` trees
+per round from the [C, N] gradients, scanning the SAME single-class
+grower over the class axis so results are bit-identical to the
+sequential per-class host loop.  These tests pin that contract:
+
+- fused vs true-sequential model strings are EQUAL (multiclass and
+  multiclassova, across plain/bagging/GOSS/feature_fraction) — the
+  sequential baseline is forced by attaching a valid set, which is a
+  documented fuse exclusion;
+- block boundaries don't matter (K=8 one block == ragged 3+3+2);
+- kill-and-resume mid-block replays to the uninterrupted model;
+- dispatch count drops from num_class programs per round to one per
+  K-round block (lgbm_train_device_dispatches_total);
+- no [K, ...] array rides the program as a closure constant (jaxpr
+  guard, extending the PR-9 class to the multiclass block);
+- the process-wide executable cache is a true LRU (touch-on-hit).
+
+Binary (C == 1) fused-vs-sequential is deliberately NOT asserted here:
+the single-output objectives' eager-vs-traced gradient arithmetic can
+differ by 1 float32 ulp (pre-existing, unrelated to the class axis);
+the repo's C == 1 contracts live in test_aot.py / test_train_gray.py.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.checkpoint import InjectedWorkerFault
+
+
+def _trees(model_str):
+    return model_str.split("\n\n", 1)[1]
+
+
+def _data(n=500, f=12, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    y = X[:, 0] * 3 + X[:, 1] * 2 + rng.rand(n) * 0.5
+    y = np.digitize(y, np.quantile(y, [0.33, 0.66])).astype(np.float64)
+    return X, y
+
+
+BASE = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+        "learning_rate": 0.2, "min_data_in_leaf": 5, "verbosity": -1,
+        "deterministic": True, "feature_fraction_seed": 3}
+
+MODES = {
+    "plain": {},
+    "bagging": {"bagging_freq": 2, "bagging_fraction": 0.6},
+    "goss": {"boosting": "goss", "learning_rate": 0.5},
+    "ff": {"feature_fraction": 0.6},
+}
+
+
+def _seq(params, X, y, rounds=8, **kw):
+    """True sequential baseline: a valid set is a documented fuse
+    exclusion, so this runs the per-class host loop."""
+    bst = lgb.train(dict(params, fused_rounds=1), lgb.Dataset(X, y),
+                    num_boost_round=rounds,
+                    valid_sets=[lgb.Dataset(X[:100], y[:100])], **kw)
+    assert not bst._gbdt._can_fuse(), "baseline must be sequential"
+    return bst
+
+
+def _fused(params, X, y, rounds=8, fused_rounds=4, **kw):
+    bst = lgb.train(dict(params, fused_rounds=fused_rounds),
+                    lgb.Dataset(X, y), num_boost_round=rounds, **kw)
+    return bst
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: fused class-parallel == sequential per-class loop
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("objective", ["multiclass", "multiclassova"])
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_fused_multiclass_bit_identical(objective, mode):
+    X, y = _data()
+    params = dict(BASE, objective=objective, **MODES[mode])
+    seq = _seq(params, X, y)
+    fused = _fused(params, X, y)
+    assert fused._gbdt.num_class == 3
+    assert _trees(seq.model_to_string()) == _trees(fused.model_to_string())
+
+
+def test_fused_multiclass_block_boundaries_irrelevant():
+    """One K=8 block and ragged 3+3+2 blocks replay the same RNG streams
+    (per-(round, class) keys are derived from the GLOBAL iteration, not
+    the block offset) and must produce the same model."""
+    X, y = _data()
+    one = _fused(BASE, X, y, rounds=8, fused_rounds=8)
+    ragged = _fused(BASE, X, y, rounds=8, fused_rounds=3)
+    assert one.model_to_string() == ragged.model_to_string()
+
+
+def test_fused_multiclass_resume_mid_block(tmp_path, monkeypatch):
+    """Kill at iteration 5 — inside the second K=4 block — then resume
+    from the checkpoint: the replayed run must match the uninterrupted
+    model bit-for-bit (block restart re-derives masks/keys from the
+    global iteration)."""
+    X, y = _data()
+    params = dict(BASE, bagging_freq=2, bagging_fraction=0.7)
+    full = _fused(params, X, y, rounds=9)
+    d = str(tmp_path / "ckpts")
+    monkeypatch.setenv("LGBM_TPU_FAULT_ITER", "5")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        _fused(params, X, y, rounds=9, checkpoint_dir=d)
+    monkeypatch.delenv("LGBM_TPU_FAULT_ITER")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+    resumed = _fused(params, X, y, rounds=9, checkpoint_dir=d)
+    assert resumed.num_trees() == full.num_trees()
+    assert resumed.model_to_string() == full.model_to_string()
+
+
+# ---------------------------------------------------------------------------
+# the perf claim: one program per block instead of num_class per round
+# ---------------------------------------------------------------------------
+def _dispatch_counter():
+    from lightgbm_tpu.telemetry.registry import get_counter
+    return get_counter(None, "lgbm_train_device_dispatches_total", "")
+
+
+def test_fused_multiclass_dispatch_count():
+    X, y = _data()
+    c = _dispatch_counter()
+    before = c.value
+    _fused(BASE, X, y, rounds=8, fused_rounds=4)
+    fused_dispatches = c.value - before
+    assert fused_dispatches == 2, fused_dispatches  # two K=4 blocks
+    before = c.value
+    _seq(BASE, X, y, rounds=8)
+    seq_dispatches = c.value - before
+    # one grower program per (round, class)
+    assert seq_dispatches == 8 * 3, seq_dispatches
+
+
+def test_multiclass_telemetry_carries_num_class(tmp_path):
+    """Per-iteration records and the summary expose num_class so the
+    dispatch/compile counters can be read per class downstream."""
+    X, y = _data(n=300)
+    params = dict(BASE, telemetry="on",
+                  telemetry_dir=str(tmp_path / "tele"))
+    bst = lgb.train(params, lgb.Dataset(X, y), 2)
+    recs = bst.telemetry_stats()
+    assert recs and all(r["num_class"] == 3 for r in recs)
+    assert bst.telemetry_summary()["num_class"] == 3
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-consts static guard, extended to the multiclass fused block
+# ---------------------------------------------------------------------------
+def test_no_closure_array_constants_in_multiclass_block():
+    """The [C, N] gradients, [K, C, F] feature masks and the GOSS padded
+    payload must ride the multiclass block as jit ARGUMENTS — an
+    inlined HLO constant would bloat every AOT bundle entry and break
+    signature-stable reuse across continuation cycles."""
+    import jax
+    X, y = _data()
+    params = dict(BASE, boosting="goss", top_rate=0.3, other_rate=0.3,
+                  learning_rate=0.5)
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=1)
+    g = bst._gbdt
+    assert g.num_class == 3
+
+    def max_const_elems(closed):
+        sizes = [int(np.asarray(c).size) for c in closed.consts
+                 if hasattr(c, "shape")]
+        return max(sizes, default=0)
+
+    # variant 1 = GOSS sampling active — the widest payload
+    for variant in (0, 1):
+        block = g._build_fused_block(variant, 2)
+        args = g._fused_example_args(2)
+        closed = jax.make_jaxpr(block)(*args)
+        assert max_const_elems(closed) <= 64, (
+            f"variant {variant}: the multiclass fused block captured an "
+            "array constant instead of taking it as an argument")
+
+
+# ---------------------------------------------------------------------------
+# executable cache is a true LRU
+# ---------------------------------------------------------------------------
+def test_fused_exec_cache_is_lru(monkeypatch):
+    """Touch-on-hit keeps the hot program resident: with the cap at 2,
+    re-using K=1 before compiling K=3 must evict K=2 (least recently
+    USED), not K=1 (least recently INSERTED)."""
+    from lightgbm_tpu.boosting import gbdt as gbdt_mod
+    X, y = _data(n=200)
+    bst = _fused(BASE, X, y, rounds=1, fused_rounds=1)
+    g = bst._gbdt
+    assert g._can_fuse()
+    monkeypatch.setattr(gbdt_mod, "_FUSED_EXEC_CACHE_CAP", 2)
+    monkeypatch.setattr(gbdt_mod, "_FUSED_EXEC_CACHE",
+                        type(gbdt_mod._FUSED_EXEC_CACHE)())
+    cache = gbdt_mod._FUSED_EXEC_CACHE
+
+    def call(k):
+        # clear the per-instance memo so every call exercises the
+        # process-wide cache path
+        g._fused_step = {}
+        return g._fused_block_callable(0, k, g._fused_example_args(k))
+
+    fn1 = call(1)
+    call(2)
+    assert len(cache) == 2
+    assert call(1) is fn1              # hit: same executable, no compile
+    call(3)                            # at cap: evicts the LRU entry
+    assert len(cache) == 2
+    assert call(1) is fn1, "LRU evicted the just-touched entry"
+    # and K=2 is the one that left: re-requesting it compiles a fresh
+    # executable object (cache keys are signature hashes, so the only
+    # observable is identity)
+    fn2b = call(2)
+    assert fn2b in cache.values()
