@@ -234,6 +234,19 @@ Stages (BENCH_STAGE env var, same parent/budget machinery for all):
                  targeted kill-and-relaunch + quorum re-admission, and
                  every injected fault's fired counter nonzero.  Knobs:
                  BENCH_GRAY_{ROUNDS,SEG_ROWS,CYCLE_BOUND_S,UNHARDENED_S}.
+- rank           learning-to-rank proof (run_rank): (1) query-bucketed
+                 lambdarank bit-identity vs the unpadded layout and
+                 device-NDCG/host-NDCGMetric parity; (2) a continuous
+                 lambdarank service (qid tail → query-split trainer →
+                 NDCG publish gate) sized so the measured cycles sit on
+                 stable bucket rungs — bar: ZERO steady-state compiles;
+                 (3) a fleet `:rank` soak: two replica processes behind
+                 the router, concurrent rank+predict clients, per-query
+                 order verified on every response — bars: zero failed
+                 requests, rank p99 under its own deadline, the
+                 lgbm_fleet_rank_* family isolated from predict, zero
+                 post-warmup compiles.  Knobs: BENCH_RANK_{ROUNDS,
+                 THREADS,PREDICT_THREADS,SECONDS,MAX_REQ_ROWS,MIN_NDCG}.
 """
 
 import json
@@ -3354,6 +3367,350 @@ def run_continuous_gray():
     print("BENCH_RESULT " + json.dumps(result), flush=True)
 
 
+def synth_rank(n_queries, q_len, seed):
+    """Synthetic ranking task: fixed-length queries, graded relevance
+    from a nonlinear score + irreducible noise (NDCG@5 lands well off
+    1.0), qids contiguous from ``seed * 10**6`` so multi-segment streams
+    never collide."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    n = n_queries * q_len
+    X = rng.randn(n, N_FEATURES).astype(np.float64)
+    rel = (X[:, 0] - 0.6 * X[:, 1] + 0.4 * X[:, 2] * X[:, 3]
+           + 0.8 * rng.randn(n))
+    edges = np.quantile(rel, [0.55, 0.8, 0.95])
+    y = np.digitize(rel, edges).astype(np.float64)
+    group = np.full(n_queries, q_len, np.int64)
+    qids = np.repeat(np.arange(n_queries) + seed * 10**6, q_len)
+    return X, y, group, qids
+
+
+def run_rank():
+    """Child body for BENCH_STAGE=rank: the learning-to-rank proof
+    (lightgbm_tpu/rank/).
+
+    Part 1, in-process probes: a lambdarank model trained on the
+    query-bucket ladder (`rank_query_buckets`, the default) must be
+    BYTE-equal to the unpadded layout (model_to_string equality), and
+    the device NDCG eval (rank/ndcg.py) must match the host NDCGMetric
+    reference on the trained model's scores.
+
+    Part 2, rank-aware continuous cycles: a qid-mode tail feeds a
+    lambdarank trainer whose train/holdout split respects query
+    boundaries, gated on holdout NDCG@5.  The workload is sized so the
+    measured cycles sit on stable bucket rungs (train rows/queries,
+    holdout rows/queries, query length all mid-rung): after the warmup
+    cycles every cycle must publish on NDCG and compile ZERO programs.
+
+    Part 3, the fleet `:rank` soak: two replica processes behind the
+    SLO router, concurrent :rank and :predict clients (the rank lane is
+    its own SLO class on the RAW-score program, never cascaded).  Every
+    rank response's per-query order is verified against its scores.
+    Bars: zero failed requests on both verbs, rank p99 under the rank
+    deadline, the lgbm_fleet_rank_* family populated separately from
+    predict, and zero compiles after the warm drives."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", time.time() + 600))
+    t_start = time.time()
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    jnp.zeros((8, 8)).block_until_ready()
+    print(f"BENCH_READY {backend}", flush=True)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.cluster import find_open_ports
+    from lightgbm_tpu.continuous import (ContinuousService,
+                                         ContinuousTrainer, DataTail,
+                                         PublishGate)
+    from lightgbm_tpu.fleet import (FleetRouter, FleetSupervisor,
+                                    HttpReplica, SLOPolicy,
+                                    default_replica_argv)
+    from lightgbm_tpu.rank import device_ndcg
+    from lightgbm_tpu.serving.server import ServingApp
+
+    rounds = int(os.environ.get("BENCH_RANK_ROUNDS", 6))
+    rk_threads = int(os.environ.get("BENCH_RANK_THREADS", 3))
+    pr_threads = int(os.environ.get("BENCH_RANK_PREDICT_THREADS", 2))
+    phase_s = float(os.environ.get("BENCH_RANK_SECONDS", 4.0))
+    max_req_rows = int(os.environ.get("BENCH_RANK_MAX_REQ_ROWS", 8))
+    floor = float(os.environ.get("BENCH_RANK_MIN_NDCG", 0.3))
+    q_len = 10
+
+    params = {"objective": "lambdarank", "num_leaves": 15,
+              "learning_rate": 0.2, "verbosity": -1, "max_bin": MAX_BIN,
+              "min_data_in_leaf": 20, "seed": 7, "deterministic": True}
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_rank_")
+
+    # --- part 1: bucketed bit-identity + device NDCG parity ----------
+    Xb, yb, gb, _ = synth_rank(200, q_len, seed=3)
+
+    def train_probe(buckets):
+        ds = lgb.Dataset(Xb, label=yb, group=gb, free_raw_data=False)
+        p = dict(params, rank_query_buckets=buckets)
+        return lgb.train(p, ds, num_boost_round=12)
+
+    bst = train_probe(True)
+    bit_identical = (bst.model_to_string()
+                     == train_probe(False).model_to_string())
+    qb = np.concatenate([[0], np.cumsum(gb)])
+    score = np.asarray(bst.predict(Xb, raw_score=True), np.float64)
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import NDCGMetric
+    host_cfg = Config(dict(params, eval_at=[5], rank_device_ndcg=False))
+    host_ndcg = NDCGMetric(host_cfg).eval(score, yb, None, None,
+                                          query_info=qb)[0][1]
+    dev_ndcg = device_ndcg(score, yb, qb, eval_at=(5,),
+                           label_gain=host_cfg.label_gain)[0]
+    ndcg_parity_delta = abs(host_ndcg - dev_ndcg)
+    model_path = os.path.join(tmp, "model.txt")
+    bst.save_model(model_path)
+
+    # --- part 2: continuous lambdarank cycles gated on NDCG ----------
+    # rung math (holdout_every=5, q_len=10): warmup ingests 325 queries
+    # -> train 260 q / 2600 rows (rungs 512 / 4096), holdout 65 q / 650
+    # rows (rungs 128 / 1024).  Each later cycle adds 15 queries (12
+    # train / 3 holdout), so after 4 more cycles every count is still
+    # mid-rung: the measured cycles may compile NOTHING.
+    src = os.path.join(tmp, "src")
+    os.makedirs(src)
+
+    def write_qid_segment(name, X, y, qids):
+        lines = [",".join([f"{y[i]:.0f}", str(int(qids[i]))]
+                          + [f"{v:.6f}" for v in X[i]])
+                 for i in range(len(y))]
+        tpath = os.path.join(src, f"_{name}.part")
+        with open(tpath, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tpath, os.path.join(src, name))
+
+    app = ServingApp()
+    trainer = ContinuousTrainer(params, os.path.join(tmp, "work"),
+                                rounds_per_cycle=rounds,
+                                gate_metric="ndcg", ndcg_at=5)
+    gate = PublishGate(app.registry, "rank", min_auc=floor,
+                       max_regression=0.2, metric="ndcg", ndcg_at=5)
+    tail = DataTail(src, num_features=N_FEATURES, label_kind="rank",
+                    query_mode="qid",
+                    quarantine_path=os.path.join(tmp, "q.jsonl"))
+    service = ContinuousService(tail, trainer, gate, poll_s=0.0,
+                                retry_backoff_s=0.0)
+    decisions, ndcgs = [], []
+    n_warm_cycles = 2
+    for cyc in range(5):
+        n_q = 325 if cyc == 0 else 15
+        Xc, yc, _, qids = synth_rank(n_q, q_len, seed=10 + cyc)
+        write_qid_segment(f"seg{cyc:03d}.csv", Xc, yc, qids)
+        s = service.step()
+        decisions.append(s["decision"]["action"] if s["decision"]
+                         else None)
+        if s["decision"]:
+            ndcgs.append(round(float(s["decision"]["auc"]), 4))
+    cycle_compiles = [e.get("compiles") for e in service.events]
+    steady_compiles = cycle_compiles[n_warm_cycles:]
+    continuous = {
+        "decisions": decisions,
+        "published_ndcg_at_5": ndcgs,
+        "cycle_compiles": cycle_compiles,
+        "warm_cycles": n_warm_cycles,
+        "published_version": app.registry.current_version("rank"),
+        "quarantined_rows": int(tail.m_quarantined.value),
+    }
+    app.close()
+
+    # --- part 3: fleet `:rank` soak ----------------------------------
+    pool_q = 256
+    Xp, _, _, _ = synth_rank(pool_q, q_len, seed=77)
+    pool = np.ascontiguousarray(Xp, np.float64)
+
+    def drive(router, seconds, seed0, threads, verb, deadline_ms=None):
+        stop = time.time() + seconds
+        lat = [[] for _ in range(threads)]
+        stat = [{} for _ in range(threads)]
+        rows_served = [0] * threads
+        order_bad = [0] * threads
+
+        def client(i):
+            r = np.random.RandomState(seed0 + i)
+            while time.time() < stop:
+                n = int(r.randint(1, max_req_rows + 1))
+                lo = int(r.randint(0, pool.shape[0] - n))
+                body = {"rows": pool[lo:lo + n].tolist()}
+                if verb == "rank" and n > 1 and r.rand() < 0.5:
+                    cut = int(r.randint(1, n))
+                    body["group"] = [cut, n - cut]
+                if deadline_ms is not None:
+                    body["deadline_ms"] = deadline_ms
+                t0 = time.perf_counter()
+                status, resp = router.handle(
+                    "POST", f"/v1/models/default:{verb}", body)
+                lat[i].append(time.perf_counter() - t0)
+                stat[i][status] = stat[i].get(status, 0) + 1
+                if status == 200:
+                    rows_served[i] += n
+                    if verb == "rank":
+                        # per-query order must sort ITS scores descending
+                        sc = np.asarray(resp["scores"])
+                        for o in resp["order"]:
+                            s = sc[o]
+                            if not (np.diff(s) <= 1e-12).all():
+                                order_bad[i] += 1
+
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(seconds + 120)
+        statuses: dict = {}
+        for s in stat:
+            for k, v in s.items():
+                statuses[k] = statuses.get(k, 0) + v
+        return (statuses, sorted(x for part in lat for x in part),
+                sum(rows_served), sum(order_bad))
+
+    def p99_ms(lat):
+        if not lat:
+            return 0.0
+        return lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+
+    def fleet_compiles(replicas):
+        total = 0
+        for rep in replicas:
+            _, metrics = rep.request("GET", "/v1/metrics")
+            total += sum(m.get("compile_count", 0)
+                         for m in metrics.values() if isinstance(m, dict))
+        return total
+
+    replica_params = {"input_model": model_path, "verbosity": "-1",
+                      "serving_max_wait_ms": "2",
+                      "serving_max_batch": "256",
+                      "serving_max_queue_rows": "2048",
+                      "rank_max_wait_ms": "2",
+                      "rank_max_batch": "256"}
+    soak = {}
+    ports = find_open_ports(2)
+    sup = FleetSupervisor(
+        lambda idx, port: default_replica_argv(replica_params, port),
+        ports, log_dir=os.path.join(tmp, "logs"),
+        max_restarts=2, restart_backoff_s=0.5)
+    try:
+        sup.spawn_all()
+        sup.wait_ready(timeout_s=min(
+            180.0, max(deadline - time.time() - 120.0, 30.0)))
+        sup.start_watching(interval_s=0.2)
+        replicas = [HttpReplica(u) for u in sup.urls]
+        with FleetRouter(replicas, policy=SLOPolicy(recover_polls=1),
+                         poll_interval_ms=50) as r:
+            # warm both verbs concurrently; each verb's deadline is
+            # sized from ITS p99 under mixed traffic (the rank lane
+            # shares device occupancy with predict batches)
+            warm: dict = {}
+
+            def warm_drive(verb, seed0, threads):
+                warm[verb] = drive(r, 2.0, seed0, threads, verb)
+
+            w_rk = threading.Thread(target=warm_drive,
+                                    args=("rank", 200, rk_threads))
+            w_pr = threading.Thread(target=warm_drive,
+                                    args=("predict", 100, pr_threads))
+            w_rk.start()
+            w_pr.start()
+            w_rk.join(240)
+            w_pr.join(240)
+            dl_rank = max(4.0 * p99_ms(warm["rank"][1]), 200.0)
+            dl_predict = max(4.0 * p99_ms(warm["predict"][1]), 120.0)
+            compiles_warm = fleet_compiles(replicas)
+
+            out: dict = {}
+
+            def measured(verb, seed0, threads, dl):
+                out[verb] = drive(r, phase_s, seed0, threads, verb,
+                                  deadline_ms=dl)
+
+            t_rk = threading.Thread(
+                target=measured, args=("rank", 300, rk_threads, dl_rank))
+            t_pr = threading.Thread(
+                target=measured, args=("predict", 400, pr_threads,
+                                       dl_predict))
+            t0 = time.time()
+            t_rk.start()
+            t_pr.start()
+            t_rk.join(phase_s + 240)
+            t_pr.join(phase_s + 240)
+            elapsed = max(time.time() - t0, 1e-9)
+
+            stat_r, lat_r, rows_r, order_bad = out["rank"]
+            stat_p, lat_p, rows_p, _ = out["predict"]
+            snap = r.registry.snapshot()
+            fam_r = snap.get("lgbm_fleet_rank_requests_total", {})
+            fam_p = snap.get("lgbm_fleet_requests_total", {})
+            soak = {
+                "rank_statuses": {str(k): v for k, v in stat_r.items()},
+                "predict_statuses": {str(k): v for k, v in stat_p.items()},
+                "failed_requests": sum(
+                    v for st in (stat_r, stat_p)
+                    for k, v in st.items() if k != 200),
+                "misordered_responses": order_bad,
+                "rank_rows_per_s": round(rows_r / elapsed, 1),
+                "predict_rows_per_s": round(rows_p / elapsed, 1),
+                "rank_p99_ms": round(p99_ms(lat_r), 1),
+                "predict_p99_ms": round(p99_ms(lat_p), 1),
+                "rank_deadline_ms": round(dl_rank, 1),
+                "predict_deadline_ms": round(dl_predict, 1),
+                "router_rank_requests": float(
+                    fam_r.get("model=default", 0.0)),
+                "router_predict_requests": float(
+                    fam_p.get("model=default", 0.0)),
+                "compiles_after_warmup":
+                    fleet_compiles(replicas) - compiles_warm,
+            }
+    finally:
+        sup.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    bars = {
+        "bucketed_bit_identical": bool(bit_identical),
+        "device_host_ndcg_parity": bool(ndcg_parity_delta <= 1e-6),
+        "all_cycles_published_on_ndcg": bool(
+            decisions and all(d == "publish" for d in decisions)
+            and all(floor <= v <= 1.0 for v in ndcgs)),
+        "zero_steady_state_compiles": bool(
+            steady_compiles and all(c == 0 for c in steady_compiles)),
+        "zero_failed_requests": bool(soak.get("failed_requests", 1) == 0),
+        "per_query_order_correct": bool(
+            soak.get("misordered_responses", 1) == 0),
+        "rank_p99_under_deadline": bool(
+            soak.get("rank_p99_ms", 1e9)
+            < soak.get("rank_deadline_ms", 0.0)),
+        "rank_family_isolated": bool(
+            soak.get("router_rank_requests", 0.0) > 0
+            and soak.get("router_predict_requests", 0.0) > 0),
+        "zero_post_warmup_compiles": bool(
+            soak.get("compiles_after_warmup", 1) == 0),
+    }
+    result = {
+        "metric": f"rank_2replicas_{rounds}rounds_{rk_threads}threads",
+        "value": soak.get("rank_rows_per_s", 0.0),
+        "unit": "rank_rows_per_s",
+        "vs_baseline": 1.0 if all(bars.values()) else 0.0,
+        "bars": bars,
+        "ndcg_parity_delta": ndcg_parity_delta,
+        "host_ndcg_at_5": round(float(host_ndcg), 4),
+        "continuous": continuous,
+        "soak": soak,
+        "setup_s": round(time.time() - t_start, 1),
+        "backend": backend,
+    }
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+
+
 def run_hist():
     """Child body for BENCH_STAGE=hist: prove the bin-width-class histogram
     engine without the chip.
@@ -3616,6 +3973,8 @@ if __name__ == "__main__":
             run_continuous_sharded()
         elif stage == "continuous_gray":
             run_continuous_gray()
+        elif stage == "rank":
+            run_rank()
         else:
             run_training()
     else:

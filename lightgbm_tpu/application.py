@@ -263,7 +263,12 @@ class Application:
                          explain_max_wait_ms=cfg.explain_max_wait_ms,
                          explain_default_deadline_ms=(
                              cfg.explain_default_deadline_ms),
-                         explain_warmup=bool(cfg.explain_warmup))
+                         explain_warmup=bool(cfg.explain_warmup),
+                         rank_max_batch=cfg.rank_max_batch,
+                         rank_max_wait_ms=cfg.rank_max_wait_ms,
+                         rank_default_deadline_ms=(
+                             cfg.rank_default_deadline_ms),
+                         rank_top_k=cfg.rank_top_k)
         models = [m for m in str(cfg.input_model).split(",") if m]
         names = [n for n in str(cfg.serving_model_name).split(",") if n]
         if len(names) > len(models):
@@ -368,11 +373,24 @@ class Application:
                          explain_max_wait_ms=cfg.explain_max_wait_ms,
                          explain_default_deadline_ms=(
                              cfg.explain_default_deadline_ms),
-                         explain_warmup=bool(cfg.explain_warmup))
+                         explain_warmup=bool(cfg.explain_warmup),
+                         rank_max_batch=cfg.rank_max_batch,
+                         rank_max_wait_ms=cfg.rank_max_wait_ms,
+                         rank_default_deadline_ms=(
+                             cfg.rank_default_deadline_ms),
+                         rank_top_k=cfg.rank_top_k)
         name = str(cfg.serving_model_name).split(",")[0] or "default"
         bundle = cfg.aot_bundle_dir or None
         shards = int(cfg.continuous_shards or 0)
         sharded = shards > 1
+        gate_metric = str(cfg.continuous_gate_metric)
+        query_mode = str(cfg.continuous_query_mode)
+        if sharded and (gate_metric == "ndcg" or query_mode != "none"):
+            raise ValueError(
+                "continuous_gate_metric=ndcg / continuous_query_mode "
+                "require a single-shard service (continuous_shards<=1): "
+                "the sharded holdout allgather is flat and cannot keep "
+                "queries whole across ranks")
         from .io import file_io
         file_io.makedirs(workdir)
         trainer_kwargs = dict(
@@ -382,7 +400,9 @@ class Application:
             keep_checkpoints=cfg.keep_checkpoints,
             rebin_policy=cfg.continuous_rebin_policy,
             rebin_threshold=cfg.continuous_rebin_threshold,
-            rebin_every_k=cfg.continuous_rebin_every_k)
+            rebin_every_k=cfg.continuous_rebin_every_k,
+            gate_metric=gate_metric,
+            ndcg_at=cfg.continuous_ndcg_at)
         if sharded:
             import jax as _jax
             if _jax.default_backend() == "cpu":
@@ -432,6 +452,8 @@ class Application:
                 quarantine_path=f"{workdir}/quarantine.jsonl",
                 allow_nan_features=bool(
                     cfg.continuous_allow_nan_features),
+                label_kind=("rank" if query_mode != "none" else "binary"),
+                query_mode=query_mode,
                 quarantine_max_bytes=cfg.continuous_quarantine_max_bytes,
                 retry_max=cfg.continuous_segment_retry_max,
                 retry_backoff_s=cfg.continuous_segment_retry_backoff_s)
@@ -440,12 +462,17 @@ class Application:
                 incremental=bool(cfg.continuous_incremental),
                 **trainer_kwargs)
         gate = PublishGate(app.registry, name,
-                           min_auc=cfg.continuous_min_auc,
+                           min_auc=(cfg.continuous_min_ndcg
+                                    if gate_metric == "ndcg"
+                                    else cfg.continuous_min_auc),
                            max_regression=cfg.continuous_max_regression,
                            aot_bundle_dir=bundle,
                            attrib_threshold=cfg.continuous_attrib_threshold,
                            attrib_sample=cfg.continuous_attrib_sample,
-                           attrib_gate=bool(cfg.continuous_attrib_gate))
+                           attrib_gate=bool(cfg.continuous_attrib_gate),
+                           metric=gate_metric,
+                           ndcg_at=cfg.continuous_ndcg_at,
+                           label_gain=self.raw_params.get("label_gain"))
         if cfg.input_model:
             # seed: serving is live (and gated-good) before cycle 0 ends
             from .io.file_io import read_text

@@ -206,7 +206,10 @@ class GBDT:
     def _boost_from_average(self, cls: int) -> float:
         cfg, obj = self.config, self.objective
         if (not cfg.boost_from_average or self._has_init_score
-                or self._boosted_from_average[cls]):
+                or obj.is_ranking or self._boosted_from_average[cls]):
+            # ranking objectives boost from 0 by definition; skipping
+            # them BEFORE the real-rows slice below also keeps a growing
+            # continuous store from recompiling that slice every cycle
             return 0.0
         self._boosted_from_average[cls] = True
         label = self.train_data.label
@@ -360,7 +363,11 @@ class GBDT:
                 ds.num_bins_per_feature, ds.has_missing_per_feature,
                 learner.monotone, learner.is_cat_f, learner.bmap,
                 learner.igroups, learner.gain_scale, learner.hist_layout,
-                forced, learner.pack_map, self._quant_bounds_arr())
+                forced, learner.pack_map, self._quant_bounds_arr(),
+                # objective-owned constants (the ranking query layout)
+                # ride as a nested pytree arg — closure-capturing them
+                # would bake this run's layout into the program
+                self.objective.fused_const_args())
         return self._fused_const
 
     def _build_fused_block(self, variant: int, k: int):
@@ -390,12 +397,14 @@ class GBDT:
         if self.num_class == 1:
             def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
                       igroups, gscale, hlayout, forced, pack_map, qbounds,
-                      score_row, lr, masks, fmasks, keys, adjust_keys):
+                      obj_const, score_row, lr, masks, fmasks, keys,
+                      adjust_keys, obj_rounds):
                 grow = grow_tree_compact if compact else grow_tree
 
                 def body(score, per_round):
-                    mask, fmask, key, akey = per_round
-                    g, h = obj.get_gradients(score, label, weight)
+                    mask, fmask, key, akey, okey = per_round
+                    g, h = obj.fused_gradients(score, label, weight,
+                                               obj_const, okey)
                     g2, h2, mask2 = booster._fused_gradient_adjust(
                         g[None, :], h[None, :], mask, akey, variant)
                     kw = {"forced": forced} if compact else {}
@@ -412,19 +421,22 @@ class GBDT:
                     return score + delta, slim
 
                 return jax.lax.scan(body, score_row,
-                                    (masks, fmasks, keys, adjust_keys))
+                                    (masks, fmasks, keys, adjust_keys,
+                                     obj_rounds))
 
             return block
 
         def block(bins, label, weight, nbf, hmf, monotone, is_cat, bmap,
                   igroups, gscale, hlayout, forced, pack_map, qbounds,
-                  score, lr, masks, fmasks, keys, adjust_keys):
+                  obj_const, score, lr, masks, fmasks, keys, adjust_keys,
+                  obj_rounds):
             grow = grow_tree_compact if compact else grow_tree
             kw = {"forced": forced} if compact else {}
 
             def body(score, per_round):
-                mask, fmask, key, akey = per_round      # fmask: [C, F]
-                g, h = obj.get_gradients(score, label, weight)   # [C, N]
+                mask, fmask, key, akey, okey = per_round    # fmask: [C, F]
+                g, h = obj.fused_gradients(score, label, weight,
+                                           obj_const, okey)      # [C, N]
                 # GOSS top-row selection sums |g*h| over the class axis
                 # (goss.py goss_adjust) — the same [C, N] call the
                 # sequential _adjust_gradients makes, shared row mask out
@@ -449,7 +461,8 @@ class GBDT:
                 return score + deltas, slims
 
             return jax.lax.scan(body, score,
-                                (masks, fmasks, keys, adjust_keys))
+                                (masks, fmasks, keys, adjust_keys,
+                                 obj_rounds))
 
         return block
 
@@ -561,7 +574,16 @@ class GBDT:
             *[self._fused_adjust_payload_at(i) for i in range(k)])
         return self._fused_const_args() + (
             score, jnp.float32(self.shrinkage_rate),
-            masks, fmasks, keys, akeys)
+            masks, fmasks, keys, akeys, self._fused_objective_rounds(k))
+
+    def _fused_objective_rounds(self, k: int):
+        """Stacked per-round objective pytrees for the fused scan's xs
+        (the rank_xendcg per-round RNG key; None for most objectives).
+        Pure — `fused_round_args` peeks relative to the objective's call
+        counter; `fused_advance` consumes only after the block runs."""
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[self.objective.fused_round_args(i) for i in range(k)])
 
     def precompile_fused(self, rounds: Optional[int] = None) -> Dict:
         """AOT-compile the fused block programs for this booster's exact
@@ -639,10 +661,12 @@ class GBDT:
             *[self._fused_adjust_payload_at(base + i) for i in range(k)])
         args = self._fused_const_args() + (
             score, jnp.float32(self.shrinkage_rate),
-            masks, fmasks, keys, akeys)
+            masks, fmasks, keys, akeys, self._fused_objective_rounds(k))
         step = self._fused_block_callable(variant, k, args)
         with timed("fused_train_block"):
             new_score, slims = step(*args)
+        # the block consumed k gradient rounds of objective RNG state
+        self.objective.fused_advance(k)
         # ONE device program launch grew k*C trees (the sequential path
         # dispatches one grower per class per round)
         self._count_dispatches(1)

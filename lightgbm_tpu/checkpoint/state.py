@@ -338,6 +338,10 @@ def restore_train_state(booster, state: TrainState) -> None:
     gbdt.models = list(state.trees)
     gbdt.iter_ = int(state.iteration)
     gbdt.train_score = jnp.asarray(score)
+    # stateful objective RNG streams (rank_xendcg's per-round gamma key)
+    # advance past the restored rounds, so the resumed run draws the
+    # same sequence an uninterrupted one would
+    gbdt.objective.fused_advance(int(state.iteration))
     gbdt.load_training_state_extra(dict(state.extra))
     booster.best_iteration = int(state.best_iteration)
     booster.best_score = dict(state.best_score)

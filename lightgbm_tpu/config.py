@@ -328,6 +328,23 @@ _PARAMS: List[ParamSpec] = [
             "a new version's first explain request pays no compile; off "
             "by default — replicas that never serve explanations "
             "shouldn't spend publish latency on it"),
+    # ---- Rank serving (POST :rank; lightgbm_tpu/rank/) ----------------
+    _p("rank_max_batch", int, 512, (), ">0",
+       "row cap per device dispatch on the rank lane (its own "
+       "MicroBatcher per model, separate from predict/explain): a rank "
+       "request's query group rides one flush whole, so the cap also "
+       "bounds the largest scorable query group"),
+    _p("rank_max_wait_ms", float, 2.0, (), ">=0",
+       "rank-lane batching window: how long a queued query group may "
+       "wait for co-riders before its batch flushes"),
+    _p("rank_default_deadline_ms", float, 0.0, (), ">=0",
+       "default deadline applied to rank requests that carry no "
+       "deadline_ms — the rank lane's own SLO class; refusals count "
+       "lgbm_serving_rank_deadline_refused_total.  0 = no default"),
+    _p("rank_top_k", int, 0, (), ">=0",
+       "default result-list truncation for :rank responses that pass no "
+       "top_k: per query, return the sorted order (and per-row scores) "
+       "cut to the best k rows.  0 = return the full sorted order"),
     # ---- Fleet serving (task=serve + fleet_*; lightgbm_tpu/fleet/) ----
     _p("fleet_role", str, "", (), "in:|replica|router",
        "task=serve role: empty = single server (or full fleet launch "
@@ -479,6 +496,26 @@ _PARAMS: List[ParamSpec] = [
     _p("continuous_min_auc", float, 0.6, (), ">=0",
        "publish gate absolute floor: a candidate below this held-out "
        "AUC never reaches the serving registry"),
+    _p("continuous_gate_metric", str, "auc", (), "in:auc|ndcg",
+       "holdout metric the publish gate scores candidates with: 'auc' "
+       "(default, binary tails) or 'ndcg' (ranking tails — per-query "
+       "NDCG@continuous_ndcg_at over the query-respecting holdout, "
+       "floor continuous_min_ndcg, same max_regression semantics)"),
+    _p("continuous_min_ndcg", float, 0.5, (), ">=0",
+       "publish gate absolute floor when continuous_gate_metric=ndcg: a "
+       "candidate below this held-out NDCG@continuous_ndcg_at never "
+       "reaches the serving registry"),
+    _p("continuous_ndcg_at", int, 5, (), ">0",
+       "cutoff k for the publish gate's holdout NDCG and the rank-aware "
+       "post-publish watch (continuous_gate_metric=ndcg)"),
+    _p("continuous_query_mode", str, "none", (), "in:none|qid|sidecar",
+       "query structure of continuous tail segments: 'none' = plain "
+       "rows; 'qid' = each line carries a query id in its second field, "
+       "queries contiguous; 'sidecar' = a <segment>.group file lists "
+       "per-query sizes.  Whole queries only — a torn or malformed "
+       "query quarantines from the offending row to the segment's end "
+       "(never splits a query), and labels must be non-negative "
+       "integer relevance grades"),
     _p("continuous_max_regression", float, 0.05, (), ">=0",
        "publish gate relative bound: reject a candidate more than this "
        "below the best published AUC; post-publish, roll back a live "
@@ -601,8 +638,15 @@ _PARAMS: List[ParamSpec] = [
     _p("fair_c", float, 1.0, (), ">0"),
     _p("poisson_max_delta_step", float, 0.7, (), ">0"),
     _p("tweedie_variance_power", float, 1.5),
-    _p("lambdarank_truncation_level", int, 30, (), ">0"),
-    _p("lambdarank_norm", bool, True),
+    _p("lambdarank_truncation_level", int, 30, (), ">0",
+       "lambdarank pair truncation: only pairs whose better-scored "
+       "member ranks above this position contribute gradients (the "
+       "NDCG@k-style focus on the top of each query's list)"),
+    _p("lambdarank_norm", bool, True,
+       desc="normalize each lambdarank pair's |delta NDCG| by "
+            "(0.01 + |score difference|) when a query's scores are not "
+            "all equal — tempers gradients on pairs the model already "
+            "separates widely"),
     _p("label_gain", list, None),
     _p("objective_seed", int, 5),
     # ---- Metric ----
@@ -662,11 +706,29 @@ _PARAMS: List[ParamSpec] = [
             "growing across continuation cycles (TrainDataset.extend) "
             "reuses the same compiled programs and AOT bundle entries "
             "until it outgrows its bucket — steady-state cycles compile "
-            "nothing.  Serial learner only; ignored for query/group "
-            "data, linear_tree, and multi-process runs; custom fobj and "
+            "nothing.  Query/group data pads too (padded rows sit after "
+            "every query and the ranking gradient scatter drops its pad "
+            "slots — bit-identical; pair with rank_query_buckets for "
+            "fully stable ranking shapes).  Serial learner only; ignored "
+            "for linear_tree and multi-process runs; custom fobj and "
             "renew-output objectives (L1/huber/quantile/...) are "
             "rejected.  Costs up to 2x histogram compute at worst-case "
             "pad fraction — the tradeoff for zero recompiles"),
+    _p("rank_query_buckets", bool, True, (),
+       desc="pad the ranking objectives' per-query [Q, M] layout up to a "
+            "power-of-two query-count/query-length rung (rank/bucket.py): "
+            "pad queries/columns are fully masked and their gradient "
+            "scatter slots dropped, so bucketed lambdarank/rank_xendcg "
+            "models are bit-identical to the unpadded host layout while "
+            "a query pool growing across continuous cycles keeps hitting "
+            "the same fused-block programs and AOT bundle entries"),
+    _p("rank_device_ndcg", bool, True, (),
+       desc="evaluate the ndcg metric on device (rank/ndcg.py) when the "
+            "raw scores already live there: per-iteration ranking eval "
+            "then skips the host round-trip.  Same semantics as the host "
+            "NDCGMetric (label_gain gains, 1/log2(2+pos) discounts, ties "
+            "by row index, all-same-label queries count 1.0) in f32 "
+            "instead of f64"),
     _p("compilation_cache_dir", str, "", ("jax_compilation_cache_dir",),
        desc="enable the JAX persistent compilation cache at this directory; "
             "repeat runs with identical shapes/configs skip XLA recompiles "

@@ -52,7 +52,10 @@ class PublishGate:
                  rollback_fn=None,
                  attrib_threshold: float = 0.0,
                  attrib_sample: int = 256,
-                 attrib_gate: bool = False):
+                 attrib_gate: bool = False,
+                 metric: str = "auc",
+                 ndcg_at: int = 5,
+                 label_gain=None):
         """``registry`` is a serving ``ModelRegistry`` (or None when
         ``publish_fn``/``rollback_fn`` are given — the fleet path, where
         publish is an HTTP broadcast instead of an in-process call).
@@ -66,7 +69,22 @@ class PublishGate:
         the input distribution moves — typically cycles before enough
         labeled evidence accumulates for the AUC gate to react.  With
         ``attrib_gate`` the pending alarm also REJECTS candidate
-        publishes (reason ``attrib-drift``) until the drift subsides."""
+        publishes (reason ``attrib-drift``) until the drift subsides.
+
+        ``metric`` selects the gate's quality number: ``"auc"`` (the
+        default) or ``"ndcg"`` — mean NDCG@``ndcg_at`` over the fresh
+        window's intact queries, for rank pipelines whose cycle score is
+        already an NDCG.  The floor/regression machinery is shared;
+        ``min_auc``/``max_regression`` bound whichever metric is
+        selected."""
+        if metric not in ("auc", "ndcg"):
+            raise LightGBMError(f"gate metric {metric!r} must be "
+                                "'auc' or 'ndcg'")
+        self.metric = metric
+        self.ndcg_at = int(ndcg_at)
+        self.label_gain = label_gain
+        self._metric_label = ("AUC" if metric == "auc"
+                              else f"NDCG@{self.ndcg_at}")
         self.registry = registry
         self.model_name = model_name
         self.min_auc = float(min_auc)
@@ -116,23 +134,26 @@ class PublishGate:
         if auc is None or math.isnan(auc):
             self.m_rejected.inc()
             log_warning(f"continuous: cycle {cycle} candidate has no "
-                        "holdout AUC — refusing to publish blind")
+                        f"holdout {self._metric_label} — refusing to "
+                        "publish blind")
             return self._record({"action": "reject", "cycle": cycle,
                                  "auc": None, "reason": "no-holdout"})
         if auc < self.min_auc:
             self.m_rejected.inc()
             log_warning(
-                f"continuous: cycle {cycle} candidate REJECTED: AUC "
-                f"{auc:.4f} below the absolute floor {self.min_auc:.4f}")
+                f"continuous: cycle {cycle} candidate REJECTED: "
+                f"{self._metric_label} {auc:.4f} below the absolute "
+                f"floor {self.min_auc:.4f}")
             return self._record({"action": "reject", "cycle": cycle,
                                  "auc": auc, "reason": "floor"})
         if (self.best_auc is not None
                 and auc < self.best_auc - self.max_regression):
             self.m_rejected.inc()
             log_warning(
-                f"continuous: cycle {cycle} candidate REJECTED: AUC "
-                f"{auc:.4f} regresses more than {self.max_regression:.4f} "
-                f"from the best published {self.best_auc:.4f}")
+                f"continuous: cycle {cycle} candidate REJECTED: "
+                f"{self._metric_label} {auc:.4f} regresses more than "
+                f"{self.max_regression:.4f} from the best published "
+                f"{self.best_auc:.4f}")
             return self._record({"action": "reject", "cycle": cycle,
                                  "auc": auc, "reason": "regression"})
         if self.attrib_gate and self._attrib_alarm_pending:
@@ -156,7 +177,8 @@ class PublishGate:
         self._live_model_str = candidate_str
         self.m_published.inc()
         log_info(f"continuous: cycle {cycle} candidate PUBLISHED as "
-                 f"{self.model_name!r} v{version} (holdout AUC {auc:.4f})")
+                 f"{self.model_name!r} v{version} (holdout "
+                 f"{self._metric_label} {auc:.4f})")
         return self._record({"action": "publish", "cycle": cycle,
                              "auc": auc, "version": version})
 
@@ -180,29 +202,46 @@ class PublishGate:
         return version
 
     # ------------------------------------------------------------------
-    def watch(self, X: np.ndarray, y: np.ndarray) -> Optional[Dict]:
+    def watch(self, X: np.ndarray, y: np.ndarray,
+              group: Optional[np.ndarray] = None) -> Optional[Dict]:
         """Score the LIVE model on a fresh holdout window; on confirmed
         regression roll the registry back (alarm counter + event).
         Returns the rollback event, or None when the model held up (or
-        the window was too small / nothing is published)."""
+        the window was too small / nothing is published).  In NDCG mode
+        the window is query-grouped (``group`` = per-query row counts)
+        and a window whose queries all carry one relevance grade is
+        skipped — every such NDCG is a degenerate 1.0, not evidence."""
         if self.live_auc is None or len(y) < self.min_fresh_rows:
             return None
-        if len(np.unique(np.asarray(y) > 0)) < 2:
-            return None                     # one-class window: AUC undefined
-        from .trainer import holdout_auc
-        # score the string this gate published (its registry 'current'):
-        # exact, transport-free, and immune to the predictor's weakref
-        # booster being collected
-        fresh = holdout_auc(self._live_model_str, np.asarray(X),
-                            np.asarray(y))
+        y_arr = np.asarray(y)
+        if self.metric == "ndcg":
+            if group is None or not len(group):
+                return None
+            bounds = np.concatenate([[0], np.cumsum(group)]).astype(int)
+            if all(len(np.unique(y_arr[s:e])) < 2
+                   for s, e in zip(bounds[:-1], bounds[1:])):
+                return None     # constant-label queries: NDCG degenerate
+            from .trainer import holdout_ndcg
+            fresh = holdout_ndcg(self._live_model_str, np.asarray(X),
+                                 y_arr, group, self.ndcg_at,
+                                 self.label_gain)
+        else:
+            if len(np.unique(y_arr > 0)) < 2:
+                return None             # one-class window: AUC undefined
+            from .trainer import holdout_auc
+            # score the string this gate published (its registry
+            # 'current'): exact, transport-free, and immune to the
+            # predictor's weakref booster being collected
+            fresh = holdout_auc(self._live_model_str, np.asarray(X),
+                                y_arr)
         bound = max(self.min_auc, self.live_auc - self.max_regression)
         if fresh >= bound:
             return None
         self.m_rollbacks.inc()
         log_warning(
             f"continuous: ALARM — live model {self.model_name!r} regressed "
-            f"on fresh data (AUC {fresh:.4f} < bound {bound:.4f}, "
-            f"published at {self.live_auc:.4f}); rolling back")
+            f"on fresh data ({self._metric_label} {fresh:.4f} < bound "
+            f"{bound:.4f}, published at {self.live_auc:.4f}); rolling back")
         if self._rollback_fn is not None:
             restored = self._rollback_fn()
         else:

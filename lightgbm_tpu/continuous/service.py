@@ -106,12 +106,19 @@ class ContinuousService:
                          "decision": None, "rollback": None}
         if not batches:
             return summary
-        fresh_hX, fresh_hy = [], []
+        fresh_hX, fresh_hy, fresh_hg = [], [], []
         for b in batches:
-            hx, hy = self.trainer.ingest(b.X, b.y)
+            # tails predating query support yield batches without .group,
+            # and their trainers take (X, y) and return a 2-tuple
+            g = getattr(b, "group", None)
+            res = (self.trainer.ingest(b.X, b.y) if g is None
+                   else self.trainer.ingest(b.X, b.y, group=g))
+            hx, hy, hg = res if len(res) == 3 else (*res, None)
             if len(hy):
                 fresh_hX.append(hx)
                 fresh_hy.append(hy)
+                if hg is not None:
+                    fresh_hg.append(hg)
         # drift watch FIRST: if the live model already regresses on the
         # fresh window, roll back before training bakes the drift into a
         # new candidate's comparison base
@@ -129,7 +136,9 @@ class ContinuousService:
                 summary["attrib_alarm"] = al
             with _trace.child_span("cycle.watch") as ws:
                 rb = self.gate.watch(np.concatenate(fresh_hX),
-                                     np.concatenate(fresh_hy))
+                                     np.concatenate(fresh_hy),
+                                     group=(np.concatenate(fresh_hg)
+                                            if fresh_hg else None))
                 if ws is not None and rb is not None:
                     ws.set(rollback=True)
             if rb is not None:

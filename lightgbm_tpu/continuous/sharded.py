@@ -1016,6 +1016,7 @@ class ShardedContinuousService(ContinuousService):
         self._raw_base_path = (f"{self.fleet_dir}/raw_base_rank"
                                f"{self.comm.rank}.npz")
         self._state_path = f"{self.fleet_dir}/commit_state.json"
+        self._attrib_sketch_path = f"{self.fleet_dir}/attrib_sketch.npz"
         self._quorum_dir = f"{self.fleet_dir}/quorum"
         self._pending_replay: List[str] = []
         self._pending_needs_prepare = False
@@ -1189,8 +1190,11 @@ class ShardedContinuousService(ContinuousService):
                  "excluded_history": {str(c): rs for c, rs in
                                       sorted(
                                           self._excluded_history.items())},
+                 "attrib_alarm_pending": bool(
+                     self.gate._attrib_alarm_pending),
                  "model_file": None, "model_sha256": None,
                  "prev_model_file": None}
+        self._write_attrib_sketch()
         if tr.model_str is not None:
             mf = f"{self.fleet_dir}/committed_model.txt"
             payload = tr.model_str.encode("utf-8")
@@ -1203,6 +1207,40 @@ class ShardedContinuousService(ContinuousService):
             state["prev_model_file"] = pf
         tmp_state = json.dumps(state, indent=1)
         _write_bytes_atomic(self._state_path, tmp_state.encode("utf-8"))
+
+    def _write_attrib_sketch(self) -> None:
+        """Persist the attribution-drift sketch (phase 2, leader): the
+        early-warning profile is cumulative evidence, and a relaunch
+        that restarted it from zero would re-pin its REFERENCE windows
+        on post-drift data — silencing the very alarm it exists to
+        raise.  Written atomically next to the commit record; restored
+        in `recover` together with the pending-alarm flag."""
+        sk = getattr(self.gate, "sketch", None)
+        if sk is None:
+            return
+        buf = io.BytesIO()
+        np.savez(buf,
+                 cycle=np.asarray([self.trainer.cycle - 1], np.int64),
+                 num_features=np.asarray([sk.num_features], np.int64),
+                 **sk.state_dict())
+        _write_bytes_atomic(self._attrib_sketch_path, buf.getvalue())
+
+    def _restore_attrib_sketch(self, state: Dict) -> None:
+        """Recovery side of `_write_attrib_sketch`: rebuild the gate's
+        sketch from the committed record and re-arm the pending-alarm
+        flag the commit state carried."""
+        self.gate._attrib_alarm_pending = bool(
+            state.get("attrib_alarm_pending", False))
+        try:
+            blob = file_io.read_bytes(self._attrib_sketch_path)
+        except OSError:
+            return
+        from ..explain import AttributionSketch
+        with np.load(io.BytesIO(blob)) as z:
+            sk = AttributionSketch(int(z["num_features"][0]))
+            sk.load_state({k: np.asarray(z[k]) for k in
+                           ("ref_sum", "ref_sumsq", "rec_sum", "counts")})
+        self.gate.sketch = sk
 
     def _write_raw_base(self) -> None:
         """Persist this rank's committed raw-score cache (phase 2): the
@@ -1293,6 +1331,7 @@ class ShardedContinuousService(ContinuousService):
             self.gate.live_auc = state.get("live_auc")
             if self.gate.live_auc is not None:
                 self.gate._live_model_str = tr.model_str
+            self._restore_attrib_sketch(state)
             if tr.model_str is not None and self.gate.registry is not None:
                 # serving resumes from the committed model immediately,
                 # before the first recovered cycle finishes
@@ -1768,7 +1807,7 @@ class ShardedContinuousService(ContinuousService):
                 maybe_inject_rank_stall(cycle, rank=self.comm.rank)
             fresh_hX, fresh_hy = [], []
             for b in batches:
-                hx, hy = tr.ingest(b.X, b.y)
+                hx, hy, _ = tr.ingest(b.X, b.y)
                 if len(hy):
                     fresh_hX.append(hx)
                     fresh_hy.append(hy)
@@ -1810,6 +1849,13 @@ class ShardedContinuousService(ContinuousService):
                     timeout_s=tmo)
                 st["watched"] = True
                 if len(wy_g):
+                    # attribution early warning first (label-free, must
+                    # score the model that is still live); every rank
+                    # folds the same fleet-global window, so the sketch
+                    # state the leader commits is what any rank holds
+                    al = self.gate.watch_attribution(wX_g)
+                    if al is not None:
+                        summary["attrib_alarm"] = al
                     rb = self.gate.watch(wX_g, wy_g)
                     if rb is not None:
                         summary["rollback"] = rb

@@ -16,7 +16,21 @@ and parses them with PER-RECORD validation:
   Inf always quarantines — no real feature pipeline emits it on
   purpose).
 - **label**: non-finite labels always quarantine; ``label_kind="binary"``
-  additionally requires 0/1.
+  additionally requires 0/1, ``label_kind="rank"`` requires a
+  non-negative integer relevance grade.
+
+**Query structure** (learning-to-rank ingest): with
+``query_mode="qid"`` every row carries its query id as the SECOND field
+(``label,qid,feat...``) and consecutive rows with the same qid form one
+query; with ``query_mode="sidecar"`` a ``<segment>.group`` file declares
+per-query row counts over the segment's data rows in order.  Queries
+are ATOMIC: a bad row quarantines its whole query (clean siblings
+included), and a structural tear — a qid that reappears
+non-contiguously, an unreadable qid, declared sizes that do not cover
+the segment's rows, or an incomplete final query — quarantines the
+segment TAIL from the tear point whole, so a query is never split
+between the training store and the quarantine file.  Clean batches
+carry their per-query sizes in ``SegmentBatch.group``.
 
 Bad rows land in a quarantine JSONL (one ``{"segment", "row", "reason",
 "raw"}`` line each, append-mode so restarts keep history) and bump
@@ -86,6 +100,9 @@ class SegmentBatch(NamedTuple):
     X: np.ndarray            # [n, num_features] float64
     y: np.ndarray            # [n] float64
     quarantined: int
+    # per-query row counts over the clean rows (query_mode != "none");
+    # None for flat row-stream segments
+    group: Optional[np.ndarray] = None
 
 
 class DataTail:
@@ -94,6 +111,7 @@ class DataTail:
                  quarantine_path: Optional[str] = None,
                  registry=None,
                  label_kind: str = "binary",
+                 query_mode: str = "none",
                  allow_nan_features: bool = False,
                  sep: str = ",",
                  shard_rank: int = 0,
@@ -106,6 +124,10 @@ class DataTail:
         self.num_features = num_features
         self.quarantine_path = quarantine_path
         self.label_kind = label_kind
+        if query_mode not in ("none", "qid", "sidecar"):
+            raise ValueError(f"query_mode {query_mode!r} not in "
+                             "('none', 'qid', 'sidecar')")
+        self.query_mode = query_mode
         self.allow_nan_features = bool(allow_nan_features)
         self.sep = sep
         self.shard_rank = int(shard_rank)
@@ -165,7 +187,7 @@ class DataTail:
         fresh = [n for n in sorted(names)
                  if n not in self._seen
                  and not n.startswith((".", "_"))
-                 and not n.endswith(".tmp")
+                 and not n.endswith((".tmp", ".group"))
                  and (self.num_shards <= 1 or self._subdir_layout
                       or shard_of(n, self.num_shards) == self.shard_rank)
                  and (n not in self._retry or self._retry[n][1] <= now)]
@@ -187,12 +209,151 @@ class DataTail:
             return None, f"label: non-finite ({label!r})"
         if self.label_kind == "binary" and label not in (0.0, 1.0):
             return None, f"label: {label!r} not in {{0, 1}}"
+        if self.label_kind == "rank" and (label < 0 or label != int(label)):
+            return None, (f"label: {label!r} is not a non-negative "
+                          "integer relevance grade")
         for j, v in enumerate(feats):
             if math.isinf(v):
                 return None, f"feature {j}: Inf"
             if math.isnan(v) and not self.allow_nan_features:
                 return None, f"feature {j}: NaN"
         return (feats, label), ""
+
+    def _parse_row(self, row: int, line: str) -> dict:
+        """Parse one data line into a record dict.  ``qid_bad`` marks a
+        row whose query id could not be read at all — a structural tear
+        in qid mode, not just a bad row."""
+        fields = line.split(self.sep)
+        rec = {"row": row, "raw": line, "qid": None, "qid_bad": False,
+               "feats": None, "label": None, "reason": ""}
+        if self.query_mode == "qid":
+            if len(fields) < 2:
+                rec["qid_bad"] = True
+                rec["reason"] = "qid: missing field (label,qid,features...)"
+                return rec
+            try:
+                rec["qid"] = int(fields[1])
+            except ValueError:
+                rec["qid_bad"] = True
+                rec["reason"] = f"qid: {fields[1]!r} is not an integer"
+                return rec
+            fields = [fields[0]] + fields[2:]
+        parsed, reason = self._validate_line(fields)
+        if parsed is None:
+            rec["reason"] = reason
+            return rec
+        rec["feats"], rec["label"] = parsed
+        if self.num_features is None:
+            # first clean row pins the expected width for every
+            # subsequent row and segment
+            self.num_features = len(rec["feats"])
+        return rec
+
+    @staticmethod
+    def _parse_sidecar(text: str):
+        """Per-query sizes from a ``<segment>.group`` sidecar, or
+        ``(None, reason)`` when the sidecar is malformed."""
+        sizes: List[int] = []
+        for i, line in enumerate(text.splitlines()):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                v = int(line)
+            except ValueError:
+                return None, (f"line {i}: {line[:50]!r} is not an "
+                              "integer query size")
+            if v <= 0:
+                return None, f"line {i}: query size {v} must be positive"
+            sizes.append(v)
+        return sizes, ""
+
+    def _group_rows(self, name: str, recs: List[dict],
+                    sizes: Optional[List[int]]):
+        """Partition parsed rows into ATOMIC queries.
+
+        Returns ``(clean_recs, group_sizes, quarantine_records)``.  A bad
+        row quarantines its whole query (clean siblings carry a
+        ``query integrity`` reason); a structural tear quarantines the
+        segment tail from the tear point whole, so no query is ever
+        split between the clean batch and the quarantine file."""
+        queries: List[List[dict]] = []
+        tail_start: Optional[int] = None
+        tail_reason = ""
+        if self.query_mode == "qid":
+            cur: List[dict] = []
+            cur_qid: Optional[int] = None
+            seen: Set[int] = set()
+            for k, rec in enumerate(recs):
+                if rec["qid_bad"]:
+                    # unknown qid: the in-progress query might continue
+                    # here, so the tail starts at ITS first row
+                    tail_start = k - len(cur)
+                    tail_reason = (f"query structure: {rec['reason']} — "
+                                   "segment tail quarantined whole "
+                                   "(queries are never split)")
+                    cur = []
+                    break
+                q = rec["qid"]
+                if cur and q == cur_qid:
+                    cur.append(rec)
+                    continue
+                if q in seen:
+                    if cur:
+                        queries.append(cur)
+                        cur = []
+                    tail_start = k
+                    tail_reason = (f"query structure: qid {q} reappears "
+                                   "non-contiguously — segment tail "
+                                   "quarantined whole (queries are "
+                                   "never split)")
+                    break
+                if cur:
+                    queries.append(cur)
+                cur = [rec]
+                cur_qid = q
+                seen.add(q)
+            if tail_start is None and cur:
+                queries.append(cur)
+        else:                                   # sidecar
+            pos = 0
+            for s in sizes or []:
+                if pos + s <= len(recs):
+                    queries.append(recs[pos:pos + s])
+                    pos += s
+                    continue
+                tail_start = pos
+                tail_reason = ("query structure: incomplete final query "
+                               f"(declared {s} rows, segment has "
+                               f"{len(recs) - pos} left) — tail "
+                               "quarantined whole (queries are never "
+                               "split)")
+                break
+            if tail_start is None and pos < len(recs):
+                tail_start = pos
+                tail_reason = (f"query structure: {len(recs) - pos} rows "
+                               "beyond the declared query sizes — tail "
+                               "quarantined whole")
+        quar: List[dict] = []
+        clean: List[dict] = []
+        group: List[int] = []
+        for qrows in queries:
+            if any(r["reason"] for r in qrows):
+                for r in qrows:
+                    quar.append({
+                        "segment": name, "row": r["row"],
+                        "reason": r["reason"] or
+                        "query integrity: sibling row quarantined "
+                        "(queries are atomic)",
+                        "raw": r["raw"][:500]})
+            else:
+                clean.extend(qrows)
+                group.append(len(qrows))
+        if tail_start is not None:
+            for r in recs[tail_start:]:
+                quar.append({"segment": name, "row": r["row"],
+                             "reason": tail_reason, "raw": r["raw"][:500]})
+        return clean, group, quar
 
     def _read_segment(self, name: str,
                       record_quarantine: bool = True
@@ -205,29 +366,55 @@ class DataTail:
             log_warning(f"continuous: cannot read segment {path}: {exc} — "
                         "will retry next poll")
             return None
-        rows, labels, quarantined = [], [], []
+        sizes: Optional[List[int]] = None
+        sidecar_err = ""
+        if self.query_mode == "sidecar":
+            try:
+                side_text = file_io.read_text(f"{path}.group")
+            except OSError as exc:
+                self.m_segment_errors.inc()
+                log_warning(f"continuous: cannot read group sidecar "
+                            f"{path}.group: {exc} — will retry next poll")
+                return None
+            sizes, sidecar_err = self._parse_sidecar(side_text)
+        recs: List[dict] = []
         for i, line in enumerate(text.splitlines()):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            parsed, reason = self._validate_line(line.split(self.sep))
-            if parsed is None:
-                quarantined.append({"segment": name, "row": i,
-                                    "reason": reason, "raw": line[:500]})
-                continue
-            feats, label = parsed
-            if self.num_features is None:
-                # first clean row pins the expected width for every
-                # subsequent row and segment
-                self.num_features = len(feats)
-            rows.append(feats)
-            labels.append(label)
-        if quarantined and record_quarantine:
-            self._quarantine(quarantined)
-        X = (np.asarray(rows, np.float64) if rows
+            recs.append(self._parse_row(i, line))
+        if self.query_mode == "sidecar" and sizes is None:
+            # a malformed sidecar is deterministic — quarantine the whole
+            # segment now instead of retrying a read that cannot improve
+            quar = [{"segment": name, "row": r["row"],
+                     "reason": f"group sidecar: {sidecar_err} — segment "
+                               "quarantined whole",
+                     "raw": r["raw"][:500]} for r in recs]
+            quar.append({"segment": name, "row": -1,
+                         "reason": f"group sidecar: {sidecar_err}",
+                         "raw": ""})
+            if record_quarantine:
+                self._quarantine(quar)
+            return SegmentBatch(
+                name, np.empty((0, self.num_features or 0), np.float64),
+                np.empty((0,), np.float64), len(quar),
+                np.empty((0,), np.int64))
+        if self.query_mode == "none":
+            clean = [r for r in recs if not r["reason"]]
+            quar = [{"segment": name, "row": r["row"],
+                     "reason": r["reason"], "raw": r["raw"][:500]}
+                    for r in recs if r["reason"]]
+            group = None
+        else:
+            clean, group, quar = self._group_rows(name, recs, sizes)
+        if quar and record_quarantine:
+            self._quarantine(quar)
+        X = (np.asarray([r["feats"] for r in clean], np.float64) if clean
              else np.empty((0, self.num_features or 0), np.float64))
-        return SegmentBatch(name, X, np.asarray(labels, np.float64),
-                            len(quarantined))
+        y = np.asarray([r["label"] for r in clean], np.float64)
+        g = (np.asarray(group, np.int64)
+             if group is not None else None)
+        return SegmentBatch(name, X, y, len(quar), g)
 
     def _quarantine(self, records: List[dict]) -> None:
         self.m_quarantined.inc(len(records))

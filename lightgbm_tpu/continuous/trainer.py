@@ -56,7 +56,7 @@ from ..metrics import AUCMetric
 from ..telemetry import get_counter
 
 __all__ = ["ContinuousTrainer", "combine_model_strings", "holdout_auc",
-           "checkpoint_prefix_matches"]
+           "holdout_ndcg", "checkpoint_prefix_matches"]
 
 _REBIN_POLICIES = ("never", "drift", "every_k")
 
@@ -104,6 +104,22 @@ def holdout_auc(model, X: np.ndarray, y: np.ndarray) -> float:
     return float(AUCMetric(None).eval(raw, y, None, None)[0][1])
 
 
+def holdout_ndcg(model, X: np.ndarray, y: np.ndarray, group: np.ndarray,
+                 k: int = 5, label_gain=None) -> float:
+    """Held-out NDCG@k of ``model`` over query-grouped rows — the rank
+    pipeline's gate number.  ``group`` holds per-query row counts in row
+    order; scoring runs on device through `rank.ndcg.device_ndcg` with
+    the same semantics as the host NDCG metric."""
+    from ..basic import Booster
+    from ..rank.ndcg import device_ndcg
+    if isinstance(model, str):
+        model = Booster(model_str=model)
+    raw = np.asarray(model.predict(X, raw_score=True), np.float64).ravel()
+    qb = np.concatenate([[0], np.cumsum(np.asarray(group, np.int64))])
+    return float(device_ndcg(raw, y, qb, eval_at=(int(k),),
+                             label_gain=label_gain)[0])
+
+
 def checkpoint_prefix_matches(state, booster) -> bool:
     """True when ``booster``'s first ``len(state.trees)`` trees are
     BIT-IDENTICAL (model-text equality over exactly-pickled trees) to the
@@ -135,6 +151,8 @@ class ContinuousTrainer:
                  rebin_policy: str = "drift",
                  rebin_threshold: float = 0.2,
                  rebin_every_k: int = 10,
+                 gate_metric: str = "auc",
+                 ndcg_at: int = 5,
                  metrics_registry=None):
         if not 0.0 < holdout_fraction < 1.0:
             raise LightGBMError("holdout_fraction must be in (0, 1), got "
@@ -143,6 +161,11 @@ class ContinuousTrainer:
             raise LightGBMError(
                 f"rebin_policy {rebin_policy!r} must be one of "
                 f"{_REBIN_POLICIES}")
+        if gate_metric not in ("auc", "ndcg"):
+            raise LightGBMError(
+                f"gate_metric {gate_metric!r} must be 'auc' or 'ndcg'")
+        self.gate_metric = gate_metric
+        self.ndcg_at = int(ndcg_at)
         from ..config import resolve_aliases
         self.params = resolve_aliases(dict(params))
         # strip service-level and per-run knobs: rounds_per_cycle is the
@@ -184,10 +207,15 @@ class ContinuousTrainer:
         self._prev_model_str: Optional[str] = None
         self._train_X: List[np.ndarray] = []
         self._train_y: List[np.ndarray] = []
+        self._train_g: List[Optional[np.ndarray]] = []
         self._hold_X: List[np.ndarray] = []
         self._hold_y: List[np.ndarray] = []
+        self._hold_g: List[Optional[np.ndarray]] = []
         self._holdout_cache: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._holdout_group_cache: Optional[np.ndarray] = None
         self._ingested = 0
+        self._ingested_queries = 0
+        self._query_data: Optional[bool] = None   # pinned by first ingest
         self.resume_events: List[Dict] = []
         # incremental store state
         self._store = None            # persistent TrainDataset
@@ -209,22 +237,53 @@ class ContinuousTrainer:
     def num_train_rows(self) -> int:
         return sum(len(y) for y in self._train_y)
 
-    def ingest(self, X: np.ndarray, y: np.ndarray
-               ) -> Tuple[np.ndarray, np.ndarray]:
+    def ingest(self, X: np.ndarray, y: np.ndarray,
+               group: Optional[np.ndarray] = None):
         """Add validated rows to the cumulative pool; returns the rows'
-        HOLDOUT slice (the fresh window the gate's drift watch scores the
-        live model on)."""
-        idx = np.arange(self._ingested, self._ingested + len(y))
-        self._ingested += len(y)
-        hold = (idx % self.holdout_every) == 0
+        HOLDOUT slice ``(X, y, group)`` (the fresh window the gate's
+        drift watch scores the live model on; ``group`` is None for flat
+        row streams).
+
+        With ``group`` (per-query row counts) the train/holdout split
+        walks the GLOBAL QUERY index modulo ``holdout_every`` instead of
+        the row index — whole queries land on one side or the other, so
+        rank metrics see intact queries and a replayed ingest reproduces
+        the same query-level split."""
+        if self._query_data is None:
+            self._query_data = group is not None
+        elif self._query_data != (group is not None):
+            raise LightGBMError(
+                "ingest() mixes query-grouped and flat segments: every "
+                "segment must carry group sizes iff the first one did")
+        if group is None:
+            idx = np.arange(self._ingested, self._ingested + len(y))
+            self._ingested += len(y)
+            hold = (idx % self.holdout_every) == 0
+            g_tr = g_ho = None
+        else:
+            group = np.asarray(group, np.int64)
+            if int(group.sum()) != len(y):
+                raise LightGBMError(
+                    f"ingest() group sizes sum to {int(group.sum())} but "
+                    f"the segment has {len(y)} rows")
+            qidx = np.arange(self._ingested_queries,
+                             self._ingested_queries + len(group))
+            self._ingested_queries += len(group)
+            self._ingested += len(y)
+            hold_q = (qidx % self.holdout_every) == 0
+            hold = np.repeat(hold_q, group)
+            g_tr, g_ho = group[~hold_q], group[hold_q]
         if (~hold).any():
             self._train_X.append(np.asarray(X[~hold], np.float64))
             self._train_y.append(np.asarray(y[~hold], np.float64))
+            self._train_g.append(g_tr)
         if hold.any():
             self._hold_X.append(np.asarray(X[hold], np.float64))
             self._hold_y.append(np.asarray(y[hold], np.float64))
+            self._hold_g.append(g_ho)
             self._holdout_cache = None     # invalidate on new holdout rows
-        return X[hold], y[hold]
+            self._holdout_group_cache = None
+        return X[hold], y[hold], g_ho
 
     def holdout(self) -> Tuple[np.ndarray, np.ndarray]:
         """Cumulative holdout (gate AUC input).  Cached: the gate's drift
@@ -237,6 +296,15 @@ class ContinuousTrainer:
                                    np.concatenate(self._hold_y))
         return self._holdout_cache
 
+    def holdout_group(self) -> Optional[np.ndarray]:
+        """Cumulative holdout per-query sizes (None for flat streams);
+        row order matches `holdout`."""
+        if not self._query_data or not self._hold_g:
+            return None
+        if self._holdout_group_cache is None:
+            self._holdout_group_cache = np.concatenate(self._hold_g)
+        return self._holdout_group_cache
+
     # ------------------------------------------------------------------
     def _cycle_dir(self, cycle: int) -> str:
         return f"{self.workdir}/cycles/cycle_{cycle:05d}"
@@ -246,13 +314,21 @@ class ContinuousTrainer:
         """Concatenated raw train pool (this rank's rows)."""
         return np.concatenate(self._train_X), np.concatenate(self._train_y)
 
+    def _pool_group(self) -> Optional[np.ndarray]:
+        """Concatenated per-query sizes of the train pool (None for flat
+        streams); row order matches `_pool`."""
+        if not self._query_data:
+            return None
+        return np.concatenate([g for g in self._train_g if g is not None])
+
     def _construct_store(self, X: np.ndarray, y: np.ndarray):
         """Build the binned store over the pool — the subclass seam the
         sharded trainer overrides to bin against FLEET-SHARED mappers
         instead of deriving them from this rank's rows alone."""
         from ..config import Config
         from ..dataset import Metadata, TrainDataset
-        return TrainDataset(X, Metadata(y), Config(self.params))
+        return TrainDataset(X, Metadata(y, group=self._pool_group()),
+                            Config(self.params))
 
     def _build_store(self, reset_sketch: bool = True) -> None:
         """(Re)build the persistent binned store from the raw pool: fresh
@@ -285,7 +361,8 @@ class ContinuousTrainer:
         while self._store_segments < len(self._train_X):
             i = self._store_segments
             Xs, ys = self._train_X[i], self._train_y[i]
-            new_bins = self._store.extend(Xs, ys)
+            new_bins = self._store.extend(Xs, ys,
+                                          group_new=self._train_g[i])
             self._sketch.update(new_bins)
             self._store_segments = i + 1
             fresh += len(ys)
@@ -433,7 +510,8 @@ class ContinuousTrainer:
                 if self.model_str is not None:
                     from ..basic import Booster
                     init = Booster(model_str=self.model_str)
-                ds = lgb.Dataset(X, y, free_raw_data=False)
+                ds = lgb.Dataset(X, y, group=self._pool_group(),
+                                 free_raw_data=False)
                 if init is None:
                     # with init_model, engine.train rebuilds the handle
                     # after folding in the init score — constructing here
@@ -509,13 +587,21 @@ class ContinuousTrainer:
             np.float32)[:self._store.num_data].astype(np.float64)
 
     def _cycle_auc(self, candidate_str: str) -> float:
-        """Cumulative-holdout AUC of the candidate.  The sharded trainer
-        allgathers per-rank (raw, label) pairs so every rank computes the
-        identical fleet-global number and gate decisions cannot
-        diverge."""
+        """Cumulative-holdout gate score of the candidate: AUC, or mean
+        NDCG@``ndcg_at`` when ``gate_metric="ndcg"`` (query-grouped
+        ingest).  The sharded trainer allgathers per-rank (raw, label)
+        pairs so every rank computes the identical fleet-global number
+        and gate decisions cannot diverge."""
         hx, hy = self.holdout()
-        return holdout_auc(candidate_str, hx, hy) if len(hy) \
-            else float("nan")
+        if not len(hy):
+            return float("nan")
+        if self.gate_metric == "ndcg":
+            hg = self.holdout_group()
+            if hg is None or not len(hg):
+                return float("nan")
+            return holdout_ndcg(candidate_str, hx, hy, hg, self.ndcg_at,
+                                self.params.get("label_gain"))
+        return holdout_auc(candidate_str, hx, hy)
 
     def commit(self, candidate_str: str) -> None:
         """Advance the committed model (the gate accepted the candidate)

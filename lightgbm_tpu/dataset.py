@@ -692,11 +692,12 @@ class TrainDataset:
 
     def _row_buckets_on(self, metadata: Metadata) -> bool:
         """Row-bucket padding gate: config ``train_row_buckets``, minus the
-        shapes the masking contract can't cover (query/group structure
-        would put padded rows inside queries; linear leaves regress on raw
-        values the pad rows don't have)."""
+        shapes the masking contract can't cover (linear leaves regress on
+        raw values the pad rows don't have).  Query/group data pads fine:
+        padded rows sit AFTER every query, the ranking layout never
+        indexes them, and the gradient scatter drops its pad slots
+        (rank.bucket), so padded ranking stays bit-identical."""
         return bool(getattr(self.config, "train_row_buckets", False)
-                    and metadata.query_ids is None
                     and not getattr(self.config, "linear_tree", False)
                     # RF folds boost_from_average over the raw label array
                     # (rf.py _rf_init) — padded zeros would shift it
@@ -721,16 +722,21 @@ class TrainDataset:
         self.num_rows_device = int(n_pad)
         label = metadata.label
         weight = metadata.weight
+        qids = metadata.query_ids
         if n_pad != n:
             host_dev_bins = pad_rows(host_dev_bins, n_pad)
             label = pad_rows(np.asarray(label), n_pad)
             if weight is not None:
                 weight = pad_rows(np.asarray(weight), n_pad)
+            if qids is not None:
+                # padded rows belong to NO query: -1 keeps them out of any
+                # per-query consumer without shifting real query ids
+                qids = np.concatenate([np.asarray(qids, np.int32),
+                                       np.full(n_pad - n, -1, np.int32)])
         self.device_bins = jnp.asarray(host_dev_bins)
         self.label = jnp.asarray(label)
         self.weight = jnp.asarray(weight) if weight is not None else None
-        self.query_ids = (jnp.asarray(metadata.query_ids)
-                          if metadata.query_ids is not None else None)
+        self.query_ids = jnp.asarray(qids) if qids is not None else None
 
     # ------------------------------------------------------------------
     # Incremental construction (frozen-mapper continuation datasets)
@@ -818,8 +824,15 @@ class TrainDataset:
                 np.asarray(self.metadata.weight, np.float32))
 
     def extend(self, X_new: np.ndarray, y_new: np.ndarray,
-               weight_new: Optional[np.ndarray] = None) -> np.ndarray:
+               weight_new: Optional[np.ndarray] = None,
+               group_new: Optional[np.ndarray] = None) -> np.ndarray:
         """Append fresh rows binned with this dataset's FROZEN mappers.
+
+        Query/group datasets extend by WHOLE queries: ``group_new`` gives
+        the fresh per-query sizes (summing to the fresh row count) and is
+        required exactly when the dataset carries query structure — the
+        continuous tail's query-integrity validation guarantees callers
+        never hand over a torn query.
 
         The incremental-continuation fast path: only the fresh segment is
         binned (``bin_external``) and bundle-encoded — O(segment) host
@@ -844,9 +857,12 @@ class TrainDataset:
             raise LightGBMError(
                 "extend() needs the full device-space matrix; rank-local "
                 "shards cannot extend incrementally")
-        if self.metadata.query_ids is not None:
-            raise LightGBMError("extend() does not support query/group "
-                                "structured data")
+        has_q = self.metadata.query_boundaries is not None
+        if has_q != (group_new is not None):
+            raise LightGBMError(
+                "extend() group sizes must match the dataset's query "
+                "structure: pass group_new= (whole queries) iff the "
+                "dataset was built with group=")
         if self.raw_device is not None:
             raise LightGBMError(
                 "extend() does not support linear_tree datasets (linear "
@@ -857,6 +873,14 @@ class TrainDataset:
         if X_new.shape[0] != len(y_new):
             raise ValueError(f"label length {len(y_new)} != rows "
                              f"{X_new.shape[0]}")
+        if group_new is not None:
+            group_new = np.asarray(group_new, np.int64).reshape(-1)
+            if (group_new <= 0).any():
+                raise ValueError("group sizes must be positive")
+            if group_new.sum() != len(y_new):
+                raise ValueError(
+                    f"sum of group sizes ({int(group_new.sum())}) != fresh "
+                    f"rows ({len(y_new)})")
         has_w = self.metadata.weight is not None or (
             self._store_weight is not None)
         if has_w != (weight_new is not None):
@@ -888,6 +912,18 @@ class TrainDataset:
         if has_w:
             md.weight = self._store_weight.view()
         md.init_score = None        # stale for the grown row set
+        if group_new is not None:
+            # whole fresh queries appended after the existing ones
+            # (reference Metadata::SetQuery over the grown row set)
+            old_n = int(md.query_boundaries[-1])
+            md.query_boundaries = np.concatenate(
+                [md.query_boundaries, old_n + np.cumsum(group_new)])
+            first_new = int(md.query_ids[-1]) + 1 if len(md.query_ids) else 0
+            md.query_ids = np.concatenate(
+                [md.query_ids,
+                 (first_new + np.repeat(np.arange(len(group_new)),
+                                        group_new)).astype(np.int32)])
+            md.num_queries = len(md.query_boundaries) - 1
         n_pad = _train_row_bucket(n) if self._row_buckets_on(md) else n
         self.num_rows_device = int(n_pad)
         # device refresh is a plain transfer of the padded host views —
@@ -897,6 +933,12 @@ class TrainDataset:
         self.label = jnp.asarray(self._store_label.padded_view(n_pad))
         self.weight = (jnp.asarray(self._store_weight.padded_view(n_pad))
                        if has_w else None)
+        if md.query_ids is not None:
+            qids = np.asarray(md.query_ids, np.int32)
+            if n_pad != n:
+                qids = np.concatenate(
+                    [qids, np.full(n_pad - n, -1, np.int32)])
+            self.query_ids = jnp.asarray(qids)
         self.setup_timings = {"binning_s": binning_s,
                               "construct_s": time.perf_counter() - t1}
         return new_bins

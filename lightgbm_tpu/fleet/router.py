@@ -170,6 +170,7 @@ class HttpReplica:
         # version for a token it already applied), so it retries too.
         retry_safe = (method == "GET" or path.endswith(":predict")
                       or path.endswith(":explain")
+                      or path.endswith(":rank")
                       or (isinstance(body, dict)
                           and bool(body.get("publish_token"))))
         for attempt in (0, 1):
@@ -254,6 +255,33 @@ class _ModelStats:
             self.latency_hist = reg.histogram(
                 "lgbm_fleet_explain_request_latency_seconds",
                 "router-side end-to-end explain latency", **lab)
+        elif verb == "rank":
+            # the rank lane is likewise its own SLO class: a :rank
+            # request is a whole query group, so its latency/goodput
+            # economics (rows follow query length) must not dilute the
+            # predict feed the placement controller reads
+            self.requests = reg.counter(
+                "lgbm_fleet_rank_requests_total",
+                "rank (query scoring) requests at the router", **lab)
+            self.reroutes = reg.counter(
+                "lgbm_fleet_rank_reroutes_total",
+                "rank forwards retried on another replica after a "
+                "failure", **lab)
+            self.shed = reg.counter(
+                "lgbm_fleet_rank_shed_total",
+                "rank requests shed because no replica was within SLO",
+                **lab)
+            self.errors = reg.counter(
+                "lgbm_fleet_rank_errors_total",
+                "rank requests that failed on every routable replica",
+                **lab)
+            self.missed = reg.counter(
+                "lgbm_fleet_rank_deadline_missed_total",
+                "rank requests that ended 504 (deadline verdict "
+                "anywhere along the chain)", **lab)
+            self.latency_hist = reg.histogram(
+                "lgbm_fleet_rank_request_latency_seconds",
+                "router-side end-to-end rank latency", **lab)
         else:
             self.requests = reg.counter(
                 "lgbm_fleet_requests_total",
@@ -298,6 +326,19 @@ class _ModelStats:
             self.goodput_g = reg.gauge(
                 "lgbm_fleet_explain_goodput_rows_per_s",
                 "per-model explain SLO gauge: contribution rows answered "
+                "200 per second over the recent window", **lab)
+        elif verb == "rank":
+            self.p99_g = reg.gauge(
+                "lgbm_fleet_rank_p99_ms",
+                "per-model rank SLO gauge: p99 of recent router-side "
+                "rank latencies (ms), failures included", **lab)
+            self.miss_g = reg.gauge(
+                "lgbm_fleet_rank_deadline_miss_ratio",
+                "per-model rank SLO gauge: fraction of recent-window "
+                "rank requests that ended 504", **lab)
+            self.goodput_g = reg.gauge(
+                "lgbm_fleet_rank_goodput_rows_per_s",
+                "per-model rank SLO gauge: query-group rows answered "
                 "200 per second over the recent window", **lab)
         else:
             self.p99_g = reg.gauge(
@@ -1812,6 +1853,12 @@ class FleetRouter:
             name = path[len("/v1/models/"):-len("/explain")]
             if name:
                 return self._forward_predict(name, body, verb="explain")
+        if (method == "POST" and path.startswith("/v1/models/")
+                and path.endswith("/rank") and ":" not in path):
+            # REST-style alias, mirroring the replica's own route
+            name = path[len("/v1/models/"):-len("/rank")]
+            if name:
+                return self._forward_predict(name, body, verb="rank")
         if path.startswith("/v1/models/") and ":" in path and method == "POST":
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
@@ -1819,6 +1866,8 @@ class FleetRouter:
                 return self._forward_predict(name, body)
             if name and verb == "explain":
                 return self._forward_predict(name, body, verb="explain")
+            if name and verb == "rank":
+                return self._forward_predict(name, body, verb="rank")
             if name and verb in ("publish", "rollback"):
                 return self._broadcast(method, path, body, name, verb)
         return 404, {"error": f"no route for {method} {path}"}

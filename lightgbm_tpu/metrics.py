@@ -334,9 +334,34 @@ class NDCGMetric(Metric):
     name = "ndcg"
     is_higher_better = True
 
+    # per-dataset DeviceNDCG evals, keyed by the boundaries array's
+    # identity (one metric instance serves train + every valid set); the
+    # strong reference to the boundaries keeps the id stable
+    _device_cache = None
+
+    def _device_eval(self, raw_score, label, query_info):
+        """Device NDCG (rank/ndcg.py) when the raw scores already live on
+        device — per-iteration ranking eval skips the host round-trip."""
+        from .rank.ndcg import DeviceNDCG
+        if self._device_cache is None:
+            self._device_cache = {}
+        key = id(query_info)
+        entry = self._device_cache.get(key)
+        if entry is None:
+            entry = (DeviceNDCG(label, query_info, self.config.eval_at,
+                                self.config.label_gain), query_info)
+            self._device_cache[key] = entry
+        vals = entry[0](raw_score)
+        return [(f"ndcg@{k}", float(v), True)
+                for k, v in zip(entry[0].ks, vals)]
+
     def eval(self, raw_score, label, weight, objective, query_info=None):
         if query_info is None:
             raise ValueError("ndcg metric requires query information")
+        if (not isinstance(raw_score, np.ndarray)
+                and getattr(self.config, "rank_device_ndcg", True)
+                and type(raw_score).__module__.startswith("jax")):
+            return self._device_eval(raw_score, label, query_info)
         score = _as_np(raw_score)
         y = _as_np(label).astype(np.int64)
         label_gain = np.asarray(self.config.label_gain, dtype=np.float64)

@@ -69,6 +69,32 @@ class ObjectiveFunction:
     def get_gradients(self, score, label, weight):
         raise NotImplementedError
 
+    # -- fused-block seams (boosting/gbdt.py _build_fused_block) --------
+    # Objectives whose gradient math depends on per-run arrays (the
+    # ranking query layout) or per-round randomness (xendcg gammas) hand
+    # them to the fused K-round program as ARGUMENTS through these hooks
+    # — closure-captured arrays would bake into the traced program as HLO
+    # constants, defeating the executable cache and AOT bundle reuse.
+    def fused_const_args(self) -> tuple:
+        """Per-run-constant array pytree appended to the fused block's
+        argument list (default: none)."""
+        return ()
+
+    def fused_round_args(self, iteration: int):
+        """Pytree of per-round arrays for the ``iteration``-th upcoming
+        gradient call, stacked into the fused scan's xs.  Must be a pure
+        function of its argument (precompile peeks without consuming)."""
+        return None
+
+    def fused_advance(self, k: int) -> None:
+        """Consume ``k`` gradient rounds of internal state (stateful
+        RNG streams advance here, AFTER the fused block ran)."""
+
+    def fused_gradients(self, score, label, weight, const_args, round_args):
+        """Gradient entry the fused scan body calls: layout/randomness
+        ride in as traced arguments.  Default ignores them."""
+        return self.get_gradients(score, label, weight)
+
     def boost_from_score(self, label, weight, class_id: int = 0) -> float:
         return 0.0
 

@@ -1,0 +1,16 @@
+"""Learning-to-rank subsystem: query-bucketed layouts and device NDCG.
+
+`bucket` pads the per-query ``[Q, M]`` layout onto a power-of-two ladder
+so ranking objectives train in fixed shapes (fused-block / AOT-bundle
+friendly); `ndcg` evaluates NDCG@k on device over the same layout so
+ranking eval no longer forces a host round-trip.
+"""
+
+from .bucket import (DROP_INDEX, pad_query_layout, query_chunk,
+                     query_count_bucket, query_length_bucket, scatter_index)
+from .ndcg import DeviceNDCG, device_ndcg
+
+__all__ = [
+    "DROP_INDEX", "pad_query_layout", "query_chunk", "query_count_bucket",
+    "query_length_bucket", "scatter_index", "DeviceNDCG", "device_ndcg",
+]

@@ -1,0 +1,98 @@
+"""Power-of-two bucketing of the per-query ``[Q, M]`` ranking layout.
+
+Continuous `extend()` grows the query count every cycle; without
+bucketing each growth step changes the ``[Q, M]`` aval threaded through
+the fused K-round training program, which means a new signature, a
+recompile, and a new AOT bundle entry.  Padding the query count and the
+max query length up to a power-of-two rung keeps the layout shape stable
+within a rung, so `_FUSED_EXEC_CACHE` and bundle signatures keep
+hitting — the same trick `ops.predict.row_bucket` plays for rows.
+
+Bit-identity contract: pad queries and pad columns are all-invalid
+(``valid=False``), their gather index is 0 (an always-in-bounds read
+whose value is masked out of the pairwise math), and their scatter index
+is `DROP_INDEX` — out of bounds for any gradient vector, so
+``.at[idx].add(..., mode='drop')`` discards them.  Every real data row
+appears in exactly one layout slot, so the padded scatter performs
+exactly the same set of adds as the unpadded one and the trained model
+is bit-identical to the host-layout path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DROP_INDEX", "pad_query_layout", "query_chunk",
+           "query_count_bucket", "query_length_bucket", "scatter_index"]
+
+# Out-of-bounds scatter sentinel: int32 max is far beyond any row count,
+# so `.at[DROP_INDEX].add(x, mode='drop')` always discards the slot.
+DROP_INDEX = np.iinfo(np.int32).max
+
+# Ladder floors: query counts below 8 and query lengths below 4 share the
+# bottom rung, bounding the enumerated shape set from below as well.
+_QUERY_FLOOR = 8
+_LENGTH_FLOOR = 4
+
+
+def _pow2_bucket(n: int, floor: int) -> int:
+    n = max(int(n), 1)
+    b = int(floor)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def query_count_bucket(num_queries: int) -> int:
+    """Smallest power-of-two rung >= num_queries (floor 8)."""
+    return _pow2_bucket(num_queries, _QUERY_FLOOR)
+
+
+def query_length_bucket(max_query_len: int) -> int:
+    """Smallest power-of-two rung >= max_query_len (floor 4)."""
+    return _pow2_bucket(max_query_len, _LENGTH_FLOOR)
+
+
+def pad_query_layout(idx: np.ndarray, valid: np.ndarray,
+                     pad_queries: bool = True):
+    """Pad a ``make_query_layout`` output ``[Q, M]`` up to ``[Qb, Mb]``.
+
+    The LENGTH axis is always bucketed: XLA's reduction over the
+    pairwise ``[M, M]`` lambda sums associates differently for different
+    M, so bit-identity across layouts requires every layout of the same
+    data to reduce over the same rung.  ``pad_queries=False`` skips only
+    the query-COUNT axis (the unbucketed baseline layout) — per-query
+    math is independent of Q, so the two variants stay bit-identical.
+
+    Pad slots get gather index 0 and ``valid=False``; callers derive the
+    scatter index (with `DROP_INDEX` in invalid slots) via
+    `scatter_index`."""
+    q, m = idx.shape
+    qb = query_count_bucket(q) if pad_queries else q
+    mb = query_length_bucket(m)
+    if (qb, mb) == (q, m):
+        return np.ascontiguousarray(idx, np.int32), valid.astype(bool)
+    out_idx = np.zeros((qb, mb), np.int32)
+    out_valid = np.zeros((qb, mb), bool)
+    out_idx[:q, :m] = idx
+    out_valid[:q, :m] = valid
+    return out_idx, out_valid
+
+
+def scatter_index(idx: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """Gradient scatter index: real slots keep their row, invalid slots
+    go out of bounds so ``mode='drop'`` discards them (no +0.0 adds that
+    could differ between the padded and unpadded layouts)."""
+    return np.where(valid, idx, DROP_INDEX).astype(np.int32)
+
+
+def query_chunk(num_queries: int, max_query_len: int,
+                target_elems: int = 1 << 24) -> int:
+    """lax.map chunk size bounding the ``[C, M, M]`` pairwise buffers.
+
+    Always a power of two, so it divides a bucketed query count exactly
+    and the chunked reshape needs no extra padding."""
+    m = max(int(max_query_len), 1)
+    c = max(int(target_elems) // (m * m), 1)
+    c = 1 << (c.bit_length() - 1)          # floor to a power of two
+    return max(1, min(int(num_queries), c))

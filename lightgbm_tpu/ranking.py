@@ -9,6 +9,15 @@ one vmapped dense ``[M, M]`` masked pass per query — MXU/VPU-friendly, no
 ragged control flow.  Queries are processed in fixed-size chunks via
 ``lax.map`` to bound the O(M^2) intermediate memory.
 
+The layout is bucketed onto a power-of-two query-count/query-length
+ladder (`rank.bucket`) so a growing dataset keeps hitting the same
+compiled program, and every layout array rides through the gradient
+entry points as an ARGUMENT — never a closure constant — so the fused
+K-round training block and AOT bundles stay layout-polymorphic (the
+fused hooks on `ObjectiveFunction` carry them in).  Pad slots scatter to
+an out-of-bounds index and are dropped, which keeps the bucketed path
+bit-identical to the unpadded host layout.
+
 Behavioral parity notes (vs rank_objective.hpp):
 - sigmoid table (:252 ConstructSigmoidTable) is unnecessary — the VPU
   evaluates the exact sigmoid; the table is a CPU-only trick.
@@ -29,12 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .objectives import ObjectiveFunction
+from .rank.bucket import pad_query_layout, query_chunk, scatter_index
 
 __all__ = ["LambdarankNDCG", "RankXENDCG", "make_query_layout"]
 
 _K_EPS = 1e-15
-# process queries in chunks to bound the [CHUNK, M, M] pairwise intermediate
-_TARGET_CHUNK_ELEMS = 1 << 24  # ~16M f32 elements ≈ 64 MB
 
 
 def make_query_layout(query_boundaries: np.ndarray):
@@ -50,9 +58,44 @@ def make_query_layout(query_boundaries: np.ndarray):
     return np.where(valid, idx, 0).astype(np.int32), valid
 
 
+def _chunk_queries(arr, chunk):
+    """Reshape the query axis to [num_chunks, chunk, ...] for lax.map."""
+    q = arr.shape[0]
+    rem = (-q) % chunk
+    if rem:
+        pad_width = ((0, rem),) + ((0, 0),) * (arr.ndim - 1)
+        arr = jnp.pad(arr, pad_width)
+    return arr.reshape((-1, chunk) + arr.shape[1:])
+
+
+def _scatter_grads(lam_pad, hess_pad, scatter_idx, out_len, weight):
+    """Scatter padded per-query gradients back to row order.
+
+    Invalid slots carry an out-of-bounds index (`rank.bucket.DROP_INDEX`)
+    and are dropped, so the padded and unpadded layouts perform exactly
+    the same set of adds — each real row exactly once."""
+    flat_idx = scatter_idx.reshape(-1)
+    lam = jnp.zeros((out_len,), lam_pad.dtype).at[flat_idx].add(
+        lam_pad.reshape(-1), mode="drop")
+    hess = jnp.zeros((out_len,), hess_pad.dtype).at[flat_idx].add(
+        hess_pad.reshape(-1), mode="drop")
+    if weight is not None:
+        # reference RankingObjective::GetGradients weights both terms
+        lam = lam * weight
+        hess = hess * weight
+    return lam, hess
+
+
 class _RankingBase(ObjectiveFunction):
     """Shared query layout plumbing (reference RankingObjective,
     rank_objective.hpp:25)."""
+
+    is_ranking = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._query_buckets = bool(getattr(config, "rank_query_buckets",
+                                           True))
 
     def init(self, metadata, num_data):
         if metadata.query_boundaries is None:
@@ -62,47 +105,29 @@ class _RankingBase(ObjectiveFunction):
                 "RankingObjective::Init raises the same")
         qb = np.asarray(metadata.query_boundaries)
         self.num_queries = len(qb) - 1
-        pad_idx, pad_valid = make_query_layout(qb)
-        self.pad_idx = jnp.asarray(pad_idx)
-        self.pad_valid = jnp.asarray(pad_valid)
-        self.max_query_len = pad_idx.shape[1]
+        idx, valid = make_query_layout(qb)
+        # the length axis always sits on the ladder (pairwise reductions
+        # must associate identically across layouts of the same data);
+        # rank_query_buckets additionally pads the query-count axis
+        idx, valid = pad_query_layout(idx, valid,
+                                      pad_queries=self._query_buckets)
+        self.max_query_len = idx.shape[1]
+        self.pad_idx = jnp.asarray(idx)
+        self.pad_valid = jnp.asarray(valid)
+        self.scatter_idx = jnp.asarray(scatter_index(idx, valid))
         label = np.asarray(metadata.label)
         if label.min() < 0:
             raise ValueError("ranking labels must be non-negative integers")
         self._label_np = label
         self.labels_pad = jnp.asarray(
-            np.where(pad_valid, label[pad_idx], 0.0).astype(np.float32))
+            np.where(valid, label[idx], 0.0).astype(np.float32))
         self.num_data = num_data
-        # chunk size bounding [C, M, M] pairwise buffers
-        m = max(self.max_query_len, 1)
-        self.chunk = max(1, min(self.num_queries,
-                                _TARGET_CHUNK_ELEMS // (m * m)))
-
-    def _scatter_back(self, lam_pad, hess_pad, weight):
-        n = self.num_data
-        flat_idx = self.pad_idx.reshape(-1)
-        vmask = self.pad_valid.reshape(-1)
-        lam = jnp.zeros((n,), lam_pad.dtype).at[flat_idx].add(
-            jnp.where(vmask, lam_pad.reshape(-1), 0.0))
-        hess = jnp.zeros((n,), hess_pad.dtype).at[flat_idx].add(
-            jnp.where(vmask, hess_pad.reshape(-1), 0.0))
-        if weight is not None:
-            # reference RankingObjective::GetGradients weights both terms
-            lam = lam * weight
-            hess = hess * weight
-        return lam, hess
+        # chunk size bounding [C, M, M] pairwise buffers; a power of two,
+        # so a bucketed query count chunks with zero extra padding
+        self.chunk = query_chunk(idx.shape[0], self.max_query_len)
 
     def boost_from_score(self, label, weight, class_id=0):
         return 0.0
-
-    def _pad_queries(self, arr_pad):
-        """Pad Q up to a multiple of the chunk size for lax.map."""
-        q = arr_pad.shape[0]
-        rem = (-q) % self.chunk
-        if rem:
-            pad_width = ((0, rem),) + ((0, 0),) * (arr_pad.ndim - 1)
-            arr_pad = jnp.pad(arr_pad, pad_width)
-        return arr_pad.reshape((-1, self.chunk) + arr_pad.shape[1:])
 
 
 @functools.partial(jax.jit, static_argnames=("sigmoid", "trunc", "norm"))
@@ -155,6 +180,29 @@ def _lambdarank_pad(scores, labels, valid, inv_max_dcg, gains, sigmoid,
     return jax.vmap(one_query)(scores, labels, valid, inv_max_dcg, gains)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("sigmoid", "trunc", "norm", "chunk"))
+def _lambdarank_grads(score, weight, pad_idx, scatter_idx, valid, labels,
+                      inv_max_dcg, gains, sigmoid, trunc, norm, chunk):
+    """Full lambdarank gradient pass: gather -> chunked pairwise lambdas
+    -> drop-scatter.  Every layout array is an argument, so the traced
+    program is layout-polymorphic (no closure constants)."""
+    q, m = pad_idx.shape
+    s_pad = score[pad_idx]
+    chunked = tuple(_chunk_queries(a, chunk)
+                    for a in (s_pad, labels, valid, inv_max_dcg, gains))
+
+    def chunk_fn(args):
+        s, lab, v, imd, g = args
+        return _lambdarank_pad(s, lab, v, imd, g, sigmoid, trunc, norm)
+
+    lam_c, hess_c = jax.lax.map(chunk_fn, chunked)
+    lam_pad = lam_c.reshape(-1, m)[:q]
+    hess_pad = hess_c.reshape(-1, m)[:q]
+    return _scatter_grads(lam_pad, hess_pad, scatter_idx, score.shape[0],
+                          weight)
+
+
 class LambdarankNDCG(_RankingBase):
     """Pairwise NDCG-weighted lambdas (reference LambdarankNDCG,
     rank_objective.hpp:98)."""
@@ -186,33 +234,29 @@ class LambdarankNDCG(_RankingBase):
                          [self.trunc], discounts)[0]
         with np.errstate(divide="ignore"):
             inv = np.where(md > 0, 1.0 / md, 0.0)
+        # pad the per-query inverse max DCG out to the bucketed query
+        # count (pad queries are fully masked; 0 keeps their math finite)
+        q_layout = self.pad_idx.shape[0]
+        if len(inv) < q_layout:
+            inv = np.concatenate([inv, np.zeros(q_layout - len(inv))])
         self.inv_max_dcg = jnp.asarray(inv.astype(np.float32))
         gains_np = self.label_gain[
             np.asarray(self.labels_pad).astype(np.int64)]
         self.gains_pad = jnp.asarray(gains_np.astype(np.float32))
 
+    def fused_const_args(self):
+        return (self.pad_idx, self.scatter_idx, self.pad_valid,
+                self.labels_pad, self.inv_max_dcg, self.gains_pad)
+
+    def fused_gradients(self, score, label, weight, const_args, round_args):
+        pad_idx, scatter_idx, valid, labels, imd, gains = const_args
+        return _lambdarank_grads(score, weight, pad_idx, scatter_idx, valid,
+                                 labels, imd, gains, self.sigmoid,
+                                 self.trunc, self.norm, self.chunk)
+
     def get_gradients(self, score, label, weight):
-        s_pad = score[self.pad_idx]
-        q = self.num_queries
-
-        if not hasattr(self, "_chunked_static"):
-            # iteration-invariant inputs, chunked once
-            self._chunked_static = (self._pad_queries(self.labels_pad),
-                                    self._pad_queries(self.pad_valid),
-                                    self._pad_queries(self.inv_max_dcg),
-                                    self._pad_queries(self.gains_pad))
-        sc = self._pad_queries(s_pad)
-        lc, vc, ic, gc = self._chunked_static
-
-        def chunk_fn(args):
-            s, lab, v, imd, g = args
-            return _lambdarank_pad(s, lab, v, imd, g, self.sigmoid,
-                                   self.trunc, self.norm)
-
-        lam_c, hess_c = jax.lax.map(chunk_fn, (sc, lc, vc, ic, gc))
-        lam_pad = lam_c.reshape(-1, self.max_query_len)[:q]
-        hess_pad = hess_c.reshape(-1, self.max_query_len)[:q]
-        return self._scatter_back(lam_pad, hess_pad, weight)
+        return self.fused_gradients(score, label, weight,
+                                    self.fused_const_args(), None)
 
     def to_string(self):
         return "lambdarank"
@@ -245,6 +289,27 @@ def _xendcg_pad(scores, labels, valid, gammas):
     return jax.vmap(one_query)(scores, labels, valid, gammas)
 
 
+def _per_item_uniform(key, pad_idx):
+    """Uniform gamma per layout slot keyed by GLOBAL row index, so each
+    real item's draw is independent of the [Q, M] bucket shape (raw
+    ``uniform(key, shape)`` is not prefix-stable across shapes)."""
+    flat = pad_idx.reshape(-1)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(flat)
+    draws = jax.vmap(lambda k: jax.random.uniform(k, (), jnp.float32))(keys)
+    return draws.reshape(pad_idx.shape)
+
+
+@jax.jit
+def _xendcg_grads(score, weight, pad_idx, scatter_idx, valid, labels, key):
+    """Full rank_xendcg gradient pass with layout and the per-round RNG
+    key as arguments (fused-block friendly)."""
+    s_pad = score[pad_idx]
+    gammas = _per_item_uniform(key, pad_idx)
+    lam_pad, hess_pad = _xendcg_pad(s_pad, labels, valid, gammas)
+    return _scatter_grads(lam_pad, hess_pad, scatter_idx, score.shape[0],
+                          weight)
+
+
 class RankXENDCG(_RankingBase):
     """Listwise cross-entropy NDCG surrogate (reference RankXENDCG,
     rank_objective.hpp:285; arXiv:1911.09798)."""
@@ -255,17 +320,33 @@ class RankXENDCG(_RankingBase):
         self.seed = int(config.objective_seed)
         self._call_count = 0
 
-    def get_gradients(self, score, label, weight):
-        s_pad = score[self.pad_idx]
+    def _round_key(self, offset):
         # fresh per-item gammas each iteration (reference draws from one
         # persistent RNG stream per query)
-        key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
-                                 self._call_count)
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                  self._call_count + offset)
+
+    def fused_const_args(self):
+        return (self.pad_idx, self.scatter_idx, self.pad_valid,
+                self.labels_pad)
+
+    def fused_round_args(self, iteration):
+        return self._round_key(iteration)
+
+    def fused_advance(self, k):
+        self._call_count += k
+
+    def fused_gradients(self, score, label, weight, const_args, round_args):
+        pad_idx, scatter_idx, valid, labels = const_args
+        return _xendcg_grads(score, weight, pad_idx, scatter_idx, valid,
+                             labels, round_args)
+
+    def get_gradients(self, score, label, weight):
+        grads = self.fused_gradients(score, label, weight,
+                                     self.fused_const_args(),
+                                     self._round_key(0))
         self._call_count += 1
-        gammas = jax.random.uniform(key, s_pad.shape, s_pad.dtype)
-        lam_pad, hess_pad = _xendcg_pad(s_pad, self.labels_pad,
-                                        self.pad_valid, gammas)
-        return self._scatter_back(lam_pad, hess_pad, weight)
+        return grads
 
     def to_string(self):
         return "rank_xendcg"

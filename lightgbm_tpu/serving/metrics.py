@@ -30,7 +30,7 @@ from ..telemetry.registry import MetricsRegistry
 from ..timer import global_timer, timers_enabled
 
 __all__ = ["ExplainMetrics", "LatencyWindow", "ModelMetrics",
-           "ServingMetrics"]
+           "RankMetrics", "ServingMetrics"]
 
 _PCTS = (50.0, 95.0, 99.0)
 
@@ -203,6 +203,19 @@ class ModelMetrics:
             "lgbm_serving_exit_fraction",
             "last cascade flush's early-exited rows over its total rows",
             **lab)
+        # cascade controller state, set at publish (the only time the
+        # rung may move) and refreshed at metrics render: the rung a
+        # flush will actually dispatch, and the exit-fraction EMA the
+        # adaptive controller steps on — together they answer "why did
+        # the prefix move" from the dashboard alone
+        self._cascade_rung = reg.gauge(
+            "lgbm_serving_cascade_prefix_rung",
+            "prefix iterations the cascade warmed and dispatches for "
+            "this model (0 = cascade off or nothing published)", **lab)
+        self._cascade_ema = reg.gauge(
+            "lgbm_serving_cascade_exit_ema",
+            "adaptive cascade controller's exit-fraction EMA (0 until "
+            "the first band flush is observed)", **lab)
         self._programs_cached = reg.gauge(
             "lgbm_serving_programs_cached",
             "executables resident in this model's predictor cache", **lab)
@@ -391,6 +404,15 @@ class ModelMetrics:
         """One request served prefix-only with degraded=true."""
         self._degraded.inc()
 
+    def record_cascade_state(self, rung: Optional[int] = None,
+                             ema: Optional[float] = None) -> None:
+        """Publish-time (rung) / render-time (ema) cascade gauges; None
+        leaves the other gauge untouched."""
+        if rung is not None:
+            self._cascade_rung.set(int(rung))
+        if ema is not None:
+            self._cascade_ema.set(float(ema))
+
     def set_programs_cached(self, count: int) -> None:
         self._programs_cached.set(int(count))
 
@@ -443,6 +465,8 @@ class ModelMetrics:
             "early_exits": self.early_exits,
             "degraded": self.degraded,
             "exit_fraction": round(float(self._exit_fraction.value), 4),
+            "cascade_prefix_rung": int(self._cascade_rung.value),
+            "cascade_exit_ema": round(float(self._cascade_ema.value), 4),
             "programs_cached": int(self._programs_cached.value),
             "queue_wait_p50_ms": round(
                 self.queue_wait.percentiles()["p50_ms"], 3),
@@ -608,6 +632,159 @@ class ExplainMetrics:
         return out
 
 
+class RankMetrics:
+    """Observables for one model's RANK lane (``:rank`` query scoring).
+
+    A rank request is a whole query group — scores plus a per-query
+    sorted order — so its unit economics differ from predict (rows per
+    request follow query length, not client batching) and its latency
+    evidence must stay out of the predict SLO class the router and
+    autoscaler act on.  Same batcher-facing interface as ExplainMetrics,
+    plus a queries counter: queue depth in ROWS meters device load, but
+    the serving contract is per-QUERY."""
+
+    def __init__(self, name: str = "default",
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+        self.name = name
+        lab = {"model": name}
+        self._requests = reg.counter(
+            "lgbm_serving_rank_requests_total",
+            "user-facing rank (query scoring) requests", **lab)
+        self._rows = reg.counter(
+            "lgbm_serving_rank_rows_total",
+            "rows across rank requests", **lab)
+        self._queries = reg.counter(
+            "lgbm_serving_rank_queries_total",
+            "query groups scored across rank requests", **lab)
+        self._errors = reg.counter(
+            "lgbm_serving_rank_errors_total",
+            "failed rank requests", **lab)
+        self._batches = reg.counter(
+            "lgbm_serving_rank_batches_total",
+            "coalesced rank device flushes", **lab)
+        self._queue_rejections = reg.counter(
+            "lgbm_serving_rank_queue_rejections_total",
+            "rank requests rejected by queue backpressure", **lab)
+        self._deadline_refused = reg.counter(
+            "lgbm_serving_rank_deadline_refused_total",
+            "rank requests refused 504 because their deadline budget "
+            "could not cover the queue", **lab)
+        self._queue_depth = reg.gauge(
+            "lgbm_serving_rank_queue_depth",
+            "rows waiting in the rank micro-batch queue", **lab)
+        self._inflight_rows = reg.gauge(
+            "lgbm_serving_rank_inflight_rows",
+            "real rows in the rank batch currently executing on the "
+            "device (0 when idle)", **lab)
+        self._batch_fill = reg.gauge(
+            "lgbm_serving_rank_batch_fill",
+            "last rank flush's real rows over its padded bucket", **lab)
+        self._queue_wait_hist = reg.histogram(
+            "lgbm_serving_rank_queue_wait_ms",
+            "milliseconds a rank request spent queued before its batch "
+            "launched",
+            buckets=(0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000,
+                     2000, 5000), **lab)
+        self._latency_hist = reg.histogram(
+            "lgbm_serving_rank_request_latency_seconds",
+            "user-facing rank request latency", **lab)
+        self.latency = LatencyWindow()
+        self.queue_wait = LatencyWindow(512, window_s=30.0)
+        self._queue_wait_cache = (-1e18, 0.0)
+        self.last_active_s = 0.0
+
+    # -- batcher-facing interface (mirrors ExplainMetrics) -------------
+    def record_request(self, rows: int, latency_s: Optional[float] = None,
+                       error: bool = False,
+                       deadline_miss: bool = False) -> None:
+        self._requests.inc()
+        self._rows.inc(int(rows))
+        self.last_active_s = time.time()
+        if error:
+            self._errors.inc()
+        if latency_s is not None:
+            self.latency.observe(latency_s)
+            self._latency_hist.observe(latency_s)
+
+    def record_queries(self, n: int) -> None:
+        """Query groups served by one successful rank request."""
+        self._queries.inc(int(n))
+
+    def record_device(self, rows: int) -> None:
+        # the predictor's own device counters belong to the MODEL
+        # metrics; the rank lane only tracks its own flushes
+        pass
+
+    def record_batch(self, n_requests: int, n_rows: int,
+                     device_s: float, fill: Optional[float] = None) -> None:
+        self._batches.inc()
+        if fill is not None:
+            self._batch_fill.set(float(fill))
+        if timers_enabled():
+            global_timer.add("serving::rank_batch", device_s)
+
+    def record_queue(self, depth: int) -> None:
+        self._queue_depth.set(int(depth))
+
+    def record_queue_wait(self, seconds: float) -> None:
+        self.queue_wait.observe(seconds)
+        self._queue_wait_hist.observe(float(seconds) * 1e3)
+
+    def queue_wait_estimate_s(self) -> float:
+        now = time.monotonic()
+        t, v = self._queue_wait_cache
+        if now - t < 0.05:
+            return v
+        v = self.queue_wait.percentiles()["p50_ms"] / 1e3
+        self._queue_wait_cache = (now, v)
+        return v
+
+    def record_deadline_refusal(self, counted_request: bool = False) -> None:
+        self._deadline_refused.inc()
+
+    def record_inflight(self, rows: int) -> None:
+        self._inflight_rows.set(int(rows))
+
+    def record_rejection(self) -> None:
+        self._queue_rejections.inc()
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
+
+    @property
+    def deadline_refused(self) -> int:
+        return int(self._deadline_refused.value)
+
+    def snapshot(self) -> Dict:
+        out = {
+            "requests": self.requests,
+            "rows": int(self._rows.value),
+            "queries": int(self._queries.value),
+            "errors": self.errors,
+            "batches": int(self._batches.value),
+            "queue_depth": self.queue_depth,
+            "queue_rejections": int(self._queue_rejections.value),
+            "deadline_refused": self.deadline_refused,
+            "inflight_rows": int(self._inflight_rows.value),
+            "batch_fill": round(float(self._batch_fill.value), 4),
+            "queue_wait_p50_ms": round(
+                self.queue_wait.percentiles()["p50_ms"], 3),
+        }
+        out.update(self.latency.percentiles())
+        return out
+
+
 class ServingMetrics:
     """name -> ModelMetrics, created on first touch; all models share this
     instance's MetricsRegistry (the Prometheus exporter's source)."""
@@ -616,6 +793,7 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._models: Dict[str, ModelMetrics] = {}
         self._explain: Dict[str, ExplainMetrics] = {}
+        self._rank: Dict[str, RankMetrics] = {}
         self.registry = registry if registry is not None else MetricsRegistry()
         # construction wall time, exported in fleet_gauges: the router's
         # publish-replay logic uses a CHANGED boot_s as its restart
@@ -640,6 +818,15 @@ class ServingMetrics:
                 m = self._explain[name] = ExplainMetrics(name, self.registry)
             return m
 
+    def rank(self, name: str) -> RankMetrics:
+        """The rank-lane instruments for `name`, minted on first touch
+        like model() and explain()."""
+        with self._lock:
+            m = self._rank.get(name)
+            if m is None:
+                m = self._rank[name] = RankMetrics(name, self.registry)
+            return m
+
     def refresh_slo_gauges(self) -> None:
         """Refresh every model's derived SLO gauges (p99 / deadline-miss
         ratio / goodput) — the Prometheus route calls this so scrapes
@@ -654,11 +841,14 @@ class ServingMetrics:
         with self._lock:
             names = list(self._models.items())
             explain = list(self._explain.items())
+            rank = list(self._rank.items())
         out = {name: m.snapshot(compile_counts.get(name))
                for name, m in names}
         for name, m in explain:
             # additive key, so the per-model dict shape stays intact
             out[f"{name}:explain"] = m.snapshot()
+        for name, m in rank:
+            out[f"{name}:rank"] = m.snapshot()
         return out
 
     def fleet_gauges(self) -> Dict:
@@ -675,16 +865,17 @@ class ServingMetrics:
         scrapes) — reads have no side effects."""
         with self._lock:
             models = list(self._models.items())
-            explain = list(self._explain.values())
+            explain = (list(self._explain.values())
+                       + list(self._rank.values()))
         out = {"queue_rows": 0, "inflight_rows": 0, "p99_ms": 0.0,
                "batch_fill": 0.0, "queue_wait_ms": 0.0, "requests": 0,
                "errors": 0, "queue_rejections": 0, "boot_s": self.boot_s}
         now = time.time()
         for m in explain:
-            # explain lanes share the process's device: their queued and
-            # in-flight rows are real load on this replica, so the
-            # capacity sums see them; their latency evidence stays OUT of
-            # p99/fill — the fleet SLO is the predict SLO class
+            # explain and rank lanes share the process's device: their
+            # queued and in-flight rows are real load on this replica, so
+            # the capacity sums see them; their latency evidence stays OUT
+            # of p99/fill — the fleet SLO is the predict SLO class
             out["queue_rows"] += m.queue_depth
             out["inflight_rows"] += int(m._inflight_rows.value)
         for name, m in models:

@@ -189,6 +189,16 @@ class ModelRegistry:
                          resolve_prefix_iterations(e - s,
                                                    casc.prefix_trees))
                     predictor.warmup(kinds=("raw",), num_iteration=k)
+                    if self._metrics is not None:
+                        # publish is the only time the rung moves, so
+                        # this set point IS the rung every flush until
+                        # the next publish dispatches on; the EMA rides
+                        # along so the dashboard sees the evidence the
+                        # controller stepped on
+                        ctl = getattr(casc, "controller", None)
+                        ema = None if ctl is None else ctl.ema
+                        self._metrics.model(name).record_cascade_state(
+                            rung=k, ema=ema)
         with self._lock:
             model = self._models.get(name)
             if model is None:

@@ -26,6 +26,10 @@ Routes (JSON bodies):
 - ``POST /v1/models/<name>:predict``    {"rows": [[...]...],
                                          "start_iteration"?, "num_iteration"?,
                                          "raw_score"?, "version"?}
+- ``POST /v1/models/<name>:rank``       {"rows": [[...]...], "group"?,
+                                         "top_k"?, "deadline_ms"?} — raw
+                                        scores + per-query best-first row
+                                        order (``/rank`` REST alias too)
 
 Default-parameter predicts are coalesced per model by a MicroBatcher whose
 "predictor" is the registry dispatch itself — each flush resolves the
@@ -68,7 +72,7 @@ class _RegistryDispatch:
 
     def __init__(self, registry: ModelRegistry, name: str,
                  cascade: Optional[CascadeConfig] = None, metrics=None,
-                 pred_contrib: bool = False):
+                 pred_contrib: bool = False, raw_score: bool = False):
         self._registry = registry
         self._name = name
         self._cascade = cascade
@@ -76,6 +80,12 @@ class _RegistryDispatch:
         # explain-lane dispatch: flushes run the kind="contrib" program
         # (SHAP layout, never cascaded — there is no prefix bound on phi)
         self._pred_contrib = bool(pred_contrib)
+        # rank-lane dispatch: flushes run the RAW program (the scores a
+        # query order is computed from are the model's raw margins — the
+        # same values the training-side NDCG gate scored — and never
+        # cascaded: a per-row early exit could reorder rows WITHIN one
+        # query, which breaks the whole-query serving contract)
+        self._raw_score = bool(raw_score)
         # advisory width + bucket ladder for the server's pre-coalesce
         # check and the batcher's fill gauge, refreshed at every flush so
         # the hot path never takes the registry lock just to read them;
@@ -91,6 +101,8 @@ class _RegistryDispatch:
             self.buckets = pred.buckets
             if self._pred_contrib:
                 return pred.predict(X, pred_contrib=True), version
+            if self._raw_score:
+                return pred.predict(X, raw_score=True), version
             casc = self._cascade
             # the band cascade only pays when rows can actually exit
             # (epsilon > 0); epsilon<=0 would run prefix + completion on
@@ -130,7 +142,11 @@ class ServingApp:
                  explain_max_batch: int = 256,
                  explain_max_wait_ms: float = 4.0,
                  explain_default_deadline_ms: float = 0.0,
-                 explain_warmup: bool = False):
+                 explain_warmup: bool = False,
+                 rank_max_batch: int = 512,
+                 rank_max_wait_ms: float = 2.0,
+                 rank_default_deadline_ms: float = 0.0,
+                 rank_top_k: int = 0):
         self.metrics = metrics or ServingMetrics()
         # early-exit cascade (serving/cascade.py): band mode exits
         # confident rows after the forest prefix inside coalesced
@@ -169,6 +185,17 @@ class ServingApp:
                                  max_queue_rows=max_queue_rows,
                                  continuous=continuous)
         self._explain_batchers: Dict[str, MicroBatcher] = {}
+        # the rank lane's OWN SLO class: a :rank request is a whole
+        # query group whose rows must come back together, so it rides
+        # its own batcher (row-bucket ladder, raw-score programs) and
+        # never queues behind — or ahead of — per-row predicts
+        self.rank_default_deadline_ms = float(rank_default_deadline_ms)
+        self.rank_top_k = int(rank_top_k)
+        self._rank_cfg = dict(max_batch=rank_max_batch,
+                              max_wait_ms=rank_max_wait_ms,
+                              max_queue_rows=max_queue_rows,
+                              continuous=continuous)
+        self._rank_batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._closed = False
         # admitted predict-request counter, feeding env-driven fault
@@ -218,6 +245,21 @@ class ServingApp:
                     metrics=self.metrics.explain(name), **self._explain_cfg)
             return b
 
+    def _rank_batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("ServingApp is closed")
+            b = self._rank_batchers.get(name)
+            if b is None:
+                # same 404-before-allocation invariant as _batcher; each
+                # request's rows stay one contiguous slice of the flush,
+                # so its queries are never split across device calls
+                b = self._rank_batchers[name] = MicroBatcher(
+                    _RegistryDispatch(self.registry, name,
+                                      raw_score=True),
+                    metrics=self.metrics.rank(name), **self._rank_cfg)
+            return b
+
     def close(self) -> None:
         """Stop admitting requests, then DRAIN: every request already
         admitted (queued or in flight in some batcher) resolves its
@@ -229,9 +271,12 @@ class ServingApp:
             batchers, self._batchers = dict(self._batchers), {}
             explain, self._explain_batchers = \
                 dict(self._explain_batchers), {}
+            rank, self._rank_batchers = dict(self._rank_batchers), {}
         for b in batchers.values():
             b.close()
         for b in explain.values():
+            b.close()
+        for b in rank.values():
             b.close()
 
     # ------------------------------------------------------------------
@@ -290,6 +335,7 @@ class ServingApp:
         if method == "GET" and path == "/v1/models":
             return 200, {"models": self.registry.models()}
         if method == "GET" and path == "/v1/metrics":
+            self._refresh_cascade_gauges()
             return 200, self.metrics.snapshot(self.registry.compile_counts())
         if method == "GET" and path == "/v1/metrics/prometheus":
             return 200, self._prometheus()
@@ -308,6 +354,12 @@ class ServingApp:
             name = path[len("/v1/models/"):-len("/explain")]
             if name:
                 return self._explain(name, body)
+        if (method == "POST" and path.startswith("/v1/models/")
+                and path.endswith("/rank") and ":" not in path):
+            # REST-style alias for the rank verb
+            name = path[len("/v1/models/"):-len("/rank")]
+            if name:
+                return self._rank(name, body)
         if path.startswith("/v1/models/") and ":" in path:
             rest = path[len("/v1/models/"):]
             name, _, verb = rest.rpartition(":")
@@ -316,6 +368,8 @@ class ServingApp:
                     return self._predict(name, body)
                 if verb == "explain":
                     return self._explain(name, body)
+                if verb == "rank":
+                    return self._rank(name, body)
                 if verb == "publish":
                     return self._publish(name, body)
                 if verb == "rollback":
@@ -343,6 +397,16 @@ class ServingApp:
         }
 
     # ------------------------------------------------------------------
+    def _refresh_cascade_gauges(self) -> None:
+        """Bring the per-model cascade EMA gauge current at render time:
+        the controller's EMA moves with every band flush, but the gauge
+        is otherwise only written at publish."""
+        ctl = self.cascade.controller
+        if ctl is None or ctl.ema is None:
+            return
+        for name in self.registry.models():
+            self.metrics.model(name).record_cascade_state(ema=ctl.ema)
+
     def _prometheus(self) -> str:
         """Prometheus text dump: this app's serving registry plus the
         process-wide telemetry registry (training stats when colocated).
@@ -354,6 +418,7 @@ class ServingApp:
         # derived per-model SLO gauges (p99 / deadline-miss ratio /
         # goodput) recomputed at scrape time
         self.metrics.refresh_slo_gauges()
+        self._refresh_cascade_gauges()
         return prometheus_text(self.metrics.registry, REGISTRY)
 
     def _publish(self, name: str, body: dict) -> Tuple[int, dict]:
@@ -513,6 +578,153 @@ class ServingApp:
                 rows.shape[0], latency_s=time.perf_counter() - t0)
         return 200, {"name": name, "version": served_version,
                      "contributions": np.asarray(out).tolist()}
+
+    def _rank(self, name: str, body: dict) -> Tuple[int, dict]:
+        """Trace wrapper around the rank path (same outcome mapping
+        discipline as _predict, its own span name)."""
+        ctx = body.get(_trace.BODY_KEY)
+        span = self.tracer.start_request(
+            "replica.rank", ctx=ctx if isinstance(ctx, dict) else None,
+            model=name)
+        if span is None:
+            return self._rank_inner(name, body, None)
+        try:
+            with _trace.activate(span):
+                status, payload = self._rank_inner(name, body, span)
+        except QueueFullError:
+            span.finish_request(status=429)
+            raise
+        except DeadlineExceededError:
+            span.finish_request(status=504)
+            raise
+        except ServingClosedError:
+            span.finish_request(status=503)
+            raise
+        except LightGBMError as exc:
+            span.finish_request(
+                status=404 if "no model published" in str(exc) else 400,
+                error=str(exc))
+            raise
+        except (KeyError, ValueError, TypeError, OSError) as exc:
+            span.finish_request(status=400, error=f"{type(exc).__name__}")
+            raise
+        except Exception as exc:
+            span.finish_request(status=500, error=repr(exc))
+            raise
+        if isinstance(payload, dict):
+            span.set(version=payload.get("version"))
+            payload.setdefault("trace_id", span.trace_id)
+        span.finish_request(status=status)
+        return status, payload
+
+    @staticmethod
+    def _rank_groups(body: dict, n_rows: int) -> np.ndarray:
+        """Validated per-query sizes for a rank body: ``group`` must be
+        positive integers summing to the row count; absent means the
+        whole request is one query."""
+        group = body.get("group")
+        if group is None:
+            return np.asarray([n_rows], np.int64)
+        g = np.asarray(group, np.int64)
+        if g.ndim != 1 or len(g) == 0 or (g <= 0).any():
+            raise ValueError(
+                "group must be a non-empty list of positive per-query "
+                "row counts")
+        if int(g.sum()) != n_rows:
+            raise ValueError(
+                f"group sizes sum to {int(g.sum())} but the request has "
+                f"{n_rows} rows — a rank request must score whole "
+                "queries")
+        return g
+
+    def _rank_inner(self, name: str, body: dict,
+                    span) -> Tuple[int, dict]:
+        """Query-group scoring as a served verb: raw scores for every
+        row plus each query's rows sorted best-first (optionally
+        truncated to top_k), coalesced on the model's RANK lane.  The
+        request is the query group — its rows ride the flush as one
+        contiguous slice, so queries are never split across device
+        calls."""
+        self._fault_latch.maybe_inject(next(self._served))
+        rows = np.asarray(body["rows"], dtype=np.float64)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
+        g = self._rank_groups(body, rows.shape[0])
+        top_k = int(body.get("top_k", self.rank_top_k))
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        t0 = time.perf_counter()
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None and self.rank_default_deadline_ms > 0:
+            deadline_ms = self.rank_default_deadline_ms
+        deadline_t = None
+        if deadline_ms is not None:
+            deadline_t = t0 + float(deadline_ms) / 1e3
+            if float(deadline_ms) <= 0:
+                self.registry.current_version(name)   # 404 before metrics
+                self.metrics.rank(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget already spent "
+                    f"({float(deadline_ms):g}ms)")
+        kwargs = {}
+        for key in ("start_iteration", "num_iteration"):
+            if key in body:
+                kwargs[key] = int(body[key])
+        version = body.get("version")
+        if not kwargs and version is None and self.batching:
+            batcher = self._rank_batcher(name)
+            nfeat = batcher.predictor.num_feature
+            if rows.shape[1] < nfeat:
+                raise LightGBMError(
+                    f"rank called with {rows.shape[1]} features; model "
+                    f"{name!r} expects {nfeat}")
+            out, meta = batcher.predict(rows, deadline_t=deadline_t,
+                                        trace_span=span)
+            served_version = (meta.get("version")
+                              if isinstance(meta, dict) else meta)
+        else:
+            if (deadline_t is not None
+                    and time.perf_counter() >= deadline_t):
+                self.registry.current_version(name)
+                self.metrics.rank(name).record_deadline_refusal()
+                raise DeadlineExceededError(
+                    f"deadline budget ({float(deadline_ms):g}ms) spent "
+                    "before dispatch")
+            dspan = (None if span is None
+                     else span.child("replica.device.rank",
+                                     rows=int(rows.shape[0])))
+            try:
+                with self.registry.acquire(name, version) as (pred, v):
+                    out = pred.predict(rows, raw_score=True, **kwargs)
+                    served_version = v
+            finally:
+                if dspan is not None:
+                    dspan.finish()
+            self.metrics.rank(name).record_request(
+                rows.shape[0], latency_s=time.perf_counter() - t0)
+        scores = np.asarray(out, np.float64)
+        if scores.ndim != 1:
+            raise LightGBMError(
+                "rank needs one score per row; model "
+                f"{name!r} returns shape {scores.shape} — multiclass "
+                "models have no single ranking score")
+        order = []
+        bounds = np.concatenate([[0], np.cumsum(g)])
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            # best-first within the query; stable sort, so score ties
+            # keep their request order (the same tiebreak device NDCG
+            # and the host eval use)
+            o = int(lo) + np.argsort(-scores[lo:hi], kind="stable")
+            order.append([int(i) for i in (o[:top_k] if top_k else o)])
+        self.metrics.rank(name).record_queries(len(g))
+        if span is not None:
+            span.set(queries=len(g))
+        return 200, {"name": name, "version": served_version,
+                     "scores": scores.tolist(),
+                     "order": order,
+                     "top_k": top_k}
 
     def _predict_inner(self, name: str, body: dict,
                        span) -> Tuple[int, dict]:

@@ -862,7 +862,8 @@ def test_fleet_and_serving_params_documented():
         text = fh.read()
     scoped = [p for p in _PARAMS
               if p.name.startswith(("fleet_", "serving_", "cascade_",
-                                    "explain_", "continuous_attrib_"))]
+                                    "explain_", "continuous_attrib_",
+                                    "rank_", "lambdarank_"))]
     assert len(scoped) >= 34      # the guard guards something real
     # ISSUE-16: the multi-tenant control plane shipped its own knob
     # families — placement + autoscaling must stay covered by this guard
@@ -878,14 +879,65 @@ def test_fleet_and_serving_params_documented():
     attrib = [p.name for p in scoped
               if p.name.startswith("continuous_attrib_")]
     assert len(attrib) >= 3, attrib
+    # ISSUE-20: the learning-to-rank subsystem's knob families (serving
+    # rank lane + query bucketing + lambdarank objective knobs)
+    rankp = [p.name for p in scoped if p.name.startswith(("rank_",
+                                                          "lambdarank_"))]
+    assert len(rankp) >= 6, rankp
     missing_desc = [p.name for p in scoped if not (p.desc or "").strip()]
     assert not missing_desc, (
-        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_* "
-        f"params without a desc: {missing_desc}")
+        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_*/"
+        f"rank_*/lambdarank_* params without a desc: {missing_desc}")
     missing_doc = [p.name for p in scoped if p.name not in text]
     assert not missing_doc, (
-        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_* "
-        f"params not mentioned in README.md: {missing_doc}")
+        f"fleet_*/serving_*/cascade_*/explain_*/continuous_attrib_*/"
+        f"rank_*/lambdarank_* params not mentioned in README.md: "
+        f"{missing_doc}")
+
+
+def test_no_error_message_names_a_lifted_query_gate():
+    """ISSUE-20 static guard: the query-data gates are LIFTED — ranking
+    datasets now bucket, extend, and serve like any other.  No
+    LightGBMError raised anywhere in the package may claim otherwise
+    (e.g. 'query data is not supported', 'ranking datasets cannot
+    extend'): a stale refusal message would resurrect a gate the
+    subsystem was built to remove.  The ONE standing query gate —
+    multi-machine rank-sharded ingestion, whose row round-robin
+    genuinely cannot keep queries whole — must say so by name
+    ('rank-sharded'); any other query refusal is an offender."""
+    import os
+    import re
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu")
+    # phrasings the old gates used (and near misses a revert would
+    # plausibly reintroduce); checked against every raise site's text
+    gate_phrases = [
+        r"quer(?:y|ies)[^\"']{0,40}not\s+(?:yet\s+)?supported",
+        r"rank(?:ing)?[^\"']{0,40}not\s+(?:yet\s+)?supported",
+        r"not\s+(?:yet\s+)?supported[^\"']{0,40}quer(?:y|ies)",
+        r"(?:refus\w+|cannot|can't)[^\"']{0,40}query\s+data",
+        r"rank(?:ing)?\s+datasets?\s+cannot",
+    ]
+    offenders = []
+    for root, _, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            for m in re.finditer(r"LightGBMError\(\s*([^)]*)", src):
+                text = m.group(1)
+                if "rank-sharded" in text:
+                    continue     # the standing gate, named as required
+                for pat in gate_phrases:
+                    if re.search(pat, text, re.IGNORECASE):
+                        line = src[:m.start()].count("\n") + 1
+                        offenders.append(f"{fname}:{line}: {text[:80]!r}")
+    assert not offenders, (
+        "LightGBMError message names a lifted query gate:\n"
+        + "\n".join(offenders))
 
 
 def test_compiled_predictor_cache_key_carries_tree_bucket():

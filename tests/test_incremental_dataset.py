@@ -205,7 +205,9 @@ def test_bucketed_training_bit_identical(mode):
 
 def test_bucketed_guards():
     """Configs the padding contract can't cover are rejected (custom fobj,
-    renew-output objectives) or quietly unpadded (query data)."""
+    renew-output objectives); ranking data pads like any other — the
+    padded rows sit after every query and the gradient scatter drops its
+    pad slots, so queries stay intact on the bucket ladder."""
     X, y = _pool(300, seed=12)
     p = dict(CFG, train_row_buckets=True, num_leaves=7)
     ds = lgb.Dataset(X, label=y)
@@ -216,10 +218,14 @@ def test_bucketed_guards():
         lgb.train(dict(p, objective="regression_l1"),
                   lgb.Dataset(X, label=np.asarray(y, np.float64)),
                   num_boost_round=2)
-    # ranking data: padding silently disabled (queries must stay intact)
+    # ranking data: pads onto the row-bucket ladder like everything else
     handle = TrainDataset(X, Metadata(y, group=np.asarray([150, 150])),
                           Config(p))
-    assert handle.num_rows_device == handle.num_data == 300
+    assert handle.num_data == 300
+    assert handle.num_rows_device == 512
+    assert handle.query_ids is not None
+    qids = np.asarray(handle.query_ids)
+    assert (qids[300:] == -1).all() and (qids[:300] >= 0).all()
 
 
 def test_fused_signature_stable_across_bucket():

@@ -1,0 +1,475 @@
+"""Learning-to-rank through the modern stack (ISSUE 20).
+
+Five contracts, each tested in isolation:
+
+1. **Bucketed bit-identity** — lambdarank / rank_xendcg trained on the
+   power-of-two query-bucket ladder (`rank_query_buckets`, the default)
+   produce byte-equal models to the unpadded layout, across plain and
+   bagging runs (model_to_string equality, the PR 9 standard).
+2. **Device NDCG parity** — `rank/ndcg.py` matches the host
+   `NDCGMetric` reference (label_gain gains, log2 discounts, stable
+   tie-break, all-same-label queries score 1) on ragged query mixes.
+3. **jaxpr-const discipline** — the padded ranking gradient program
+   over an EXTENDED query store carries its query layout as jit
+   arguments, never closure constants (the guard class every padded
+   program in this repo passes).
+4. **Rank-aware continuous cycles** — qid/sidecar tails keep queries
+   atomic (bad row quarantines its whole query, structural tears
+   quarantine the segment tail whole), and a lambdarank cycle gates
+   publish on holdout NDCG.
+5. **The fleet `:rank` verb** — per-query scores + sorted order/top-k
+   on replica and router, with the rank lane's own SLO class and
+   `lgbm_{serving,fleet}_rank_*` metric families.
+
+Everything runs in-process on the CPU backend; router tests use
+transport-free replicas, mirroring tests/test_explain.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.continuous import (ContinuousService, ContinuousTrainer,
+                                     DataTail, PublishGate)
+from lightgbm_tpu.fleet import FleetRouter
+from lightgbm_tpu.rank import device_ndcg
+from lightgbm_tpu.serving.server import ServingApp
+
+NF = 6
+
+
+def _rank_pool(n=400, n_q=40, seed=7):
+    """Query-grouped pool: integer relevance grades, ragged queries."""
+    r = np.random.RandomState(seed)
+    sizes = r.randint(5, 2 * n // n_q, size=n_q)
+    sizes[-1] = max(n - int(sizes[:-1].sum()), 1)
+    n = int(sizes.sum())
+    X = r.randn(n, NF)
+    rel = (2 * X[:, 0] + X[:, 1] + 0.5 * r.randn(n))
+    edges = np.quantile(rel, [0.5, 0.8, 0.95])
+    y = np.digitize(rel, edges).astype(np.float64)
+    return X, y, sizes.astype(np.int64)
+
+
+RANK_PARAMS = {"num_leaves": 7, "verbosity": -1, "min_data_in_leaf": 5,
+               "learning_rate": 0.1, "seed": 7, "deterministic": True,
+               "max_bin": 63}
+
+
+# ---------------------------------------------------------------------------
+# 1. bucketed bit-identity
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("objective", ["lambdarank", "rank_xendcg"])
+@pytest.mark.parametrize("bagging", [False, True])
+def test_query_bucketed_training_bit_identical(objective, bagging):
+    X, y, g = _rank_pool()
+
+    def train(buckets):
+        p = dict(RANK_PARAMS, objective=objective,
+                 rank_query_buckets=buckets)
+        if bagging:
+            p.update(bagging_fraction=0.7, bagging_freq=1,
+                     bagging_seed=11)
+        ds = lgb.Dataset(X, label=y, group=g, free_raw_data=False)
+        return lgb.train(p, ds, num_boost_round=12).model_to_string()
+
+    assert train(True) == train(False)
+
+
+# ---------------------------------------------------------------------------
+# 2. device NDCG vs the host reference
+# ---------------------------------------------------------------------------
+def test_device_ndcg_matches_host_metric():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import NDCGMetric
+    X, y, g = _rank_pool(n=300, n_q=24, seed=3)
+    qb = np.concatenate([[0], np.cumsum(g)])
+    r = np.random.RandomState(5)
+    score = r.randn(len(y))
+    # ties + an all-same-label query: the reference's edge rules
+    score[qb[2]:qb[3]] = 0.25
+    y[qb[4]:qb[5]] = 2.0
+    cfg = Config({"objective": "lambdarank", "eval_at": [1, 3, 5, 10],
+                  "rank_device_ndcg": False})
+    host = NDCGMetric(cfg).eval(score, y, None, None, query_info=qb)
+    dev = device_ndcg(score, y, qb, eval_at=(1, 3, 5, 10),
+                      label_gain=cfg.label_gain)
+    for (name, hv, _), dv in zip(host, dev):
+        assert abs(hv - dv) < 1e-6, (name, hv, dv)
+
+
+def test_device_ndcg_custom_label_gain():
+    _, y, g = _rank_pool(n=200, n_q=16, seed=9)
+    qb = np.concatenate([[0], np.cumsum(g)])
+    score = np.random.RandomState(1).randn(len(y))
+    lin = list(range(8))                       # linear, not 2^i - 1
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import NDCGMetric
+    cfg = Config({"objective": "lambdarank", "label_gain": lin,
+                  "eval_at": [5], "rank_device_ndcg": False})
+    host = NDCGMetric(cfg).eval(score, y, None, None, query_info=qb)
+    dev = device_ndcg(score, y, qb, eval_at=(5,), label_gain=lin)
+    assert abs(host[0][1] - dev[0]) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# 3. jaxpr-const discipline over an EXTENDED query store
+# ---------------------------------------------------------------------------
+def test_no_closure_array_constants_in_padded_ranking_program():
+    """The padded ranking gradient program (query gather/scatter, pad
+    masks) must take its query layout as jit ARGUMENTS: a layout baked
+    in as a closure constant would force a recompile every continuous
+    cycle, exactly what the query-bucket ladder exists to avoid."""
+    import jax
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Metadata, TrainDataset
+    X0, y0, g0 = _rank_pool(n=300, n_q=30, seed=14)
+    X1, y1, g1 = _rank_pool(n=120, n_q=12, seed=15)
+    params = dict(RANK_PARAMS, objective="lambdarank",
+                  rank_query_buckets=True, train_row_buckets=True)
+    # the booster is built over an EXTENDED incremental query store
+    # (extend happens between runs, like the continuous trainer cycles)
+    handle = TrainDataset(X0, Metadata(y0, group=g0), Config(params))
+    handle.extend(X1, y1, group_new=g1)
+    ds = lgb.Dataset._from_handle(handle, params)
+    bst = lgb.train(params, ds, num_boost_round=1)
+    gbdt = bst._gbdt
+    block = gbdt._build_fused_block(1, 2)
+    args = gbdt._fused_example_args(2)
+    closed = jax.make_jaxpr(block)(*args)
+    sizes = [int(np.asarray(c).size) for c in closed.consts
+             if hasattr(c, "shape")]
+    assert max(sizes, default=0) <= 64, (
+        "the padded ranking gradient program captured an array constant "
+        f"instead of taking it as an argument (const sizes: {sizes})")
+
+
+# ---------------------------------------------------------------------------
+# 4a. tail: queries are atomic
+# ---------------------------------------------------------------------------
+def _write_seg(src, name, lines):
+    tmp = os.path.join(src, f"_{name}.part")
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    os.replace(tmp, os.path.join(src, name))
+
+
+def _qid_lines(X, y, qids):
+    return [",".join([f"{y[i]:.0f}", str(int(qids[i]))]
+                     + [f"{v:.6f}" for v in X[i]])
+            for i in range(len(y))]
+
+
+def test_tail_qid_bad_row_quarantines_whole_query(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    r = np.random.RandomState(0)
+    X = r.randn(9, NF)
+    y = np.array([1, 0, 2, 1, 1, 0, 2, 0, 1], float)
+    qids = np.array([0, 0, 0, 1, 1, 1, 2, 2, 2])
+    lines = _qid_lines(X, y, qids)
+    f = lines[4].split(",")
+    f[2] = "nan"                                     # poison query 1
+    lines[4] = ",".join(f)
+    _write_seg(src, "seg000.csv", lines)
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="qid", quarantine_path=qp)
+    (b,) = tail.poll()
+    # queries 0 and 2 survive whole; query 1 is gone whole
+    assert b.group.tolist() == [3, 3] and len(b.y) == 6
+    import json
+    recs = [json.loads(l) for l in open(qp)]
+    assert len(recs) == 3                    # all 3 rows of query 1
+    assert any("query integrity" in r["reason"] for r in recs)
+
+
+def test_tail_qid_reappearing_qid_tears_segment_tail(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    r = np.random.RandomState(1)
+    X = r.randn(8, NF)
+    y = np.ones(8)
+    qids = np.array([0, 0, 1, 1, 0, 2, 2, 2])   # qid 0 reappears at row 4
+    _write_seg(src, "seg000.csv", _qid_lines(X, y, qids))
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="qid", quarantine_path=qp)
+    (b,) = tail.poll()
+    # clean prefix [q0, q1]; the tail from the tear is quarantined whole
+    assert b.group.tolist() == [2, 2] and len(b.y) == 4
+    import json
+    recs = [json.loads(l) for l in open(qp)]
+    assert len(recs) == 4
+    assert all("reappears" in r["reason"] for r in recs)
+
+
+def test_tail_sidecar_incomplete_final_query(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    r = np.random.RandomState(2)
+    X = r.randn(7, NF)
+    y = np.zeros(7)
+    lines = [",".join([f"{y[i]:.0f}"] + [f"{v:.6f}" for v in X[i]])
+             for i in range(7)]
+    _write_seg(src, "seg000.csv", lines)
+    # declares 3+4+4 rows but the segment only has 7: the final query
+    # is torn and its rows quarantine whole
+    with open(os.path.join(src, "seg000.csv.group"), "w") as fh:
+        fh.write("3\n4\n4\n")
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="sidecar", quarantine_path=qp)
+    (b,) = tail.poll()
+    # the two complete queries survive; the zero-row final declaration
+    # tears nothing, so nothing quarantines
+    assert b.group.tolist() == [3, 4]
+    assert len(b.y) == 7
+    assert not os.path.exists(qp) or len(open(qp).readlines()) == 0
+
+
+def test_tail_sidecar_short_segment_quarantines_tail(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    r = np.random.RandomState(2)
+    X = r.randn(6, NF)
+    y = np.zeros(6)
+    lines = [",".join([f"{y[i]:.0f}"] + [f"{v:.6f}" for v in X[i]])
+             for i in range(6)]
+    _write_seg(src, "seg000.csv", lines)
+    with open(os.path.join(src, "seg000.csv.group"), "w") as fh:
+        fh.write("3\n4\n")                   # declares 7 rows, has 6
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="sidecar", quarantine_path=qp)
+    (b,) = tail.poll()
+    assert b.group.tolist() == [3] and len(b.y) == 3
+    import json
+    recs = [json.loads(l) for l in open(qp)]
+    assert len(recs) == 3
+    assert all("incomplete final query" in r["reason"] for r in recs)
+    # the .group sidecar itself is never discovered as a data segment
+    assert all(not bname.endswith(".group") for bname in tail._seen)
+
+
+def test_tail_rank_label_validation(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    qp = str(tmp_path / "q.jsonl")
+    r = np.random.RandomState(3)
+    X = r.randn(4, NF)
+    qids = np.array([0, 0, 1, 1])
+    lines = _qid_lines(X, np.array([1.0, 2.0, 1.0, 1.0]), qids)
+    lines[0] = "-1," + lines[0].split(",", 1)[1]       # negative grade
+    _write_seg(src, "seg000.csv", lines)
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="qid", quarantine_path=qp)
+    (b,) = tail.poll()
+    assert b.group.tolist() == [2] and len(b.y) == 2   # query 0 gone
+    import json
+    recs = [json.loads(l) for l in open(qp)]
+    assert any("relevance grade" in r["reason"] for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# 4b. continuous lambdarank cycle gated on NDCG
+# ---------------------------------------------------------------------------
+def test_continuous_lambdarank_cycle_publishes_on_ndcg(tmp_path):
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    app = ServingApp()
+    params = dict(RANK_PARAMS, objective="lambdarank", num_leaves=7)
+    trainer = ContinuousTrainer(params, str(tmp_path / "work"),
+                                rounds_per_cycle=4, gate_metric="ndcg",
+                                ndcg_at=5)
+    gate = PublishGate(app.registry, "rk", min_auc=0.2, metric="ndcg",
+                       ndcg_at=5)
+    tail = DataTail(src, num_features=NF, label_kind="rank",
+                    query_mode="qid",
+                    quarantine_path=str(tmp_path / "q.jsonl"))
+    svc = ContinuousService(tail, trainer, gate, poll_s=0.0,
+                            retry_backoff_s=0.0)
+    qid0 = 0
+    for cyc in range(2):
+        X, y, g = _rank_pool(n=260, n_q=26, seed=20 + cyc)
+        qids = np.repeat(np.arange(qid0, qid0 + len(g)), g)
+        qid0 += len(g)
+        _write_seg(src, f"seg{cyc:03d}.csv", _qid_lines(X, y, qids))
+        s = svc.step()
+        assert s["trained"], s
+        assert s["decision"]["action"] == "publish", s
+        # the gate's number is NDCG, not AUC: multi-grade labels would
+        # crash an AUC gate, and the value is a sane mean NDCG@5
+        assert 0.2 <= s["decision"]["auc"] <= 1.0
+    assert app.registry.current_version("rk") == 2
+    # holdout split respected query boundaries: per-query sizes known
+    hg = trainer.holdout_group()
+    assert hg is not None and int(hg.sum()) == len(trainer._hold_y[0]) \
+        + sum(len(y) for y in trainer._hold_y[1:])
+    app.close()
+
+
+def test_trainer_refuses_mixed_flat_and_query_segments(tmp_path):
+    params = dict(RANK_PARAMS, objective="lambdarank")
+    trainer = ContinuousTrainer(params, str(tmp_path / "work"),
+                                rounds_per_cycle=2)
+    X, y, g = _rank_pool(n=100, n_q=10, seed=1)
+    trainer.ingest(X, y, group=g)
+    with pytest.raises(lgb.LightGBMError, match="query-grouped"):
+        trainer.ingest(X, y)                 # flat segment after grouped
+
+
+# ---------------------------------------------------------------------------
+# 5. serving + fleet `:rank`
+# ---------------------------------------------------------------------------
+def _rank_model():
+    X, y, g = _rank_pool(n=300, n_q=30, seed=4)
+    p = dict(RANK_PARAMS, objective="lambdarank")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, group=g),
+                    num_boost_round=8)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def rank_booster():
+    return _rank_model()
+
+
+def test_rank_verb_scores_order_topk(rank_booster):
+    bst, X = rank_booster
+    app = ServingApp()
+    st, _ = app.handle("POST", "/v1/models/rk:publish",
+                       {"model_str": bst.model_to_string()})
+    assert st == 200
+    rows = X[:10]
+    st, r = app.handle("POST", "/v1/models/rk:rank",
+                       {"rows": rows.tolist(), "group": [4, 6]})
+    assert st == 200, r
+    raw = bst.predict(rows, raw_score=True)
+    np.testing.assert_array_equal(np.asarray(r["scores"]), raw)
+    # per-query order: indices stay inside their query, scores descend
+    order = r["order"]
+    assert sorted(order[0]) == [0, 1, 2, 3]
+    assert sorted(order[1]) == [4, 5, 6, 7, 8, 9]
+    for o in order:
+        s = raw[o]
+        assert all(s[i] >= s[i + 1] for i in range(len(s) - 1))
+    # top-k truncation per query
+    st, r = app.handle("POST", "/v1/models/rk:rank",
+                       {"rows": rows.tolist(), "group": [4, 6],
+                        "top_k": 2})
+    assert st == 200 and [len(o) for o in r["order"]] == [2, 2]
+    assert r["order"][0] == order[0][:2]
+    # group omitted: the whole request is one query
+    st, r = app.handle("POST", "/v1/models/rk/rank",
+                       {"rows": rows[:5].tolist()})
+    assert st == 200 and len(r["order"]) == 1
+    assert sorted(r["order"][0]) == [0, 1, 2, 3, 4]
+    app.close()
+
+
+def test_rank_verb_error_paths_and_metrics(rank_booster):
+    bst, X = rank_booster
+    app = ServingApp(rank_default_deadline_ms=5000.0)
+    app.handle("POST", "/v1/models/rk:publish",
+               {"model_str": bst.model_to_string()})
+    st, r = app.handle("POST", "/v1/models/rk:rank",
+                       {"rows": X[:6].tolist(), "group": [3, 3]})
+    assert st == 200, r
+    # group sizes must cover the request exactly
+    st, r = app.handle("POST", "/v1/models/rk:rank",
+                       {"rows": X[:6].tolist(), "group": [3, 4]})
+    assert st == 400 and "whole queries" in r["error"]
+    # spent deadline refused up-front, in the rank lane's OWN family
+    st, _ = app.handle("POST", "/v1/models/rk:rank",
+                       {"rows": X[:2].tolist(), "deadline_ms": 0})
+    assert st == 504
+    st, _ = app.handle("POST", "/v1/models/nope:rank",
+                       {"rows": X[:2].tolist()})
+    assert st == 404
+    st, snap = app.handle("GET", "/v1/metrics", None)
+    assert "rk:rank" in snap
+    assert snap["rk:rank"]["deadline_refused"] == 1
+    assert snap["rk:rank"]["queries"] == 2
+    assert snap["rk"]["requests"] == 0       # predict lane untouched
+    st, prom = app.handle("GET", "/v1/metrics/prometheus", None)
+    text = prom["text"] if isinstance(prom, dict) else prom
+    assert "lgbm_serving_rank_requests_total" in text
+    assert "lgbm_serving_rank_queries_total" in text
+    app.close()
+
+
+def test_cascade_gauges_in_metrics(rank_booster):
+    """Satellite 1: per-model cascade gauges ride the metrics snapshot
+    and the prometheus rendering."""
+    rng = np.random.RandomState(6)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] + 0.2 * rng.randn(300)).astype(np.float32)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, y), num_boost_round=6)
+    app = ServingApp(cascade_mode="band", cascade_prefix_trees=2,
+                     cascade_epsilon=0.1)
+    app.handle("POST", "/v1/models/m:publish",
+               {"model_str": bst.model_to_string()})
+    for _ in range(3):
+        st, _ = app.handle("POST", "/v1/models/m:predict",
+                           {"rows": X[:8].tolist()})
+        assert st == 200
+    st, snap = app.handle("GET", "/v1/metrics", None)
+    assert snap["m"]["cascade_prefix_rung"] >= 2
+    assert 0.0 <= snap["m"]["cascade_exit_ema"] <= 1.0
+    st, prom = app.handle("GET", "/v1/metrics/prometheus", None)
+    text = prom["text"] if isinstance(prom, dict) else prom
+    assert "lgbm_serving_cascade_prefix_rung" in text
+    assert "lgbm_serving_cascade_exit_ema" in text
+    app.close()
+
+
+class _AppReplica:
+    """Transport-free endpoint over a real in-process ServingApp."""
+
+    def __init__(self, name, app):
+        self.name = name
+        self.app = app
+
+    def health(self, timeout_s=2.0):
+        st, body = self.app.handle("GET", "/v1/fleet/health", None)
+        return body.get("gauges", {}) if st == 200 else None
+
+    def request(self, method, path, body=None, timeout_s=None):
+        return self.app.handle(method, path, body)
+
+
+def test_router_forwards_rank_with_own_metric_family(rank_booster):
+    bst, X = rank_booster
+    apps = [ServingApp(), ServingApp()]
+    router = FleetRouter(
+        [_AppReplica(f"r{i}", a) for i, a in enumerate(apps)],
+        poll_interval_ms=0, autostart=False)
+    router.poll_once()
+    st, _ = router.handle("POST", "/v1/models/rk:publish",
+                          {"model_str": bst.model_to_string()})
+    assert st == 200
+    raw = bst.predict(X[:8], raw_score=True)
+    st, r = router.handle("POST", "/v1/models/rk:rank",
+                          {"rows": X[:8].tolist(), "group": [3, 5]})
+    assert st == 200, r
+    np.testing.assert_array_equal(np.asarray(r["scores"]), raw)
+    st, r = router.handle("POST", "/v1/models/rk/rank",
+                          {"rows": X[:4].tolist()})
+    assert st == 200
+    st, _ = router.handle("POST", "/v1/models/rk:rank",
+                          {"rows": X[:2].tolist(), "deadline_ms": 0})
+    assert st == 504
+    snap = router.registry.snapshot()
+    assert snap["lgbm_fleet_rank_requests_total"]["model=rk"] == 3.0
+    assert snap["lgbm_fleet_rank_deadline_missed_total"]["model=rk"] == 1.0
+    # the predict family never counts rank traffic
+    assert "model=rk" not in snap.get("lgbm_fleet_requests_total", {})
+    for a in apps:
+        a.close()
+    router.close()

@@ -411,6 +411,66 @@ def test_recovery_without_commit_record_replays_everything(tmp_path):
     assert s["decision"]["action"] == "publish"
 
 
+def test_attrib_sketch_survives_kill_relaunch(tmp_path, monkeypatch):
+    """The attribution-drift sketch is cumulative evidence: a relaunch
+    that restarted it from zero would re-pin its reference windows on
+    post-drift data, silencing the very alarm it exists to raise.  The
+    two-phase commit persists its state (attrib_sketch.npz next to the
+    commit record) and recover() restores it bit-for-bit."""
+    from lightgbm_tpu.checkpoint.fault import InjectedWorkerFault
+    from lightgbm_tpu.serving.server import ServingApp
+
+    def build(tag):
+        src = os.path.join(str(tmp_path), "src")
+        os.makedirs(src, exist_ok=True)
+        work = os.path.join(str(tmp_path), "work")
+        os.makedirs(work, exist_ok=True)
+        app = ServingApp()
+        trainer = ShardedContinuousTrainer(
+            dict(PARAMS), work, FleetComm(0, 1), rounds_per_cycle=3)
+        gate = PublishGate(app.registry, tag, min_auc=0.55,
+                           attrib_threshold=5.0, attrib_sample=64)
+        tail = DataTail(src, num_features=NF,
+                        quarantine_path=os.path.join(work, "q.jsonl"))
+        svc = ShardedContinuousService(tail, trainer, gate, poll_s=0.0,
+                                       retry_backoff_s=0.0)
+        return src, svc
+
+    src, svc = build("m")
+    # cycle 0 publishes (arms the live model); cycle 1's watch folds the
+    # first attribution window into the sketch, and its commit persists
+    for i in range(2):
+        X, y = _xy(300, seed=10 + i)
+        _write_segment(src, f"seg{i:03d}.csv", X, y)
+        assert svc.step()["decision"]["action"] == "publish"
+    sk = svc.gate.sketch
+    assert sk is not None and sk.windows_seen == 1
+    committed = {k: v.copy() for k, v in sk.state_dict().items()}
+
+    # cycle 2 dies after the poll, before the commit
+    X, y = _xy(300, seed=12)
+    _write_segment(src, "seg002.csv", X, y)
+    monkeypatch.setenv("LGBM_TPU_FAULT_CYCLE", "2")
+    monkeypatch.setenv("LGBM_TPU_FAULT_MODE", "raise")
+    with pytest.raises(InjectedWorkerFault):
+        svc.step()
+    monkeypatch.delenv("LGBM_TPU_FAULT_CYCLE")
+    monkeypatch.delenv("LGBM_TPU_FAULT_MODE")
+
+    # relaunch: the sketch resumes from the COMMITTED profile, not zero
+    _, svc2 = build("m")
+    sk2 = svc2.gate.sketch
+    assert sk2 is not None and sk2.windows_seen == 1
+    assert svc2.gate._attrib_alarm_pending is False
+    for k, v in committed.items():
+        np.testing.assert_array_equal(sk2.state_dict()[k], v)
+    # and the interrupted cycle replays to a publish with the sketch
+    # continuing to accumulate (window 2 completes the reference)
+    s = svc2.step()
+    assert s["replayed"] and s["decision"]["action"] == "publish"
+    assert svc2.gate.sketch.windows_seen == 2
+
+
 # ---------------------------------------------------------------------------
 # in-process 2-rank fleet: identical models + consensus re-bin
 # ---------------------------------------------------------------------------
